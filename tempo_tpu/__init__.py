@@ -1,0 +1,26 @@
+"""tempo-tpu: a TPU-native distributed tracing backend.
+
+A ground-up rebuild of the capabilities of Shopify/tempo (Grafana Tempo,
+FlatBuffer-search era — see /root/repo/SURVEY.md): multi-tenant span
+ingestion, WAL-backed immutable block building, object-storage-only
+persistence, bloom+index trace-by-ID lookup, compaction/retention, and a
+columnar tag-search engine whose hot scan path runs as JAX/XLA kernels on
+TPU, sharded over a `jax.sharding.Mesh` with ICI collectives.
+
+Layer map (mirrors SURVEY.md §1, reinterpreted TPU-first):
+
+  backend/    object storage (local, in-memory mock; s3/gcs/azure gated)
+  encoding/   immutable block format vT1 (pages, index, bloom)
+  tempopb/    wire model (OTLP-compatible protobuf) + helpers
+  model/      trace object codecs (v1 raw proto, v2 framed)
+  wal/        write-ahead log with crash replay
+  search/     columnar search blocks + the JAX scan engine (north star)
+  ops/        jax/pallas kernels used by search
+  parallel/   device mesh, shard_map distribution, collectives
+  db/         tempodb orchestration: blocklist, poller, compaction, pool
+  modules/    distributor / ingester / querier / frontend / overrides
+  api/        HTTP+gRPC surface
+  utils/      hashing, ids, test fabricators
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+from .codec import (
+    CURRENT_ENCODING,
+    ALL_ENCODINGS,
+    ObjectCodec,
+    SegmentCodec,
+    codec_for,
+    segment_codec_for,
+)
+from .combine import combine_trace_protos, combine_trace_bytes
+from .matches import matches, trace_search_metadata
+from .sort import sort_trace
+
+__all__ = [
+    "CURRENT_ENCODING", "ALL_ENCODINGS", "ObjectCodec", "SegmentCodec",
+    "codec_for", "segment_codec_for", "combine_trace_protos",
+    "combine_trace_bytes", "matches", "trace_search_metadata", "sort_trace",
+]

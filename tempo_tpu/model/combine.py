@@ -1,0 +1,49 @@
+"""Trace combination: merge partial traces for the same id, deduping spans.
+
+Role-equivalent to the reference's pkg/model/trace/combine.go (span-id
+hashing dedupe) — used on read (partials from several ingesters/blocks) and
+during compaction (same trace object in two input blocks).
+"""
+
+from __future__ import annotations
+
+from tempo_tpu import tempopb
+
+
+def combine_trace_protos(traces: list[tempopb.Trace]) -> tempopb.Trace:
+    if not traces:
+        return tempopb.Trace()
+    if len(traces) == 1:
+        # copy: callers own the result and may mutate it (sort, dedupe)
+        out = tempopb.Trace()
+        out.CopyFrom(traces[0])
+        return out
+    out = tempopb.Trace()
+    seen: set[bytes] = set()
+    for t in traces:
+        for batch in t.batches:
+            kept = None
+            for ss in batch.scope_spans:
+                new_spans = [s for s in ss.spans if _span_key(s) not in seen]
+                for s in new_spans:
+                    seen.add(_span_key(s))
+                if new_spans:
+                    if kept is None:
+                        kept = out.batches.add()
+                        kept.resource.CopyFrom(batch.resource)
+                        kept.schema_url = batch.schema_url
+                    nss = kept.scope_spans.add()
+                    nss.scope.CopyFrom(ss.scope)
+                    nss.schema_url = ss.schema_url
+                    nss.spans.extend(new_spans)
+    return out
+
+
+def combine_trace_bytes(objs: list[bytes], encoding: str) -> bytes:
+    from tempo_tpu.model.codec import codec_for
+
+    return codec_for(encoding).combine(*objs)
+
+
+def _span_key(span: tempopb.Span) -> bytes:
+    return span.span_id or span.SerializeToString()

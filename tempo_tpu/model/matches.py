@@ -1,0 +1,120 @@
+"""Proto-level search matching over full trace objects.
+
+Role-equivalent to the reference's pkg/model/trace/matches.go:33-184: the
+querier's trace-block scan path evaluates a SearchRequest directly against
+the unmarshalled proto (tag substring semantics on string attributes,
+numeric equality on int attrs, duration and time-window filters), and
+extracts TraceSearchMetadata (root service/span, start, duration).
+
+This is the CPU fallback / correctness oracle for the TPU columnar engine —
+both must agree on match semantics (tests assert this).
+"""
+
+from __future__ import annotations
+
+from tempo_tpu import tempopb
+
+
+def _attr_matches(kv: tempopb.KeyValue, want_key: str, want_val: str) -> bool:
+    if kv.key != want_key:
+        return False
+    which = kv.value.WhichOneof("value")
+    if which == "string_value":
+        return want_val in kv.value.string_value  # substring, like bytes.Contains
+    if which == "int_value":
+        return want_val == str(kv.value.int_value)
+    if which == "bool_value":
+        return want_val == ("true" if kv.value.bool_value else "false")
+    if which == "double_value":
+        return want_val == repr(kv.value.double_value)
+    return False
+
+
+def _iter_all_attrs(trace: tempopb.Trace):
+    for batch in trace.batches:
+        for kv in batch.resource.attributes:
+            yield kv
+        for ss in batch.scope_spans:
+            for span in ss.spans:
+                for kv in span.attributes:
+                    yield kv
+                # well-known derived tags, as the reference's search data
+                # extraction records name and status error
+                nk = tempopb.KeyValue()
+                nk.key = "name"
+                nk.value.string_value = span.name
+                yield nk
+                if span.status.code == tempopb.Status.STATUS_CODE_ERROR:
+                    ek = tempopb.KeyValue()
+                    ek.key = "error"
+                    ek.value.string_value = "true"
+                    yield ek
+
+
+def trace_range_ns(trace: tempopb.Trace) -> tuple[int, int]:
+    start, end = 2**63, 0
+    for batch in trace.batches:
+        for ss in batch.scope_spans:
+            for span in ss.spans:
+                start = min(start, span.start_time_unix_nano)
+                end = max(end, span.end_time_unix_nano)
+    if end == 0:
+        return 0, 0
+    return start, end
+
+
+def matches(trace: tempopb.Trace, req: tempopb.SearchRequest) -> bool:
+    start_ns, end_ns = trace_range_ns(trace)
+    dur_ms = (end_ns - start_ns) // 1_000_000
+    if req.min_duration_ms and dur_ms < req.min_duration_ms:
+        return False
+    if req.max_duration_ms and dur_ms > req.max_duration_ms:
+        return False
+    if req.start and end_ns // 1_000_000_000 < req.start:
+        return False
+    if req.end and start_ns // 1_000_000_000 > req.end:
+        return False
+    if req.tags:
+        attrs = list(_iter_all_attrs(trace))
+        for k, v in req.tags.items():
+            if not any(_attr_matches(kv, k, v) for kv in attrs):
+                return False
+    return True
+
+
+def trace_search_metadata(trace_id: bytes, trace: tempopb.Trace) -> tempopb.TraceSearchMetadata:
+    m = tempopb.TraceSearchMetadata()
+    m.trace_id = trace_id.hex()
+    start_ns, end_ns = trace_range_ns(trace)
+    m.start_time_unix_nano = start_ns if start_ns < 2**63 else 0
+    m.duration_ms = min(max(0, (end_ns - start_ns)) // 1_000_000, 0xFFFFFFFF)
+    # root span: no parent
+    root = None
+    root_service = ""
+    for batch in trace.batches:
+        svc = ""
+        for kv in batch.resource.attributes:
+            if kv.key == "service.name":
+                svc = kv.value.string_value
+        for ss in batch.scope_spans:
+            for span in ss.spans:
+                if not span.parent_span_id and (
+                    root is None or span.start_time_unix_nano < root.start_time_unix_nano
+                ):
+                    root = span
+                    root_service = svc
+    if root is None:  # fall back to earliest span
+        for batch in trace.batches:
+            svc = ""
+            for kv in batch.resource.attributes:
+                if kv.key == "service.name":
+                    svc = kv.value.string_value
+            for ss in batch.scope_spans:
+                for span in ss.spans:
+                    if root is None or span.start_time_unix_nano < root.start_time_unix_nano:
+                        root = span
+                        root_service = svc
+    if root is not None:
+        m.root_trace_name = root.name
+        m.root_service_name = root_service
+    return m

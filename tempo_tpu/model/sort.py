@@ -1,0 +1,14 @@
+"""Span/batch sorting by start time (reference pkg/model/trace/sort.go)."""
+
+from __future__ import annotations
+
+from tempo_tpu import tempopb
+
+
+def sort_trace(trace: tempopb.Trace) -> tempopb.Trace:
+    for batch in trace.batches:
+        for ss in batch.scope_spans:
+            spans = sorted(ss.spans, key=lambda s: s.start_time_unix_nano)
+            del ss.spans[:]
+            ss.spans.extend(spans)
+    return trace

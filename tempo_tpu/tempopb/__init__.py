@@ -1,0 +1,43 @@
+"""Wire model: protoc-generated OTLP-compatible trace protos + service
+messages (sources in /root/repo/protos, regenerate with protos/gen.sh).
+
+Role-equivalent to the reference's pkg/tempopb (gogo-proto generated types,
+tempo.proto services) — see SURVEY.md §2.4.
+"""
+
+from . import trace_pb2
+from . import tempo_pb2
+
+Trace = tempo_pb2.Trace
+PushBytesRequest = tempo_pb2.PushBytesRequest
+PushResponse = tempo_pb2.PushResponse
+TraceByIDRequest = tempo_pb2.TraceByIDRequest
+TraceByIDResponse = tempo_pb2.TraceByIDResponse
+TraceByIDMetrics = tempo_pb2.TraceByIDMetrics
+SearchRequest = tempo_pb2.SearchRequest
+SearchBlockRequest = tempo_pb2.SearchBlockRequest
+SearchResponse = tempo_pb2.SearchResponse
+TraceSearchMetadata = tempo_pb2.TraceSearchMetadata
+SearchMetrics = tempo_pb2.SearchMetrics
+SearchTagsRequest = tempo_pb2.SearchTagsRequest
+SearchTagsResponse = tempo_pb2.SearchTagsResponse
+SearchTagValuesRequest = tempo_pb2.SearchTagValuesRequest
+SearchTagValuesResponse = tempo_pb2.SearchTagValuesResponse
+
+ResourceSpans = trace_pb2.ResourceSpans
+ScopeSpans = trace_pb2.ScopeSpans
+Span = trace_pb2.Span
+Status = trace_pb2.Status
+Resource = trace_pb2.Resource
+KeyValue = trace_pb2.KeyValue
+AnyValue = trace_pb2.AnyValue
+
+__all__ = [
+    "Trace", "PushBytesRequest", "PushResponse", "TraceByIDRequest",
+    "TraceByIDResponse", "TraceByIDMetrics", "SearchRequest",
+    "SearchBlockRequest", "SearchResponse", "TraceSearchMetadata",
+    "SearchMetrics", "SearchTagsRequest", "SearchTagsResponse",
+    "SearchTagValuesRequest", "SearchTagValuesResponse",
+    "ResourceSpans", "ScopeSpans", "Span", "Status", "Resource",
+    "KeyValue", "AnyValue", "trace_pb2", "tempo_pb2",
+]

@@ -1,0 +1,22 @@
+from .hashing import token_for, token_for_trace_id, fnv1a_32, fnv1a_64
+from .ids import (
+    trace_id_to_hex,
+    hex_to_trace_id,
+    random_trace_id,
+    random_span_id,
+    pad_trace_id,
+    validate_trace_id,
+)
+
+__all__ = [
+    "token_for",
+    "token_for_trace_id",
+    "fnv1a_32",
+    "fnv1a_64",
+    "trace_id_to_hex",
+    "hex_to_trace_id",
+    "random_trace_id",
+    "random_span_id",
+    "pad_trace_id",
+    "validate_trace_id",
+]

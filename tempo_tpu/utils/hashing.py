@@ -1,0 +1,62 @@
+"""Ring-token and shard hashing.
+
+Equivalent roles to the reference's pkg/util/hash.go:7-16 (fnv1a token used
+to place a (tenant, traceID) on the distributor ring) and the fnv32 bloom
+shard key (tempodb/encoding/common/bloom.go). Implemented here as pure
+functions over bytes; a vectorized numpy variant is provided for bulk
+sharding on the ingest path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_FNV1A_32_OFFSET = 0x811C9DC5
+_FNV1A_32_PRIME = 0x01000193
+_FNV1A_64_OFFSET = 0xCBF29CE484222325
+_FNV1A_64_PRIME = 0x100000001B3
+_MASK32 = 0xFFFFFFFF
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a_32(data: bytes, seed: int = _FNV1A_32_OFFSET) -> int:
+    h = seed
+    for b in data:
+        h ^= b
+        h = (h * _FNV1A_32_PRIME) & _MASK32
+    return h
+
+
+def fnv1a_64(data: bytes, seed: int = _FNV1A_64_OFFSET) -> int:
+    h = seed
+    for b in data:
+        h ^= b
+        h = (h * _FNV1A_64_PRIME) & _MASK64
+    return h
+
+
+def token_for(tenant: str, trace_id: bytes) -> int:
+    """Ring token for a (tenant, trace id) pair — 32-bit fnv1a over the
+    tenant bytes then the trace id bytes, matching the placement role of
+    the reference's util.TokenFor."""
+    return fnv1a_32(trace_id, seed=fnv1a_32(tenant.encode("utf-8")))
+
+
+def token_for_trace_id(trace_id: bytes) -> int:
+    return fnv1a_32(trace_id)
+
+
+def fnv1a_32_batch(ids: np.ndarray) -> np.ndarray:
+    """Vectorized fnv1a-32 over a [N, L] uint8 array of fixed-length keys.
+
+    Used for bulk bloom-shard assignment when building blocks: one pass per
+    byte position, vectorized over N keys.
+    """
+    assert ids.dtype == np.uint8 and ids.ndim == 2
+    h = np.full(ids.shape[0], _FNV1A_32_OFFSET, dtype=np.uint64)
+    prime = np.uint64(_FNV1A_32_PRIME)
+    mask = np.uint64(_MASK32)
+    for col in range(ids.shape[1]):
+        h ^= ids[:, col].astype(np.uint64)
+        h = (h * prime) & mask
+    return h.astype(np.uint32)

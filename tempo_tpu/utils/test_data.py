@@ -1,0 +1,67 @@
+"""Deterministic trace fabricators for tests and benchmarks.
+
+Role-equivalent to the reference's pkg/util/test/req.go:14-50 (MakeSpan /
+MakeBatch / MakeTrace) and pkg/util.TraceInfo (deterministic regeneration
+from a seed, shared by vulture and e2e so readers can verify content
+without a side channel).
+"""
+
+from __future__ import annotations
+
+import random
+
+from tempo_tpu import tempopb
+from tempo_tpu.utils.ids import random_span_id
+
+_SERVICES = [
+    "frontend", "checkout", "cart", "payments", "shipping",
+    "inventory", "auth", "search", "recs", "gateway",
+]
+_OPS = ["GET /", "POST /api", "db.query", "cache.get", "publish", "consume"]
+
+
+def make_span(rng: random.Random, trace_id: bytes,
+              start_ns: int | None = None, dur_ns: int | None = None) -> tempopb.Span:
+    s = tempopb.Span()
+    s.trace_id = trace_id
+    s.span_id = rng.randbytes(8)
+    s.name = rng.choice(_OPS)
+    s.kind = rng.randint(1, 5)
+    # spans of one trace cluster around a common epoch so durations are sane
+    s.start_time_unix_nano = (
+        start_ns if start_ns is not None
+        else 1_600_000_000_000_000_000 + rng.randint(0, 3_600_000_000_000)
+    )
+    s.end_time_unix_nano = s.start_time_unix_nano + (
+        dur_ns if dur_ns is not None else rng.randint(1_000_000, 2_000_000_000)
+    )
+    kv = s.attributes.add()
+    kv.key = "http.status_code"
+    kv.value.int_value = rng.choice([200, 200, 200, 404, 500])
+    kv = s.attributes.add()
+    kv.key = "component"
+    kv.value.string_value = rng.choice(["grpc", "http", "db"])
+    return s
+
+
+def make_batch(rng: random.Random, trace_id: bytes, spans: int = 2,
+               service: str | None = None) -> tempopb.ResourceSpans:
+    rs = tempopb.ResourceSpans()
+    kv = rs.resource.attributes.add()
+    kv.key = "service.name"
+    kv.value.string_value = service or rng.choice(_SERVICES)
+    ss = rs.scope_spans.add()
+    ss.scope.name = "tempo-tpu-test"
+    for _ in range(spans):
+        ss.spans.append(make_span(rng, trace_id))
+    return rs
+
+
+def make_trace(trace_id: bytes, seed: int | None = None, batches: int = 2,
+               spans_per_batch: int = 2) -> tempopb.Trace:
+    """Deterministic for a given (trace_id, seed)."""
+    rng = random.Random(seed if seed is not None else trace_id)
+    t = tempopb.Trace()
+    for _ in range(batches):
+        t.batches.append(make_batch(rng, trace_id, spans=spans_per_batch))
+    return t

@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device virtual CPU platform before jax loads.
+
+Multi-chip TPU hardware is not available in CI; all sharding/parallelism
+tests run over a virtual 8-device CPU mesh, exactly as the driver's
+dryrun_multichip does. This must run before any jax import anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_backend_dir(tmp_path):
+    d = tmp_path / "backend"
+    d.mkdir()
+    return str(d)
+
+
+@pytest.fixture
+def tmp_wal_dir(tmp_path):
+    d = tmp_path / "wal"
+    d.mkdir()
+    return str(d)

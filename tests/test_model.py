@@ -1,0 +1,176 @@
+import random
+
+from tempo_tpu import tempopb
+from tempo_tpu.model import (
+    codec_for,
+    segment_codec_for,
+    combine_trace_protos,
+    matches,
+    trace_search_metadata,
+)
+from tempo_tpu.utils import token_for, trace_id_to_hex, hex_to_trace_id, random_trace_id
+from tempo_tpu.utils.hashing import fnv1a_32, fnv1a_32_batch
+from tempo_tpu.utils.test_data import make_trace
+
+import numpy as np
+
+
+def test_fnv1a_known_vectors():
+    # standard fnv1a-32 test vectors
+    assert fnv1a_32(b"") == 0x811C9DC5
+    assert fnv1a_32(b"a") == 0xE40C292C
+    assert fnv1a_32(b"foobar") == 0xBF9CF968
+
+
+def test_fnv1a_batch_matches_scalar():
+    ids = np.frombuffer(b"".join(bytes([i] * 16) for i in range(32)), dtype=np.uint8)
+    ids = ids.reshape(32, 16)
+    batch = fnv1a_32_batch(ids)
+    for i in range(32):
+        assert batch[i] == fnv1a_32(bytes(ids[i]))
+
+
+def test_token_for_deterministic():
+    tid = b"\x01" * 16
+    assert token_for("t1", tid) == token_for("t1", tid)
+    assert token_for("t1", tid) != token_for("t2", tid)
+
+
+def test_trace_id_hex_roundtrip():
+    tid = random_trace_id()
+    assert hex_to_trace_id(trace_id_to_hex(tid)) == tid
+    # short ids are left-padded
+    assert hex_to_trace_id("abcd") == b"\x00" * 14 + b"\xab\xcd"
+
+
+def test_codec_v2_roundtrip_and_fastrange():
+    tid = random_trace_id()
+    tr = make_trace(tid, seed=7)
+    c = codec_for("v2")
+    obj = c.marshal(tr, start=100, end=200)
+    assert c.fast_range(obj) == (100, 200)
+    got = c.prepare_for_read(obj)
+    assert got == tr
+
+
+def test_codec_v1_roundtrip():
+    tid = random_trace_id()
+    tr = make_trace(tid, seed=3)
+    c = codec_for("v1")
+    obj = c.marshal(tr)
+    assert c.fast_range(obj) is None
+    assert c.prepare_for_read(obj) == tr
+
+
+def test_segment_codec_combines_ranges():
+    tid = random_trace_id()
+    sc = segment_codec_for("v2")
+    t1, t2 = make_trace(tid, seed=1, batches=1), make_trace(tid, seed=2, batches=1)
+    s1 = sc.prepare_for_write(t1, 10, 20)
+    s2 = sc.prepare_for_write(t2, 5, 15)
+    obj = sc.to_object([s1, s2])
+    assert codec_for("v2").fast_range(obj) == (5, 20)
+    got = codec_for("v2").prepare_for_read(obj)
+    assert len(got.batches) == 2
+
+
+def test_combine_dedupes_spans():
+    tid = random_trace_id()
+    tr = make_trace(tid, seed=5)
+    merged = combine_trace_protos([tr, tr])
+    n_spans = sum(len(ss.spans) for b in merged.batches for ss in b.scope_spans)
+    orig = sum(len(ss.spans) for b in tr.batches for ss in b.scope_spans)
+    assert n_spans == orig
+
+
+def test_combine_merges_distinct():
+    tid = random_trace_id()
+    t1 = make_trace(tid, seed=1, batches=1, spans_per_batch=1)
+    t2 = make_trace(tid, seed=2, batches=1, spans_per_batch=1)
+    merged = combine_trace_protos([t1, t2])
+    n_spans = sum(len(ss.spans) for b in merged.batches for ss in b.scope_spans)
+    assert n_spans == 2
+
+
+def _mk_req(**kw):
+    req = tempopb.SearchRequest()
+    for k, v in kw.pop("tags", {}).items():
+        req.tags[k] = v
+    for k, v in kw.items():
+        setattr(req, k, v)
+    return req
+
+
+def test_matches_tag_substring():
+    tid = random_trace_id()
+    tr = tempopb.Trace()
+    b = tr.batches.add()
+    kv = b.resource.attributes.add()
+    kv.key = "service.name"
+    kv.value.string_value = "checkout-service"
+    s = b.scope_spans.add().spans.add()
+    s.start_time_unix_nano = 1_000_000_000
+    s.end_time_unix_nano = 3_000_000_000
+
+    assert matches(tr, _mk_req(tags={"service.name": "checkout"}))
+    assert matches(tr, _mk_req(tags={"service.name": "checkout-service"}))
+    assert not matches(tr, _mk_req(tags={"service.name": "cart"}))
+    assert not matches(tr, _mk_req(tags={"other.key": "checkout"}))
+
+
+def test_matches_duration_and_window():
+    tid = random_trace_id()
+    tr = tempopb.Trace()
+    s = tr.batches.add().scope_spans.add().spans.add()
+    s.start_time_unix_nano = 10 * 10**9
+    s.end_time_unix_nano = 12 * 10**9  # 2000ms
+
+    assert matches(tr, _mk_req(min_duration_ms=1000))
+    assert not matches(tr, _mk_req(min_duration_ms=3000))
+    assert not matches(tr, _mk_req(max_duration_ms=1000))
+    assert matches(tr, _mk_req(start=5, end=20))
+    assert not matches(tr, _mk_req(start=13, end=20))
+    assert not matches(tr, _mk_req(start=1, end=9))
+
+
+def test_matches_int_attr():
+    tr = tempopb.Trace()
+    b = tr.batches.add()
+    s = b.scope_spans.add().spans.add()
+    kv = s.attributes.add()
+    kv.key = "http.status_code"
+    kv.value.int_value = 500
+    assert matches(tr, _mk_req(tags={"http.status_code": "500"}))
+    assert not matches(tr, _mk_req(tags={"http.status_code": "200"}))
+
+
+def test_search_metadata_root():
+    tid = random_trace_id()
+    tr = tempopb.Trace()
+    b = tr.batches.add()
+    kv = b.resource.attributes.add()
+    kv.key = "service.name"
+    kv.value.string_value = "frontend"
+    ss = b.scope_spans.add()
+    root = ss.spans.add()
+    root.name = "GET /"
+    root.span_id = b"\x01" * 8
+    root.start_time_unix_nano = 10**9
+    root.end_time_unix_nano = 2 * 10**9
+    child = ss.spans.add()
+    child.name = "db.query"
+    child.span_id = b"\x02" * 8
+    child.parent_span_id = root.span_id
+    child.start_time_unix_nano = int(1.1e9)
+    child.end_time_unix_nano = int(1.5e9)
+
+    m = trace_search_metadata(tid, tr)
+    assert m.root_trace_name == "GET /"
+    assert m.root_service_name == "frontend"
+    assert m.duration_ms == 1000
+    assert m.trace_id == tid.hex()
+
+
+def test_make_trace_deterministic():
+    tid = random_trace_id()
+    assert make_trace(tid, seed=42) == make_trace(tid, seed=42)
