@@ -1,0 +1,160 @@
+// Native host runtime for tempo-tpu: block codecs + hashing.
+//
+// Wraps the system libzstd / liblz4 / libsnappy — the role the reference
+// fills with vendored Go asm codec libraries (SURVEY.md §7 native mapping).
+// Exposed as a C ABI consumed via ctypes (tempo_tpu/ops/native.py).
+// All functions return the produced byte count, or a negative error code.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#include <zstd.h>
+
+// liblz4 / libsnappy ship no dev headers in this image; declare the stable
+// C ABIs directly and link against the versioned runtime libraries.
+extern "C" {
+int LZ4_compress_default(const char* src, char* dst, int srcSize, int dstCapacity);
+int LZ4_decompress_safe(const char* src, char* dst, int compressedSize, int dstCapacity);
+
+typedef enum {
+  SNAPPY_OK = 0,
+  SNAPPY_INVALID_INPUT = 1,
+  SNAPPY_BUFFER_TOO_SMALL = 2,
+} snappy_status;
+snappy_status snappy_compress(const char* input, size_t input_length,
+                              char* compressed, size_t* compressed_length);
+snappy_status snappy_uncompress(const char* compressed, size_t compressed_length,
+                                char* uncompressed, size_t* uncompressed_length);
+}
+
+extern "C" {
+
+long long tt_zstd_compress(const char* src, size_t src_len,
+                           char* dst, size_t dst_cap, int level) {
+  size_t n = ZSTD_compress(dst, dst_cap, src, src_len, level);
+  if (ZSTD_isError(n)) return -1;
+  return (long long)n;
+}
+
+long long tt_zstd_decompress(const char* src, size_t src_len,
+                             char* dst, size_t dst_cap) {
+  unsigned long long content = ZSTD_getFrameContentSize(src, src_len);
+  if (content != ZSTD_CONTENTSIZE_UNKNOWN &&
+      content != ZSTD_CONTENTSIZE_ERROR && content > dst_cap) {
+    return -2;  // caller must grow dst
+  }
+  size_t n = ZSTD_decompress(dst, dst_cap, src, src_len);
+  if (ZSTD_isError(n)) return -1;
+  return (long long)n;
+}
+
+long long tt_lz4_compress(const char* src, size_t src_len,
+                          char* dst, size_t dst_cap) {
+  int n = LZ4_compress_default(src, dst, (int)src_len, (int)dst_cap);
+  if (n <= 0) return -1;
+  return (long long)n;
+}
+
+long long tt_lz4_decompress(const char* src, size_t src_len,
+                            char* dst, size_t dst_cap) {
+  int n = LZ4_decompress_safe(src, dst, (int)src_len, (int)dst_cap);
+  if (n < 0) return -1;
+  return (long long)n;
+}
+
+long long tt_snappy_compress(const char* src, size_t src_len,
+                             char* dst, size_t dst_cap) {
+  size_t out_len = dst_cap;
+  if (snappy_compress(src, src_len, dst, &out_len) != SNAPPY_OK) return -1;
+  return (long long)out_len;
+}
+
+long long tt_snappy_decompress(const char* src, size_t src_len,
+                               char* dst, size_t dst_cap) {
+  size_t out_len = dst_cap;
+  if (snappy_uncompress(src, src_len, dst, &out_len) != SNAPPY_OK) return -1;
+  return (long long)out_len;
+}
+
+// xxhash64 (XXH64) — self-contained implementation so we do not depend on
+// a system libxxhash being present.
+static const uint64_t P1 = 11400714785074694791ULL;
+static const uint64_t P2 = 14029467366897019727ULL;
+static const uint64_t P3 = 1609587929392839161ULL;
+static const uint64_t P4 = 9650029242287828579ULL;
+static const uint64_t P5 = 2870177450012600261ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+static inline uint64_t read64(const char* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+static inline uint32_t read32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+static inline uint64_t round1(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = rotl64(acc, 31);
+  acc *= P1;
+  return acc;
+}
+static inline uint64_t merge_round(uint64_t acc, uint64_t val) {
+  val = round1(0, val);
+  acc ^= val;
+  acc = acc * P1 + P4;
+  return acc;
+}
+
+unsigned long long tt_xxhash64(const char* data, size_t len,
+                               unsigned long long seed) {
+  const char* p = data;
+  const char* end = data + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    const char* limit = end - 32;
+    do {
+      v1 = round1(v1, read64(p)); p += 8;
+      v2 = round1(v2, read64(p)); p += 8;
+      v3 = round1(v3, read64(p)); p += 8;
+      v4 = round1(v4, read64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = merge_round(h, v1);
+    h = merge_round(h, v2);
+    h = merge_round(h, v3);
+    h = merge_round(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    h ^= round1(0, read64(p));
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= (uint64_t)read32(p) * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (uint64_t)(uint8_t)(*p) * P5;
+    h = rotl64(h, 11) * P1;
+    p++;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+}  // extern "C"

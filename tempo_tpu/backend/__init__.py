@@ -1,0 +1,42 @@
+from .types import (
+    BlockMeta,
+    CompactedBlockMeta,
+    TenantIndex,
+    NAME_META,
+    NAME_COMPACTED_META,
+    NAME_DATA,
+    NAME_INDEX,
+    NAME_TENANT_INDEX,
+    bloom_name,
+)
+from .raw import RawBackend, BackendError, DoesNotExist
+from .local import LocalBackend
+from .mock import MockBackend
+
+__all__ = [
+    "BlockMeta", "CompactedBlockMeta", "TenantIndex",
+    "NAME_META", "NAME_COMPACTED_META", "NAME_DATA", "NAME_INDEX",
+    "NAME_TENANT_INDEX", "bloom_name",
+    "RawBackend", "BackendError", "DoesNotExist",
+    "LocalBackend", "MockBackend",
+]
+
+
+def open_backend(cfg: dict) -> RawBackend:
+    """Build a backend from config: {"backend": "local", "local": {"path": ...}}.
+
+    S3/GCS/Azure are config-gated here; their client implementations land
+    behind the same RawBackend interface (reference tempodb/backend/{s3,gcs,
+    azure}) and raise until enabled in this environment (zero egress).
+    """
+    kind = cfg.get("backend", "local")
+    if kind == "local":
+        return LocalBackend(cfg.get("local", {}).get("path", "./tempo-blocks"))
+    if kind == "memory":
+        return MockBackend()
+    if kind in ("s3", "gcs", "azure"):
+        raise NotImplementedError(
+            f"backend {kind!r} requires network egress; use 'local' here. "
+            "The RawBackend interface is the extension point."
+        )
+    raise ValueError(f"unknown backend {kind!r}")

@@ -1,0 +1,93 @@
+"""Filesystem backend: ``<path>/<tenant>/<block>/<name>``.
+
+Role-equivalent to the reference's tempodb/backend/local (also reused as
+the ingester-local store and the WAL /blocks dir). Writes are atomic via
+temp-file + rename so a crashed writer never leaves a torn meta.json.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from .raw import RawBackend, DoesNotExist
+
+
+class LocalBackend(RawBackend):
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _p(self, tenant: str, block_id: str | None, name: str = "") -> str:
+        parts = [self.path, tenant]
+        if block_id:
+            parts.append(block_id)
+        if name:
+            parts.append(name)
+        return os.path.join(*parts)
+
+    def write(self, tenant, block_id, name, data: bytes) -> None:
+        d = self._p(tenant, block_id)
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=f".{name}.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, os.path.join(d, name))
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def read(self, tenant, block_id, name) -> bytes:
+        try:
+            with open(self._p(tenant, block_id, name), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise DoesNotExist(f"{tenant}/{block_id}/{name}") from None
+
+    def read_range(self, tenant, block_id, name, offset: int, length: int) -> bytes:
+        try:
+            with open(self._p(tenant, block_id, name), "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        except FileNotFoundError:
+            raise DoesNotExist(f"{tenant}/{block_id}/{name}") from None
+
+    def delete(self, tenant, block_id, name) -> None:
+        try:
+            os.unlink(self._p(tenant, block_id, name))
+        except FileNotFoundError:
+            raise DoesNotExist(f"{tenant}/{block_id}/{name}") from None
+        # opportunistically remove empty block dirs
+        d = self._p(tenant, block_id)
+        try:
+            if block_id and not os.listdir(d):
+                os.rmdir(d)
+        except OSError:
+            pass
+
+    def list_tenants(self) -> list[str]:
+        try:
+            return sorted(
+                e for e in os.listdir(self.path)
+                if os.path.isdir(os.path.join(self.path, e))
+            )
+        except FileNotFoundError:
+            return []
+
+    def list_blocks(self, tenant: str) -> list[str]:
+        try:
+            base = self._p(tenant, None)
+            return sorted(
+                e for e in os.listdir(base)
+                if os.path.isdir(os.path.join(base, e))
+            )
+        except FileNotFoundError:
+            return []
+
+    def _block_objects(self, tenant: str, block_id: str) -> list[str]:
+        try:
+            return os.listdir(self._p(tenant, block_id))
+        except FileNotFoundError:
+            return []

@@ -1,0 +1,56 @@
+"""In-memory backend for tests (reference tempodb/backend/mocks.go)."""
+
+from __future__ import annotations
+
+import threading
+
+from .raw import RawBackend, DoesNotExist
+
+
+class MockBackend(RawBackend):
+    def __init__(self, fail_reads: bool = False):
+        self._objs: dict[tuple[str, str, str], bytes] = {}
+        self._lock = threading.Lock()
+        self.fail_reads = fail_reads
+        self.read_count = 0
+        self.write_count = 0
+
+    def _k(self, tenant, block_id, name):
+        return (tenant, block_id or "", name)
+
+    def write(self, tenant, block_id, name, data: bytes) -> None:
+        with self._lock:
+            self.write_count += 1
+            self._objs[self._k(tenant, block_id, name)] = bytes(data)
+
+    def read(self, tenant, block_id, name) -> bytes:
+        with self._lock:
+            self.read_count += 1
+            if self.fail_reads:
+                raise DoesNotExist("mock configured to fail")
+            try:
+                return self._objs[self._k(tenant, block_id, name)]
+            except KeyError:
+                raise DoesNotExist(f"{tenant}/{block_id}/{name}") from None
+
+    def read_range(self, tenant, block_id, name, offset, length) -> bytes:
+        return self.read(tenant, block_id, name)[offset:offset + length]
+
+    def delete(self, tenant, block_id, name) -> None:
+        with self._lock:
+            try:
+                del self._objs[self._k(tenant, block_id, name)]
+            except KeyError:
+                raise DoesNotExist(f"{tenant}/{block_id}/{name}") from None
+
+    def list_tenants(self) -> list[str]:
+        with self._lock:
+            return sorted({t for (t, _, _) in self._objs})
+
+    def list_blocks(self, tenant: str) -> list[str]:
+        with self._lock:
+            return sorted({b for (t, b, _) in self._objs if t == tenant and b})
+
+    def _block_objects(self, tenant, block_id) -> list[str]:
+        with self._lock:
+            return [n for (t, b, n) in self._objs if t == tenant and b == block_id]

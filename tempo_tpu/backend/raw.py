@@ -1,0 +1,102 @@
+"""Object-storage backend interface.
+
+Role-equivalent to the reference's tempodb/backend/raw.go:26-45 RawReader /
+RawWriter / Compactor triple, collapsed into one ABC (implementations are
+local filesystem, in-memory mock; S3/GCS/Azure slot in behind the same
+interface). Keypath layout: ``<tenant>/<block_id>/<name>`` with tenant-level
+objects at ``<tenant>/<name>``.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable
+
+from .types import (
+    BlockMeta,
+    CompactedBlockMeta,
+    NAME_META,
+    NAME_COMPACTED_META,
+)
+
+
+class BackendError(Exception):
+    pass
+
+
+class DoesNotExist(BackendError):
+    pass
+
+
+class RawBackend(abc.ABC):
+    # ---- raw object ops ----
+
+    @abc.abstractmethod
+    def write(self, tenant: str, block_id: str | None, name: str, data: bytes) -> None:
+        """Write an object atomically (block_id None → tenant-level object)."""
+
+    @abc.abstractmethod
+    def read(self, tenant: str, block_id: str | None, name: str) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def read_range(self, tenant: str, block_id: str | None, name: str,
+                   offset: int, length: int) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def delete(self, tenant: str, block_id: str | None, name: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def list_tenants(self) -> list[str]:
+        ...
+
+    @abc.abstractmethod
+    def list_blocks(self, tenant: str) -> list[str]:
+        ...
+
+    # ---- meta helpers (reference backend.go:21-64) ----
+
+    def write_block_meta(self, meta: BlockMeta) -> None:
+        self.write(meta.tenant_id, meta.block_id, NAME_META, meta.to_json())
+
+    def read_block_meta(self, tenant: str, block_id: str) -> BlockMeta:
+        return BlockMeta.from_json(self.read(tenant, block_id, NAME_META))
+
+    def write_compacted_meta(self, cm: CompactedBlockMeta) -> None:
+        self.write(cm.meta.tenant_id, cm.meta.block_id, NAME_COMPACTED_META, cm.to_json())
+
+    def read_compacted_meta(self, tenant: str, block_id: str) -> CompactedBlockMeta:
+        return CompactedBlockMeta.from_json(
+            self.read(tenant, block_id, NAME_COMPACTED_META)
+        )
+
+    # ---- compactor ops (reference backend Compactor iface) ----
+
+    def mark_compacted(self, meta: BlockMeta) -> None:
+        """Flip a block to compacted: write the compacted marker, remove the
+        live meta so pollers stop listing it."""
+        self.write_compacted_meta(CompactedBlockMeta.from_meta(meta))
+        try:
+            self.delete(meta.tenant_id, meta.block_id, NAME_META)
+        except DoesNotExist:
+            pass
+
+    def clear_block(self, tenant: str, block_id: str,
+                    names: Iterable[str] | None = None) -> None:
+        """Hard-delete a block's objects (retention second phase)."""
+        for name in list(names) if names is not None else self._block_objects(tenant, block_id):
+            try:
+                self.delete(tenant, block_id, name)
+            except DoesNotExist:
+                pass
+
+    def _block_objects(self, tenant: str, block_id: str) -> list[str]:
+        """Names of the objects in a block; backends that can list within a
+        block override this. Default covers the standard layout."""
+        from .types import NAME_DATA, NAME_INDEX, NAME_SEARCH, NAME_SEARCH_HEADER, bloom_name
+        names = [NAME_META, NAME_COMPACTED_META, NAME_DATA, NAME_INDEX,
+                 NAME_SEARCH, NAME_SEARCH_HEADER]
+        names += [bloom_name(i) for i in range(64)]
+        return names

@@ -1,0 +1,136 @@
+"""Block metadata and object naming.
+
+Role-equivalent to the reference's tempodb/backend/block_meta.go and
+tenant index (blocklist/poller writes index.json.gz). The only durable,
+shared state in the whole system is object storage; meta.json written last
+is the commit record for a block (SURVEY.md §1 invariant, §5 checkpoint).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import time
+import uuid
+from dataclasses import dataclass, field, asdict
+
+VERSION_VT1 = "vT1"
+
+NAME_META = "meta.json"
+NAME_COMPACTED_META = "meta.compacted.json"
+NAME_DATA = "data"
+NAME_INDEX = "index"
+NAME_TENANT_INDEX = "index.json.gz"
+
+# columnar search block objects (tempo_tpu.search)
+NAME_SEARCH = "search"
+NAME_SEARCH_HEADER = "search-header.json"
+
+
+def bloom_name(shard: int) -> str:
+    return f"bloom-{shard}"
+
+
+def new_block_id() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass
+class BlockMeta:
+    version: str = VERSION_VT1
+    block_id: str = ""
+    tenant_id: str = ""
+    start_time: int = 0  # unix seconds, min over objects
+    end_time: int = 0    # unix seconds, max over objects
+    total_objects: int = 0
+    size: int = 0        # bytes of the data object
+    compaction_level: int = 0
+    encoding: str = "zstd"        # page compression
+    index_page_size: int = 0      # records per index page
+    total_records: int = 0
+    data_encoding: str = "v2"     # trace object codec
+    bloom_shard_count: int = 0
+    bloom_shard_size_bytes: int = 0
+    min_id: str = ""  # hex, lowest object id in block
+    max_id: str = ""  # hex, highest object id in block
+
+    def __post_init__(self):
+        if not self.block_id:
+            self.block_id = new_block_id()
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self), sort_keys=True).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "BlockMeta":
+        d = json.loads(data)
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+    def extend_range(self, start: int, end: int) -> None:
+        if start:
+            self.start_time = min(self.start_time or start, start)
+        if end:
+            self.end_time = max(self.end_time, end)
+
+
+@dataclass
+class CompactedBlockMeta:
+    meta: BlockMeta = field(default_factory=BlockMeta)
+    compacted_time: int = 0  # unix seconds
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {"meta": asdict(self.meta), "compacted_time": self.compacted_time},
+            sort_keys=True,
+        ).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "CompactedBlockMeta":
+        d = json.loads(data)
+        return cls(meta=BlockMeta(**{
+            k: v for k, v in d["meta"].items()
+            if k in BlockMeta.__dataclass_fields__
+        }), compacted_time=d.get("compacted_time", 0))
+
+    @classmethod
+    def from_meta(cls, meta: BlockMeta) -> "CompactedBlockMeta":
+        return cls(meta=meta, compacted_time=int(time.time()))
+
+
+@dataclass
+class TenantIndex:
+    """Gzipped per-tenant listing of block metas, written by the elected
+    poller so other instances can skip the per-block meta fetches
+    (reference blocklist/poller.go:134-177)."""
+
+    created_at: int = 0
+    metas: list = field(default_factory=list)            # list[BlockMeta]
+    compacted: list = field(default_factory=list)        # list[CompactedBlockMeta]
+
+    def to_bytes(self) -> bytes:
+        doc = {
+            "created_at": self.created_at or int(time.time()),
+            "metas": [asdict(m) for m in self.metas],
+            "compacted": [
+                {"meta": asdict(c.meta), "compacted_time": c.compacted_time}
+                for c in self.compacted
+            ],
+        }
+        return gzip.compress(json.dumps(doc).encode())
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "TenantIndex":
+        d = json.loads(gzip.decompress(data))
+        return cls(
+            created_at=d.get("created_at", 0),
+            metas=[BlockMeta(**{
+                k: v for k, v in m.items() if k in BlockMeta.__dataclass_fields__
+            }) for m in d.get("metas", [])],
+            compacted=[CompactedBlockMeta(
+                meta=BlockMeta(**{
+                    k: v for k, v in c["meta"].items()
+                    if k in BlockMeta.__dataclass_fields__
+                }),
+                compacted_time=c.get("compacted_time", 0),
+            ) for c in d.get("compacted", [])],
+        )

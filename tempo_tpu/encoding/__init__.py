@@ -1,0 +1,17 @@
+"""Immutable block encodings.
+
+One versioned encoding today: vT1 (tempo_tpu.encoding.v2) — pages of
+length-framed objects with per-page compression, a binary-searchable
+downsampled index of 28-byte records, and sharded bloom filters; the same
+page machinery also carries the columnar search data (tempo_tpu.search).
+
+Role-equivalent to the reference's tempodb/encoding (VersionedEncoding,
+versioned.go:15-27).
+"""
+
+from tempo_tpu.encoding.v2.streaming_block import StreamingBlock
+from tempo_tpu.encoding.v2.backend_block import BackendBlock
+
+SUPPORTED_VERSIONS = ("vT1",)
+
+__all__ = ["StreamingBlock", "BackendBlock", "SUPPORTED_VERSIONS"]

@@ -1,0 +1,13 @@
+from .compression import compress, decompress, SUPPORTED_ENCODINGS
+from .objects import marshal_object, unmarshal_objects, ObjectFramingError
+from .index import Record, RECORD_LEN, IndexWriter, IndexReader
+from .bloom import ShardedBloom
+from .streaming_block import StreamingBlock
+from .backend_block import BackendBlock
+
+__all__ = [
+    "compress", "decompress", "SUPPORTED_ENCODINGS",
+    "marshal_object", "unmarshal_objects", "ObjectFramingError",
+    "Record", "RECORD_LEN", "IndexWriter", "IndexReader",
+    "ShardedBloom", "StreamingBlock", "BackendBlock",
+]

@@ -1,0 +1,71 @@
+"""Per-page compression codecs.
+
+Role-equivalent to the reference's tempodb/encoding/v2/pool.go:36-93
+(gzip/lz4/snappy/zstd/s2/none via vendored Go asm libs). Here the fast
+codecs ride the native C++ runtime (tempo_tpu.ops.native wrapping system
+libzstd/liblz4/libsnappy); `zstd` also has a pure-python wheel fallback
+(zstandard) and gzip/zlib/none always work, so the format is readable even
+without the native build.
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+import zlib as _zlib
+
+try:
+    import zstandard as _zstd
+except ImportError:  # pragma: no cover
+    _zstd = None
+
+SUPPORTED_ENCODINGS = ("none", "gzip", "zlib", "zstd", "lz4", "snappy")
+
+
+def _native():
+    from tempo_tpu.ops import native
+
+    return native if native.available() else None
+
+
+def compress(data: bytes, encoding: str, level: int = 3) -> bytes:
+    if encoding == "none":
+        return data
+    if encoding == "gzip":
+        return _gzip.compress(data, compresslevel=min(level + 3, 9))
+    if encoding == "zlib":
+        return _zlib.compress(data, level + 3)
+    if encoding == "zstd":
+        n = _native()
+        if n is not None:
+            return n.zstd_compress(data, level)
+        if _zstd is None:
+            raise RuntimeError("zstd unavailable: no native lib and no zstandard wheel")
+        return _zstd.ZstdCompressor(level=level).compress(data)
+    if encoding in ("lz4", "snappy"):
+        n = _native()
+        if n is None:
+            raise RuntimeError(f"{encoding} requires the native runtime (make -C native)")
+        return n.lz4_compress(data) if encoding == "lz4" else n.snappy_compress(data)
+    raise ValueError(f"unknown encoding {encoding!r}")
+
+
+def decompress(data: bytes, encoding: str) -> bytes:
+    if encoding == "none":
+        return data
+    if encoding == "gzip":
+        return _gzip.decompress(data)
+    if encoding == "zlib":
+        return _zlib.decompress(data)
+    if encoding == "zstd":
+        n = _native()
+        if n is not None:
+            return n.zstd_decompress(data)
+        if _zstd is None:
+            raise RuntimeError("zstd unavailable: no native lib and no zstandard wheel")
+        return _zstd.ZstdDecompressor().decompress(data)
+    if encoding in ("lz4", "snappy"):
+        n = _native()
+        if n is None:
+            raise RuntimeError(f"{encoding} requires the native runtime (make -C native)")
+        return n.lz4_decompress(data) if encoding == "lz4" else n.snappy_decompress(data)
+    raise ValueError(f"unknown encoding {encoding!r}")

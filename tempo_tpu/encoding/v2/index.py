@@ -1,0 +1,127 @@
+"""Block index: fixed 28-byte records in checksummed pages.
+
+Record = ``| 16B max_id | u64 start | u32 len |`` — one per data page,
+where max_id is the highest object id in that page (the index is
+downsampled: many objects per record). Index pages carry an xxhash64
+checksum so torn reads are detected (reference: record.go:13,64-84,
+index_writer.go, index_reader.go:42-143 with xxhash check :134-137).
+
+Lookup: binary search for the first record with max_id >= target, fetch
+that data page, scan. Implemented over numpy so a whole index column loads
+as one array.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+import xxhash
+
+RECORD_LEN = 28
+_PAGE_HDR = struct.Struct("<IQ")  # record_count, xxhash64 of records
+
+
+class IndexCorruptError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Record:
+    max_id: bytes  # 16 bytes
+    start: int     # byte offset of the data page
+    length: int    # byte length of the data page
+
+    def pack(self) -> bytes:
+        mid = self.max_id.rjust(16, b"\x00")[-16:]
+        return mid + struct.pack("<QI", self.start, self.length)
+
+    @classmethod
+    def unpack(cls, buf: bytes, off: int = 0) -> "Record":
+        mid = bytes(buf[off:off + 16])
+        start, length = struct.unpack_from("<QI", buf, off + 16)
+        return cls(mid, start, length)
+
+
+class IndexWriter:
+    """Accumulates records, emits pages of `page_size` records each,
+    checksummed."""
+
+    def __init__(self, records_per_page: int = 1024):
+        self.records_per_page = max(1, records_per_page)
+
+    def write(self, records: list[Record]) -> bytes:
+        out = bytearray()
+        for i in range(0, len(records), self.records_per_page):
+            chunk = records[i:i + self.records_per_page]
+            body = b"".join(r.pack() for r in chunk)
+            out += _PAGE_HDR.pack(len(chunk), xxhash.xxh64_intdigest(body))
+            out += body
+        return bytes(out)
+
+
+class IndexReader:
+    """Parses the whole index object into columnar numpy arrays and binary
+    searches them. Index objects are small (28B per data page) so eager
+    parse is the right trade."""
+
+    def __init__(self, data: bytes):
+        ids = []
+        starts = []
+        lengths = []
+        off, n = 0, len(data)
+        while off < n:
+            if off + _PAGE_HDR.size > n:
+                raise IndexCorruptError("truncated index page header")
+            count, checksum = _PAGE_HDR.unpack_from(data, off)
+            off += _PAGE_HDR.size
+            body = data[off:off + count * RECORD_LEN]
+            if len(body) != count * RECORD_LEN:
+                raise IndexCorruptError("truncated index page body")
+            if xxhash.xxh64_intdigest(body) != checksum:
+                raise IndexCorruptError("index page checksum mismatch")
+            arr = np.frombuffer(body, dtype=np.uint8).reshape(count, RECORD_LEN)
+            ids.append(arr[:, :16])
+            tail = np.ascontiguousarray(arr[:, 16:])
+            starts.append(tail[:, :8].copy().view("<u8").reshape(-1))
+            lengths.append(tail[:, 8:12].copy().view("<u4").reshape(-1))
+            off += count * RECORD_LEN
+        if ids:
+            self.ids = np.concatenate(ids)          # [N,16] u8
+            self.starts = np.concatenate(starts)    # [N] u64
+            self.lengths = np.concatenate(lengths)  # [N] u32
+        else:
+            self.ids = np.zeros((0, 16), dtype=np.uint8)
+            self.starts = np.zeros(0, dtype=np.uint64)
+            self.lengths = np.zeros(0, dtype=np.uint32)
+        # big-endian-comparable packed ids for searchsorted: 16B big-endian
+        # bytes compare like two u64 lexicographic keys
+        self._hi = self.ids[:, :8].copy().view(">u8").reshape(-1).astype(np.uint64)
+        self._lo = self.ids[:, 8:].copy().view(">u8").reshape(-1).astype(np.uint64)
+
+    def __len__(self) -> int:
+        return len(self.starts)
+
+    def record(self, i: int) -> Record:
+        return Record(bytes(self.ids[i]), int(self.starts[i]), int(self.lengths[i]))
+
+    def find_index(self, obj_id: bytes) -> int | None:
+        """Position of the first record whose max_id >= obj_id, i.e. the only
+        data page that can contain obj_id."""
+        if len(self) == 0:
+            return None
+        key = obj_id.rjust(16, b"\x00")[-16:]
+        hi = int.from_bytes(key[:8], "big")
+        lo = int.from_bytes(key[8:], "big")
+        # lexicographic (hi, lo) search over sorted max_ids
+        i = int(np.searchsorted(self._hi, hi, side="left"))
+        while i < len(self) and self._hi[i] == hi and self._lo[i] < lo:
+            i += 1
+        if i >= len(self):
+            return None
+        return i
+
+    def find(self, obj_id: bytes) -> Record | None:
+        i = self.find_index(obj_id)
+        return None if i is None else self.record(i)

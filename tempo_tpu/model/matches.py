@@ -88,9 +88,10 @@ def trace_search_metadata(trace_id: bytes, trace: tempopb.Trace) -> tempopb.Trac
     start_ns, end_ns = trace_range_ns(trace)
     m.start_time_unix_nano = start_ns if start_ns < 2**63 else 0
     m.duration_ms = min(max(0, (end_ns - start_ns)) // 1_000_000, 0xFFFFFFFF)
-    # root span: no parent
-    root = None
-    root_service = ""
+    # one pass tracking both the best parentless span and the earliest span
+    # (fallback when the root was dropped/sampled away)
+    root, root_service = None, ""
+    earliest, earliest_service = None, ""
     for batch in trace.batches:
         svc = ""
         for kv in batch.resource.attributes:
@@ -98,22 +99,15 @@ def trace_search_metadata(trace_id: bytes, trace: tempopb.Trace) -> tempopb.Trac
                 svc = kv.value.string_value
         for ss in batch.scope_spans:
             for span in ss.spans:
+                t = span.start_time_unix_nano
                 if not span.parent_span_id and (
-                    root is None or span.start_time_unix_nano < root.start_time_unix_nano
+                    root is None or t < root.start_time_unix_nano
                 ):
-                    root = span
-                    root_service = svc
-    if root is None:  # fall back to earliest span
-        for batch in trace.batches:
-            svc = ""
-            for kv in batch.resource.attributes:
-                if kv.key == "service.name":
-                    svc = kv.value.string_value
-            for ss in batch.scope_spans:
-                for span in ss.spans:
-                    if root is None or span.start_time_unix_nano < root.start_time_unix_nano:
-                        root = span
-                        root_service = svc
+                    root, root_service = span, svc
+                if earliest is None or t < earliest.start_time_unix_nano:
+                    earliest, earliest_service = span, svc
+    if root is None:
+        root, root_service = earliest, earliest_service
     if root is not None:
         m.root_trace_name = root.name
         m.root_service_name = root_service

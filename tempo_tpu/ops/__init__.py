@@ -1,0 +1,7 @@
+"""Kernels and native host runtime.
+
+- `native`: ctypes bindings to the C++ host runtime (codecs, hashing) —
+  the counterpart of the reference's vendored Go asm codec libraries.
+- JAX/Pallas device kernels used by the search engine live alongside
+  (see tempo_tpu.search.engine).
+"""

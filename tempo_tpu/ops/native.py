@@ -1,0 +1,119 @@
+"""ctypes bindings for the native C++ host runtime (native/libtempotpu.so).
+
+The runtime wraps system libzstd/liblz4/libsnappy block codecs — the
+role the reference fills with vendored Go asm codec libraries (klauspost
+zstd/s2/snappy, pierrec lz4 — SURVEY.md §7 native mapping). Build with
+``make -C native`` (see native/Makefile); everything degrades gracefully
+to pure-python paths when the .so is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+_LIB = None
+_TRIED = False
+
+_SO_PATHS = [
+    os.path.join(os.path.dirname(__file__), "..", "..", "native", "libtempotpu.so"),
+    os.path.join(os.path.dirname(__file__), "libtempotpu.so"),
+]
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    for p in _SO_PATHS:
+        p = os.path.abspath(p)
+        if os.path.exists(p):
+            try:
+                lib = ctypes.CDLL(p)
+                _bind(lib)
+                _LIB = lib
+                break
+            except OSError:
+                continue
+    return _LIB
+
+
+def _bind(lib):
+    for name in ("tt_zstd_compress", "tt_zstd_decompress",
+                 "tt_lz4_compress", "tt_lz4_decompress",
+                 "tt_snappy_compress", "tt_snappy_decompress"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                       ctypes.c_char_p, ctypes.c_size_t]
+    lib.tt_zstd_compress.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                     ctypes.c_char_p, ctypes.c_size_t,
+                                     ctypes.c_int]
+    lib.tt_xxhash64.restype = ctypes.c_ulonglong
+    lib.tt_xxhash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_ulonglong]
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+_LEN_HDR = struct.Struct("<Q")  # uncompressed length prefix for lz4/snappy raw blocks
+
+
+class NativeBufferTooSmall(RuntimeError):
+    pass
+
+
+def _roundtrip(fn_name: str, data: bytes, bound: int, *extra) -> bytes:
+    lib = _load()
+    out = ctypes.create_string_buffer(bound)
+    n = getattr(lib, fn_name)(data, len(data), out, bound, *extra)
+    if n == -2:
+        raise NativeBufferTooSmall(fn_name)
+    if n < 0:
+        raise RuntimeError(f"{fn_name} failed ({n})")
+    return out.raw[:n]
+
+
+def zstd_compress(data: bytes, level: int = 3) -> bytes:
+    return _roundtrip("tt_zstd_compress", data, len(data) + (len(data) >> 6) + 1024, level)
+
+
+def zstd_decompress(data: bytes) -> bytes:
+    # zstd frames carry their content size; the native side returns -2 only
+    # when the frame declares a size larger than our bound — grow just then.
+    # -1 (corrupt input) fails immediately.
+    bound = max(1 << 16, len(data) * 32)
+    for _ in range(4):
+        try:
+            return _roundtrip("tt_zstd_decompress", data, bound)
+        except NativeBufferTooSmall:
+            bound *= 8
+    raise RuntimeError("zstd decompress failed: frame too large")
+
+
+def lz4_compress(data: bytes) -> bytes:
+    body = _roundtrip("tt_lz4_compress", data, len(data) + (len(data) // 255) + 64)
+    return _LEN_HDR.pack(len(data)) + body
+
+
+def lz4_decompress(data: bytes) -> bytes:
+    (n,) = _LEN_HDR.unpack_from(data)
+    return _roundtrip("tt_lz4_decompress", data[_LEN_HDR.size:], int(n))
+
+
+def snappy_compress(data: bytes) -> bytes:
+    body = _roundtrip("tt_snappy_compress", data, len(data) + (len(data) // 6) + 64)
+    return _LEN_HDR.pack(len(data)) + body
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    (n,) = _LEN_HDR.unpack_from(data)
+    return _roundtrip("tt_snappy_decompress", data[_LEN_HDR.size:], int(n))
+
+
+def xxhash64(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    return int(lib.tt_xxhash64(data, len(data), seed))

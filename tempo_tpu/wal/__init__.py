@@ -1,0 +1,3 @@
+from .wal import WAL, AppendBlock, parse_wal_filename
+
+__all__ = ["WAL", "AppendBlock", "parse_wal_filename"]

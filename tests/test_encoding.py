@@ -1,0 +1,185 @@
+import os
+import random
+
+import pytest
+
+from tempo_tpu.backend import BlockMeta, LocalBackend, MockBackend, DoesNotExist
+from tempo_tpu.backend.types import TenantIndex, CompactedBlockMeta
+from tempo_tpu.encoding.v2 import (
+    StreamingBlock,
+    BackendBlock,
+    ShardedBloom,
+    IndexWriter,
+    IndexReader,
+    Record,
+    compress,
+    decompress,
+)
+from tempo_tpu.encoding.v2.index import IndexCorruptError
+from tempo_tpu.encoding.v2.objects import (
+    marshal_object,
+    unmarshal_objects,
+    ObjectFramingError,
+)
+from tempo_tpu.ops import native
+
+
+ENCODINGS = ["none", "gzip", "zlib", "zstd"] + (
+    ["lz4", "snappy"] if native.available() else []
+)
+
+
+@pytest.mark.parametrize("enc", ENCODINGS)
+def test_compression_roundtrip(enc):
+    data = os.urandom(1000) + b"A" * 5000
+    assert decompress(compress(data, enc), enc) == data
+
+
+def test_object_framing_roundtrip():
+    objs = [(os.urandom(16), os.urandom(i * 7 + 1)) for i in range(20)]
+    buf = b"".join(marshal_object(i, d) for i, d in objs)
+    assert list(unmarshal_objects(buf)) == objs
+
+
+def test_object_framing_truncation():
+    buf = marshal_object(b"\x01" * 16, b"data") + b"\x00\x01"
+    with pytest.raises(ObjectFramingError):
+        list(unmarshal_objects(buf))
+    got = list(unmarshal_objects(buf, tolerate_truncation=True))
+    assert got == [(b"\x01" * 16, b"data")]
+
+
+def test_index_roundtrip_and_find():
+    recs = []
+    off = 0
+    for i in range(100):
+        mid = (i * 10 + 9).to_bytes(16, "big")  # max id of page i
+        recs.append(Record(mid, off, 100))
+        off += 100
+    data = IndexWriter(records_per_page=7).write(recs)
+    rd = IndexReader(data)
+    assert len(rd) == 100
+    # id 55 falls in page 5 (ids 50..59 -> max 59)
+    r = rd.find((55).to_bytes(16, "big"))
+    assert r.start == 500
+    # exact max id
+    r = rd.find((9).to_bytes(16, "big"))
+    assert r.start == 0
+    # beyond all
+    assert rd.find((2000).to_bytes(16, "big")) is None
+
+
+def test_index_checksum_detects_corruption():
+    recs = [Record(b"\x01" * 16, 0, 10)]
+    data = bytearray(IndexWriter().write(recs))
+    data[-1] ^= 0xFF
+    with pytest.raises(IndexCorruptError):
+        IndexReader(bytes(data))
+
+
+def test_bloom_membership():
+    b = ShardedBloom(shard_count=4, fp_rate=0.01, expected_per_shard=500)
+    ids = [os.urandom(16) for _ in range(1000)]
+    for i in ids:
+        b.add(i)
+    for i in ids:
+        assert b.test(i)
+    fp = sum(b.test(os.urandom(16)) for _ in range(2000))
+    assert fp < 2000 * 0.05  # generous bound on fp rate
+
+
+def test_bloom_marshalled_matches_inmemory():
+    b = ShardedBloom(shard_count=3, expected_per_shard=100)
+    ids = [os.urandom(16) for _ in range(200)]
+    for i in ids:
+        b.add(i)
+    shards = [b.marshal_shard(s) for s in range(3)]
+    for i in ids:
+        s = ShardedBloom.shard_for(i, 3)
+        assert ShardedBloom.test_marshalled(shards[s], i)
+
+
+@pytest.mark.parametrize("enc", ["none", "zstd"])
+def test_streaming_block_roundtrip(tmp_backend_dir, enc):
+    be = LocalBackend(tmp_backend_dir)
+    meta = BlockMeta(tenant_id="t1", encoding=enc)
+    sb = StreamingBlock(meta, page_size=2048)
+    rng = random.Random(1)
+    objs = sorted(
+        (rng.randbytes(16), rng.randbytes(rng.randint(50, 500)))
+        for _ in range(200)
+    )
+    for i, (oid, data) in enumerate(objs):
+        sb.add_object(oid, data, start=100 + i, end=200 + i)
+    out = sb.complete(be)
+    assert out.total_objects == 200
+    assert out.total_records > 1  # multiple pages
+    assert out.start_time == 100 and out.end_time == 399
+
+    bb = BackendBlock(be, be.read_block_meta("t1", out.block_id))
+    # every object findable
+    for oid, data in objs:
+        assert bb.find_by_id(oid) == data
+    # absent ids return None
+    for _ in range(50):
+        assert bb.find_by_id(rng.randbytes(16)) is None
+    # full iteration returns everything in order
+    got = list(bb.iter_objects())
+    assert [o for o, _ in got] == [o for o, _ in objs]
+    # page-range iteration covers a subset
+    part = list(bb.iter_objects(start_page=1, pages=2))
+    assert 0 < len(part) < 200
+
+
+def test_streaming_block_rejects_unsorted(tmp_backend_dir):
+    sb = StreamingBlock(BlockMeta(tenant_id="t1"))
+    sb.add_object(b"\x05" * 16, b"x")
+    with pytest.raises(ValueError):
+        sb.add_object(b"\x01" * 16, b"y")
+
+
+def test_block_meta_json_roundtrip():
+    m = BlockMeta(tenant_id="t9", encoding="zstd", total_objects=5)
+    m2 = BlockMeta.from_json(m.to_json())
+    assert m2 == m
+    cm = CompactedBlockMeta.from_meta(m)
+    cm2 = CompactedBlockMeta.from_json(cm.to_json())
+    assert cm2.meta == m and cm2.compacted_time == cm.compacted_time
+
+
+def test_tenant_index_roundtrip():
+    metas = [BlockMeta(tenant_id="t") for _ in range(3)]
+    idx = TenantIndex(created_at=123, metas=metas,
+                      compacted=[CompactedBlockMeta.from_meta(metas[0])])
+    idx2 = TenantIndex.from_bytes(idx.to_bytes())
+    assert idx2.created_at == 123
+    assert [m.block_id for m in idx2.metas] == [m.block_id for m in metas]
+    assert idx2.compacted[0].meta.block_id == metas[0].block_id
+
+
+def test_backend_compacted_lifecycle(tmp_backend_dir):
+    be = LocalBackend(tmp_backend_dir)
+    meta = BlockMeta(tenant_id="t1")
+    sb = StreamingBlock(meta)
+    sb.add_object(b"\x01" * 16, b"hello")
+    out = sb.complete(be)
+    assert be.list_blocks("t1") == [out.block_id]
+    be.mark_compacted(out)
+    with pytest.raises(DoesNotExist):
+        be.read_block_meta("t1", out.block_id)
+    cm = be.read_compacted_meta("t1", out.block_id)
+    assert cm.meta.block_id == out.block_id
+    be.clear_block("t1", out.block_id)
+    assert be.list_blocks("t1") == []
+
+
+def test_mock_backend_matches_local(tmp_backend_dir):
+    for be in (LocalBackend(tmp_backend_dir), MockBackend()):
+        be.write("t", "b1", "data", b"abc")
+        assert be.read("t", "b1", "data") == b"abc"
+        assert be.read_range("t", "b1", "data", 1, 1) == b"b"
+        assert be.list_tenants() == ["t"]
+        assert be.list_blocks("t") == ["b1"]
+        be.delete("t", "b1", "data")
+        with pytest.raises(DoesNotExist):
+            be.read("t", "b1", "data")

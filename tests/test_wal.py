@@ -1,0 +1,124 @@
+import os
+
+from tempo_tpu.backend import BlockMeta, LocalBackend
+from tempo_tpu.encoding.v2 import StreamingBlock, BackendBlock
+from tempo_tpu.model import segment_codec_for, codec_for
+from tempo_tpu.utils.test_data import make_trace
+from tempo_tpu.utils.ids import random_trace_id
+from tempo_tpu.wal import WAL, parse_wal_filename
+
+
+def _seg(tid, seed, start, end):
+    sc = segment_codec_for("v2")
+    return sc.prepare_for_write(make_trace(tid, seed=seed, batches=1), start, end)
+
+
+def test_wal_append_find_iterate(tmp_wal_dir):
+    wal = WAL(tmp_wal_dir)
+    blk = wal.new_block("t1")
+    tids = sorted(random_trace_id() for _ in range(10))
+    for i, tid in enumerate(tids):
+        blk.append(tid, _seg(tid, i, 100 + i, 200 + i), 100 + i, 200 + i)
+    # duplicate segment for tids[0] combines on read
+    blk.append(tids[0], _seg(tids[0], 99, 50, 60), 50, 60)
+
+    assert blk.meta.total_objects == 11
+    assert blk.meta.start_time == 50 and blk.meta.end_time == 209
+
+    obj = blk.find(tids[0])
+    c = codec_for("v2")
+    assert c.fast_range(obj) == (50, 200)
+    assert blk.find(b"\x00" * 16) is None
+
+    ids = [i for i, _ in blk.iterator()]
+    assert ids == tids  # sorted, deduped
+    blk.close()
+
+
+def test_wal_replay(tmp_wal_dir):
+    wal = WAL(tmp_wal_dir)
+    blk = wal.new_block("t1")
+    tids = [random_trace_id() for _ in range(5)]
+    for i, tid in enumerate(tids):
+        blk.append(tid, _seg(tid, i, 10, 20), 10, 20)
+    blk.close()
+
+    blocks, removed = WAL(tmp_wal_dir).replay_all()
+    assert removed == []
+    assert len(blocks) == 1
+    rb = blocks[0]
+    assert rb.meta.tenant_id == "t1"
+    assert rb.meta.total_objects == 5
+    assert rb.meta.block_id == blk.meta.block_id
+    for i, tid in enumerate(tids):
+        assert rb.find(tid) is not None
+    rb.close()
+
+
+def test_wal_replay_truncated_tail(tmp_wal_dir):
+    wal = WAL(tmp_wal_dir)
+    blk = wal.new_block("t1")
+    tids = [random_trace_id() for _ in range(3)]
+    for i, tid in enumerate(tids):
+        blk.append(tid, _seg(tid, i, 10, 20), 10, 20)
+    blk.close()
+
+    # simulate crash mid-append: chop 3 bytes off the tail
+    with open(blk.path, "r+b") as f:
+        f.truncate(os.path.getsize(blk.path) - 3)
+
+    blocks, removed = WAL(tmp_wal_dir).replay_all()
+    assert len(blocks) == 1
+    rb = blocks[0]
+    assert rb.meta.total_objects == 2  # torn last record discarded
+    # appends continue cleanly after replay truncation
+    extra = random_trace_id()
+    rb.append(extra, _seg(extra, 9, 10, 20), 10, 20)
+    assert rb.find(extra) is not None
+    rb.close()
+
+
+def test_wal_replay_removes_garbage(tmp_wal_dir):
+    with open(os.path.join(tmp_wal_dir, "not-a-wal-file"), "wb") as f:
+        f.write(b"junk")
+    with open(os.path.join(tmp_wal_dir, "a+b+vT1+none+v2"), "wb") as f:
+        pass  # zero length
+    blocks, removed = WAL(tmp_wal_dir).replay_all()
+    assert blocks == []
+    assert sorted(removed) == ["a+b+vT1+none+v2", "not-a-wal-file"]
+    assert os.listdir(tmp_wal_dir) == []
+
+
+def test_parse_wal_filename():
+    m = parse_wal_filename("abc123+tenant-1+vT1+none+v2")
+    assert m.block_id == "abc123"
+    assert m.tenant_id == "tenant-1"
+    assert m.data_encoding == "v2"
+
+
+def test_wal_to_complete_block(tmp_wal_dir, tmp_backend_dir):
+    """The flush path: WAL iterator → StreamingBlock → BackendBlock find."""
+    wal = WAL(tmp_wal_dir)
+    blk = wal.new_block("t1")
+    tids = [random_trace_id() for _ in range(20)]
+    for i, tid in enumerate(tids):
+        blk.append(tid, _seg(tid, i, 100, 200), 100, 200)
+
+    be = LocalBackend(tmp_backend_dir)
+    meta = BlockMeta(tenant_id="t1", block_id=blk.meta.block_id, encoding="zstd")
+    sb = StreamingBlock(meta, page_size=1024)
+    c = codec_for("v2")
+    for oid, obj in blk.iterator():
+        s, e = c.fast_range(obj)
+        sb.add_object(oid, obj, s, e)
+    out = sb.complete(be)
+    assert out.total_objects == 20
+
+    bb = BackendBlock(be, out)
+    for tid in tids:
+        obj = bb.find_by_id(tid)
+        assert obj is not None
+        tr = c.prepare_for_read(obj)
+        assert len(tr.batches) == 1
+    blk.clear()
+    assert not os.path.exists(blk.path)
