@@ -10,6 +10,7 @@
 #include <cstring>
 
 #include <zstd.h>
+#include <zstd_errors.h>
 
 // liblz4 / libsnappy ship no dev headers in this image; declare the stable
 // C ABIs directly and link against the versioned runtime libraries.
@@ -45,7 +46,11 @@ long long tt_zstd_decompress(const char* src, size_t src_len,
     return -2;  // caller must grow dst
   }
   size_t n = ZSTD_decompress(dst, dst_cap, src, src_len);
-  if (ZSTD_isError(n)) return -1;
+  if (ZSTD_isError(n)) {
+    // streaming encoders omit the frame content size; a too-small dst then
+    // surfaces here rather than in the precheck — keep it retryable
+    return ZSTD_getErrorCode(n) == ZSTD_error_dstSize_tooSmall ? -2 : -1;
+  }
   return (long long)n;
 }
 

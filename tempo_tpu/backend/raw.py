@@ -94,9 +94,19 @@ class RawBackend(abc.ABC):
 
     def _block_objects(self, tenant: str, block_id: str) -> list[str]:
         """Names of the objects in a block; backends that can list within a
-        block override this. Default covers the standard layout."""
+        block override this. The default derives the bloom shard count from
+        the block's (compacted) meta so large blocks don't leak shards."""
         from .types import NAME_DATA, NAME_INDEX, NAME_SEARCH, NAME_SEARCH_HEADER, bloom_name
         names = [NAME_META, NAME_COMPACTED_META, NAME_DATA, NAME_INDEX,
                  NAME_SEARCH, NAME_SEARCH_HEADER]
-        names += [bloom_name(i) for i in range(64)]
+        shards = 64
+        for reader in (self.read_compacted_meta, self.read_block_meta):
+            try:
+                meta = reader(tenant, block_id)
+                meta = getattr(meta, "meta", meta)  # CompactedBlockMeta wraps
+                shards = max(shards, meta.bloom_shard_count)
+                break
+            except BackendError:
+                continue
+        names += [bloom_name(i) for i in range(shards)]
         return names

@@ -16,6 +16,7 @@ from .bloom import ShardedBloom
 from .compression import decompress
 from .index import IndexReader
 from .objects import unmarshal_objects
+from tempo_tpu.utils.ids import pad_trace_id
 
 
 class BackendBlock:
@@ -45,7 +46,7 @@ class BackendBlock:
 
     def find_by_id(self, obj_id: bytes) -> bytes | None:
         """Bloom-gated point lookup; returns the stored object bytes or None."""
-        key = obj_id.rjust(16, b"\x00")[-16:]
+        key = pad_trace_id(obj_id)
         if self.meta.bloom_shard_count:
             shard = ShardedBloom.shard_for(key, self.meta.bloom_shard_count)
             blob = self.backend.read(self.meta.tenant_id, self.meta.block_id,
@@ -58,9 +59,9 @@ class BackendBlock:
             return None
         page = self.read_page(i)
         for oid, data in unmarshal_objects(page):
-            if oid.rjust(16, b"\x00")[-16:] == key:
+            if pad_trace_id(oid) == key:
                 return data
-            if oid.rjust(16, b"\x00")[-16:] > key:
+            if pad_trace_id(oid) > key:
                 return None
         return None
 

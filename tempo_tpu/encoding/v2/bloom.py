@@ -19,6 +19,22 @@ import xxhash
 from tempo_tpu.utils.hashing import fnv1a_32
 
 _HDR = struct.Struct("<IIQ")  # k hashes, reserved, m bits
+_SEED2 = 0x9E3779B97F4A7C15
+
+
+def _probe_positions(obj_id: bytes, k: int, m: int) -> np.ndarray:
+    """Kirsch-Mitzenmacher double hashing: h_i = h1 + i*h2 mod m. The ONE
+    definition shared by in-memory filters and marshalled-shard tests — a
+    divergence here silently produces bloom false negatives."""
+    h1 = xxhash.xxh64_intdigest(obj_id, seed=0)
+    h2 = xxhash.xxh64_intdigest(obj_id, seed=_SEED2) | 1
+    i = np.arange(k, dtype=np.uint64)
+    return (np.uint64(h1) + i * np.uint64(h2)) % np.uint64(m)
+
+
+def _probe_words(bits: np.ndarray, pos: np.ndarray) -> bool:
+    words = bits[(pos // 64).astype(np.int64)]
+    return bool(np.all(words & (np.uint64(1) << (pos % np.uint64(64)))))
 
 
 class ShardedBloom:
@@ -38,26 +54,16 @@ class ShardedBloom:
     def shard_for(obj_id: bytes, shard_count: int) -> int:
         return fnv1a_32(obj_id) % max(1, shard_count)
 
-    def _positions(self, obj_id: bytes) -> np.ndarray:
-        h1 = xxhash.xxh64_intdigest(obj_id, seed=0)
-        h2 = xxhash.xxh64_intdigest(obj_id, seed=0x9E3779B97F4A7C15) | 1
-        i = np.arange(self.k, dtype=np.uint64)
-        return (np.uint64(h1) + i * np.uint64(h2)) % np.uint64(self.m)
-
     def add(self, obj_id: bytes) -> None:
         s = self.shard_for(obj_id, self.shard_count)
-        pos = self._positions(obj_id)
+        pos = _probe_positions(obj_id, self.k, self.m)
         np.bitwise_or.at(self._bits[s], (pos // 64).astype(np.int64),
                          np.uint64(1) << (pos % np.uint64(64)))
 
     def test(self, obj_id: bytes) -> bool:
         s = self.shard_for(obj_id, self.shard_count)
-        return self._test_shard(self._bits[s], obj_id)
-
-    def _test_shard(self, bits: np.ndarray, obj_id: bytes) -> bool:
-        pos = self._positions(obj_id)
-        words = bits[(pos // 64).astype(np.int64)]
-        return bool(np.all(words & (np.uint64(1) << (pos % np.uint64(64)))))
+        return _probe_words(self._bits[s],
+                            _probe_positions(obj_id, self.k, self.m))
 
     # ---- serialization: one object per shard ----
 
@@ -70,12 +76,7 @@ class ShardedBloom:
         bits = np.frombuffer(data, dtype=np.uint64, offset=_HDR.size)
         if len(bits) != m // 64:
             raise ValueError("bloom shard truncated")
-        h1 = xxhash.xxh64_intdigest(obj_id, seed=0)
-        h2 = xxhash.xxh64_intdigest(obj_id, seed=0x9E3779B97F4A7C15) | 1
-        i = np.arange(k, dtype=np.uint64)
-        pos = (np.uint64(h1) + i * np.uint64(h2)) % np.uint64(m)
-        words = bits[(pos // 64).astype(np.int64)]
-        return bool(np.all(words & (np.uint64(1) << (pos % np.uint64(64)))))
+        return _probe_words(bits, _probe_positions(obj_id, k, m))
 
     def shard_size_bytes(self) -> int:
         return _HDR.size + self.m // 8

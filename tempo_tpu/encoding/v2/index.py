@@ -18,6 +18,7 @@ from dataclasses import dataclass
 
 import numpy as np
 import xxhash
+from tempo_tpu.utils.ids import pad_trace_id
 
 RECORD_LEN = 28
 _PAGE_HDR = struct.Struct("<IQ")  # record_count, xxhash64 of records
@@ -34,7 +35,7 @@ class Record:
     length: int    # byte length of the data page
 
     def pack(self) -> bytes:
-        mid = self.max_id.rjust(16, b"\x00")[-16:]
+        mid = pad_trace_id(self.max_id)
         return mid + struct.pack("<QI", self.start, self.length)
 
     @classmethod
@@ -111,7 +112,7 @@ class IndexReader:
         data page that can contain obj_id."""
         if len(self) == 0:
             return None
-        key = obj_id.rjust(16, b"\x00")[-16:]
+        key = pad_trace_id(obj_id)
         hi = int.from_bytes(key[:8], "big")
         lo = int.from_bytes(key[8:], "big")
         # lexicographic (hi, lo) search over sorted max_ids

@@ -19,6 +19,7 @@ from .bloom import ShardedBloom
 from .compression import compress
 from .index import IndexWriter, Record
 from .objects import marshal_object
+from tempo_tpu.utils.ids import pad_trace_id
 
 DEFAULT_PAGE_SIZE = 1 << 20          # 1 MiB uncompressed, cf. reference index downsample
 DEFAULT_RECORDS_PER_INDEX_PAGE = 1024
@@ -48,7 +49,7 @@ class StreamingBlock:
                    start: int = 0, end: int = 0) -> None:
         # normalize to the 16-byte padded key everywhere (index, bloom,
         # page framing) so short 64-bit ids sort and probe consistently
-        obj_id = obj_id.rjust(16, b"\x00")[-16:]
+        obj_id = pad_trace_id(obj_id)
         if self._last_id and obj_id < self._last_id:
             raise ValueError("objects must be added in ascending id order")
         self._last_id = obj_id

@@ -27,17 +27,37 @@ def _load():
     if _TRIED:
         return _LIB
     _TRIED = True
-    for p in _SO_PATHS:
-        p = os.path.abspath(p)
-        if os.path.exists(p):
-            try:
-                lib = ctypes.CDLL(p)
-                _bind(lib)
-                _LIB = lib
-                break
-            except OSError:
-                continue
+    for attempt in (0, 1):
+        for p in _SO_PATHS:
+            p = os.path.abspath(p)
+            if os.path.exists(p):
+                try:
+                    lib = ctypes.CDLL(p)
+                    _bind(lib)
+                    _LIB = lib
+                    return _LIB
+                except OSError:
+                    continue
+        if attempt == 0:
+            _try_build()
     return _LIB
+
+
+def _try_build():
+    """The .so is not committed (platform-specific); build it on first use
+    when a toolchain is present."""
+    import subprocess
+
+    native_dir = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "..", "native")
+    )
+    if not os.path.exists(os.path.join(native_dir, "Makefile")):
+        return
+    try:
+        subprocess.run(["make", "-C", native_dir], capture_output=True,
+                       timeout=120, check=False)
+    except (OSError, subprocess.TimeoutExpired):
+        pass
 
 
 def _bind(lib):

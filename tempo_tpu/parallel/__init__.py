@@ -1,0 +1,4 @@
+from .mesh import make_mesh, scan_mesh_axes
+from .dist_search import DistributedScanEngine
+
+__all__ = ["make_mesh", "scan_mesh_axes", "DistributedScanEngine"]

@@ -1,0 +1,28 @@
+"""Device mesh construction.
+
+The TPU reinterpretation of the reference's data-distribution strategies
+(SURVEY.md §2.5): block/page shards map onto mesh axes the way search jobs
+map onto queriers. One axis — "shards" — carries the scan fan-out
+(pages × blocks are data-parallel); collectives ride ICI within a slice
+and DCN across slices, replacing the goroutine fan-out + Results channel.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+SCAN_AXIS = "shards"
+
+
+def scan_mesh_axes() -> tuple[str, ...]:
+    return (SCAN_AXIS,)
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devs), (SCAN_AXIS,))

@@ -1,0 +1,31 @@
+"""Search subsystem: columnar tag-search blocks + the JAX scan engine.
+
+This is the north-star path (SURVEY.md §3.4, BASELINE.json): the
+reference's FlatBuffer search pages (pkg/tempofb) and hot scan loops
+(tempodb/search/backend_search_block.go:184-298, pipeline.go) are
+re-designed TPU-first — per-block dictionary-encoded tag columns staged as
+device int32 arrays, predicate evaluation as vectorized compares + segment
+reductions under jit, sharded over a device mesh with psum/all_gather for
+result merge.
+
+  data.py        per-trace search data extraction + wire codec
+  streaming.py   WAL-side search block (linear host scan, crash replay)
+  columnar.py    the device-ready columnar page format + container codec
+  pipeline.py    host-side query compilation (dictionary prefilter,
+                 substring semantics) + block-level pruning
+  engine.py      the jit scan kernels (single device)
+  backend_search_block.py  block build/open/search orchestration
+"""
+
+from .data import SearchData, extract_search_data, encode_search_data, decode_search_data
+from .streaming import StreamingSearchBlock
+from .columnar import ColumnarPages, PageGeometry
+from .backend_search_block import BackendSearchBlock, write_search_block
+from .results import SearchResults
+
+__all__ = [
+    "SearchData", "extract_search_data", "encode_search_data",
+    "decode_search_data", "StreamingSearchBlock", "ColumnarPages",
+    "PageGeometry", "BackendSearchBlock", "write_search_block",
+    "SearchResults",
+]

@@ -1,0 +1,264 @@
+"""Device-ready columnar search pages.
+
+The TPU-first redesign of the reference's FlatBuffer SearchPage
+(pkg/tempofb/tempo.fbs, search_page_builder.go): instead of byte-level
+FlatBuffer accessors scanned entry-by-entry on CPU, a block's search data
+is dictionary-encoded once at build time — tag keys and values become
+int32 ids into per-block sorted dictionaries — and laid out DENSELY so the
+device predicate is pure compares + lane reductions, no scatter/gather on
+the hot path:
+
+  kv_key    int32 [P, E, C]  key id of each kv slot (pad -1)
+  kv_val    int32 [P, E, C]  value id of each kv slot (pad -1)
+  entry_start u32 [P, E]   trace start, unix seconds
+  entry_end   u32 [P, E]   trace end, unix seconds
+  entry_dur   u32 [P, E]   trace duration, ms (exact parity with the
+                           proto oracle's (end_ns-start_ns)//1e6)
+  entry_valid bool[P, E]
+  entry_root_svc/name int32 [P, E]  val-dict ids for result rendering
+  trace_ids  u8 [P, E, 16]  stays host-side for result construction
+
+P = pages, E = entries/page, C = kv slots per entry. A term match is
+``any((kv_key == k) & (kv_val in ranges), axis=-1)`` — a VPU-friendly
+reduction (membership = OR of [lo,hi] range compares, see
+pipeline.ids_to_ranges). Ragged tag sets are padded/truncated to C (the reference
+likewise caps search data per trace, limits.go max_search_bytes_per_trace);
+that capacity trade is the price of static shapes on a shape-static
+accelerator (SURVEY.md §7 hard parts). An earlier CSR + scatter layout
+benchmarked ~20x slower on TPU than numpy on CPU — scatters serialize on
+the VPU; dense + reduce is the idiomatic mapping.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .data import SearchData
+from tempo_tpu.utils.ids import pad_trace_id
+
+_MAGIC = 0x54505553  # "TPUS"
+_VERSION = 2
+_HDR = struct.Struct("<IIQ")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+
+
+@dataclass(frozen=True)
+class PageGeometry:
+    entries_per_page: int = 1024
+    # CAP on kv slots per entry; the build sizes the actual capacity C to
+    # the corpus (next pow2 of the real max), so this only bounds memory
+    # for pathologically tagged traces (cf. reference
+    # max_search_bytes_per_trace, limits.go)
+    kv_per_entry: int = 64
+
+
+@dataclass
+class ColumnarPages:
+    geometry: PageGeometry
+    key_dict: list          # sorted list[str]
+    val_dict: list          # sorted list[str]
+    kv_key: np.ndarray      # int32 [P,E,C]
+    kv_val: np.ndarray      # int32 [P,E,C]
+    entry_start: np.ndarray  # uint32 [P,E]
+    entry_end: np.ndarray    # uint32 [P,E]
+    entry_dur: np.ndarray    # uint32 [P,E]
+    entry_valid: np.ndarray  # bool [P,E]
+    entry_root_svc: np.ndarray   # int32 [P,E]
+    entry_root_name: np.ndarray  # int32 [P,E]
+    trace_ids: np.ndarray    # uint8 [P,E,16]
+    n_entries: int = 0
+    header: dict = field(default_factory=dict)
+
+    @property
+    def n_pages(self) -> int:
+        return self.kv_key.shape[0]
+
+    # ------------------------------------------------------------------
+    # build
+
+    @classmethod
+    def build(cls, entries: list[SearchData],
+              geometry: PageGeometry = PageGeometry()) -> "ColumnarPages":
+        E = geometry.entries_per_page
+
+        keys: set[str] = set()
+        vals: set[str] = set()
+        for sd in entries:
+            for k, vs in sd.kvs.items():
+                keys.add(k)
+                vals.update(vs)
+            if sd.root_service:
+                vals.add(sd.root_service)
+            if sd.root_name:
+                vals.add(sd.root_name)
+        key_dict = sorted(keys)
+        val_dict = sorted(vals)
+        kidx = {k: i for i, k in enumerate(key_dict)}
+        vidx = {v: i for i, v in enumerate(val_dict)}
+
+        # size the kv capacity to the corpus: next pow2 of the widest
+        # entry, capped by geometry (truncation only beyond the cap)
+        widest = max(
+            (sum(len(vs) for vs in sd.kvs.values()) for sd in entries),
+            default=1,
+        )
+        C = 1
+        while C < min(widest, geometry.kv_per_entry):
+            C *= 2
+        C = min(C, geometry.kv_per_entry)
+
+        P = max(1, -(-len(entries) // E))
+        kv_key = np.full((P, E, C), -1, dtype=np.int32)
+        kv_val = np.full((P, E, C), -1, dtype=np.int32)
+        entry_start = np.zeros((P, E), dtype=np.uint32)
+        entry_end = np.zeros((P, E), dtype=np.uint32)
+        entry_dur = np.zeros((P, E), dtype=np.uint32)
+        entry_valid = np.zeros((P, E), dtype=bool)
+        entry_root_svc = np.full((P, E), -1, dtype=np.int32)
+        entry_root_name = np.full((P, E), -1, dtype=np.int32)
+        trace_ids = np.zeros((P, E, 16), dtype=np.uint8)
+
+        n_entries = 0
+        truncated = 0
+        min_start, max_end = 0xFFFFFFFF, 0
+        min_dur, max_dur = 0xFFFFFFFF, 0
+        for i, sd in enumerate(entries):
+            p, e = divmod(i, E)
+            entry_start[p, e] = sd.start_s & 0xFFFFFFFF
+            entry_end[p, e] = sd.end_s & 0xFFFFFFFF
+            entry_dur[p, e] = min(sd.dur_ms, 0xFFFFFFFF)
+            entry_valid[p, e] = True
+            if sd.root_service:
+                entry_root_svc[p, e] = vidx[sd.root_service]
+            if sd.root_name:
+                entry_root_name[p, e] = vidx[sd.root_name]
+            tid = pad_trace_id(sd.trace_id)
+            trace_ids[p, e] = np.frombuffer(tid, dtype=np.uint8)
+            if sum(len(vs) for vs in sd.kvs.values()) > C:
+                truncated += 1
+            slot = 0
+            for k in sorted(sd.kvs):
+                if slot >= C:
+                    break
+                for v in sorted(sd.kvs[k]):
+                    if slot >= C:
+                        break
+                    kv_key[p, e, slot] = kidx[k]
+                    kv_val[p, e, slot] = vidx[v]
+                    slot += 1
+            n_entries += 1
+            if sd.start_s:
+                min_start = min(min_start, sd.start_s)
+            max_end = max(max_end, sd.end_s)
+            min_dur = min(min_dur, sd.dur_ms)
+            max_dur = max(max_dur, sd.dur_ms)
+
+        header = {
+            "n_entries": n_entries,
+            "n_pages": P,
+            "entries_per_page": E,
+            "kv_per_entry": C,  # actual capacity, not the geometry cap
+            "n_keys": len(key_dict),
+            "n_vals": len(val_dict),
+            "truncated_entries": truncated,
+            "min_start_s": 0 if min_start == 0xFFFFFFFF else min_start,
+            "max_end_s": max_end,
+            "min_dur_ms": 0 if min_dur == 0xFFFFFFFF else min_dur,
+            "max_dur_ms": max_dur,
+        }
+        return cls(
+            geometry=PageGeometry(E, C), key_dict=key_dict, val_dict=val_dict,
+            kv_key=kv_key, kv_val=kv_val,
+            entry_start=entry_start, entry_end=entry_end, entry_dur=entry_dur,
+            entry_valid=entry_valid, entry_root_svc=entry_root_svc,
+            entry_root_name=entry_root_name, trace_ids=trace_ids,
+            n_entries=n_entries, header=header,
+        )
+
+    # ------------------------------------------------------------------
+    # container codec
+
+    _ARRAYS = (
+        ("kv_key", np.int32), ("kv_val", np.int32),
+        ("entry_start", np.uint32), ("entry_end", np.uint32),
+        ("entry_dur", np.uint32), ("entry_valid", np.bool_),
+        ("entry_root_svc", np.int32), ("entry_root_name", np.int32),
+        ("trace_ids", np.uint8),
+    )
+
+    def to_bytes(self) -> bytes:
+        sections: dict[str, bytes] = {}
+        for name, _ in self._ARRAYS:
+            sections[name] = np.ascontiguousarray(getattr(self, name)).tobytes()
+        sections["key_dict"] = _pack_strs(self.key_dict)
+        sections["val_dict"] = _pack_strs(self.val_dict)
+
+        offsets = {}
+        body = bytearray()
+        for name, blob in sections.items():
+            offsets[name] = [len(body), len(blob)]
+            body += blob
+        hdr = dict(self.header)
+        hdr["sections"] = offsets
+        hdr_b = json.dumps(hdr).encode()
+        return _HDR.pack(_MAGIC, _VERSION, len(hdr_b)) + hdr_b + bytes(body)
+
+    @classmethod
+    def from_bytes(cls, buf: bytes) -> "ColumnarPages":
+        magic, version, hdr_len = _HDR.unpack_from(buf)
+        if magic != _MAGIC:
+            raise ValueError("bad search container magic")
+        if version != _VERSION:
+            raise ValueError(f"unsupported search container version {version}")
+        hdr = json.loads(buf[_HDR.size:_HDR.size + hdr_len])
+        base = _HDR.size + hdr_len
+        sections = hdr.pop("sections")
+
+        P = hdr["n_pages"]
+        E = hdr["entries_per_page"]
+        C = hdr["kv_per_entry"]
+        shapes = {
+            "kv_key": (P, E, C), "kv_val": (P, E, C),
+            "entry_start": (P, E), "entry_end": (P, E), "entry_dur": (P, E),
+            "entry_valid": (P, E), "entry_root_svc": (P, E),
+            "entry_root_name": (P, E), "trace_ids": (P, E, 16),
+        }
+        kw = {}
+        for name, dtype in cls._ARRAYS:
+            off, length = sections[name]
+            arr = np.frombuffer(buf, dtype=dtype, count=length // np.dtype(dtype).itemsize,
+                                offset=base + off)
+            kw[name] = arr.reshape(shapes[name])
+        off, length = sections["key_dict"]
+        key_dict = _unpack_strs(buf[base + off: base + off + length])
+        off, length = sections["val_dict"]
+        val_dict = _unpack_strs(buf[base + off: base + off + length])
+        return cls(
+            geometry=PageGeometry(E, C), key_dict=key_dict, val_dict=val_dict,
+            n_entries=hdr["n_entries"], header=hdr, **kw,
+        )
+
+
+def _pack_strs(strs: list) -> bytes:
+    out = bytearray(_U32.pack(len(strs)))
+    for s in strs:
+        b = s.encode("utf-8")[:0xFFFF]
+        out += _U16.pack(len(b)) + b
+    return bytes(out)
+
+
+def _unpack_strs(buf: bytes) -> list:
+    (n,) = _U32.unpack_from(buf)
+    off = 4
+    out = []
+    for _ in range(n):
+        (ln,) = _U16.unpack_from(buf, off)
+        off += 2
+        out.append(buf[off:off + ln].decode("utf-8", errors="replace"))
+        off += ln
+    return out

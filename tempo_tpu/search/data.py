@@ -1,0 +1,205 @@
+"""Per-trace search data: extraction and wire codec.
+
+Role-equivalent to the reference's distributor search-data extraction
+(modules/distributor/search_data.go:28-88) and the tempofb SearchEntry /
+SearchDataMap (pkg/tempofb/searchdatamap.go): for each trace we record the
+tag key→values map (resource + span attributes, span names under "name",
+"error" for error-status spans), the time range, and the root
+service/span-name needed to render results without decoding the trace.
+
+Wire format (the `search_data` bytes in PushBytesRequest, and the payload
+of WAL search-block entries) — little-endian, length-prefixed:
+
+  | u32 start_s | u32 end_s | u32 dur_ms | u16 root_svc_len | root_svc
+  | u16 root_name_len | root_name | u16 n_keys |
+  per key: | u16 key_len | key | u16 n_vals | (u16 val_len | val)* |
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from tempo_tpu import tempopb
+
+_U32 = struct.Struct("<I")
+_U16 = struct.Struct("<H")
+
+# hard cap per trace, cf. reference max_search_bytes_per_trace default 5KB
+DEFAULT_MAX_SEARCH_BYTES = 5 << 10
+
+
+@dataclass
+class SearchData:
+    trace_id: bytes = b""
+    start_s: int = 0
+    end_s: int = 0
+    dur_ms: int = 0
+    root_service: str = ""
+    root_name: str = ""
+    kvs: dict = field(default_factory=dict)  # str -> set[str]
+
+    @property
+    def start_ns(self) -> int:
+        # second precision is what the columnar format keeps; results carry
+        # start_s * 1e9 (the oracle's exact ns start is not persisted)
+        return self.start_s * 1_000_000_000
+
+    def merge(self, other: "SearchData") -> None:
+        if other.start_s and (not self.start_s or other.start_s < self.start_s):
+            self.start_s = other.start_s
+        if other.end_s > self.end_s:
+            self.end_s = other.end_s
+        self.dur_ms = max(self.dur_ms, other.dur_ms)
+        if not self.root_service and other.root_service:
+            self.root_service = other.root_service
+            self.root_name = other.root_name
+        for k, vs in other.kvs.items():
+            self.kvs.setdefault(k, set()).update(vs)
+
+
+def extract_search_data(trace_id: bytes, trace: tempopb.Trace,
+                        max_bytes: int = DEFAULT_MAX_SEARCH_BYTES) -> SearchData:
+    from tempo_tpu.model.matches import trace_range_ns
+
+    sd = SearchData(trace_id=trace_id)
+    start_ns, end_ns = trace_range_ns(trace)
+    sd.start_s = start_ns // 1_000_000_000
+    sd.end_s = end_ns // 1_000_000_000
+    sd.dur_ms = min((end_ns - start_ns) // 1_000_000, 0xFFFFFFFF) if end_ns else 0
+
+    budget = max_bytes
+    root = None
+
+    def _add(k: str, v: str) -> None:
+        nonlocal budget
+        if not v:
+            return
+        cost = len(k) + len(v)
+        if budget - cost < 0:
+            return
+        s = sd.kvs.setdefault(k, set())
+        if v not in s:
+            s.add(v)
+            budget -= cost
+
+    for batch in trace.batches:
+        svc = ""
+        for kv in batch.resource.attributes:
+            v = _any_value_str(kv.value)
+            _add(kv.key, v)
+            if kv.key == "service.name":
+                svc = v
+        for ss in batch.scope_spans:
+            for span in ss.spans:
+                _add("name", span.name)
+                if span.status.code == tempopb.Status.STATUS_CODE_ERROR:
+                    _add("error", "true")
+                for kv in span.attributes:
+                    _add(kv.key, _any_value_str(kv.value))
+                if not span.parent_span_id and (
+                    root is None or span.start_time_unix_nano < root[0]
+                ):
+                    root = (span.start_time_unix_nano, svc, span.name)
+    if root is None:
+        # fallback: earliest span overall
+        for batch in trace.batches:
+            svc = ""
+            for kv in batch.resource.attributes:
+                if kv.key == "service.name":
+                    svc = kv.value.string_value
+            for ss in batch.scope_spans:
+                for span in ss.spans:
+                    if root is None or span.start_time_unix_nano < root[0]:
+                        root = (span.start_time_unix_nano, svc, span.name)
+    if root is not None:
+        sd.root_service, sd.root_name = root[1], root[2]
+    return sd
+
+
+def _any_value_str(v: tempopb.AnyValue) -> str:
+    which = v.WhichOneof("value")
+    if which == "string_value":
+        return v.string_value
+    if which == "int_value":
+        return str(v.int_value)
+    if which == "bool_value":
+        return "true" if v.bool_value else "false"
+    if which == "double_value":
+        return repr(v.double_value)
+    return ""
+
+
+def search_data_matches(sd: SearchData, req) -> bool:
+    """Host-side predicate over extracted search data — same semantics as
+    the device kernel (substring on values, ms durations, second windows).
+    Used for live/WAL scans and as the engine's correctness oracle."""
+    if req.min_duration_ms and sd.dur_ms < req.min_duration_ms:
+        return False
+    if req.max_duration_ms and sd.dur_ms > req.max_duration_ms:
+        return False
+    if req.start and sd.end_s < req.start:
+        return False
+    if req.end and sd.start_s > req.end:
+        return False
+    for k, v in req.tags.items():
+        vs = sd.kvs.get(k)
+        if not vs:
+            return False
+        if v and not any(v in x for x in vs):
+            return False
+    return True
+
+
+# ---- wire codec ----
+
+def encode_search_data(sd: SearchData) -> bytes:
+    out = bytearray()
+    out += _U32.pack(sd.start_s & 0xFFFFFFFF)
+    out += _U32.pack(sd.end_s & 0xFFFFFFFF)
+    out += _U32.pack(min(sd.dur_ms, 0xFFFFFFFF))
+    for s in (sd.root_service, sd.root_name):
+        b = s.encode("utf-8")[:0xFFFF]
+        out += _U16.pack(len(b)) + b
+    keys = sorted(sd.kvs)
+    out += _U16.pack(len(keys))
+    for k in keys:
+        kb = k.encode("utf-8")[:0xFFFF]
+        out += _U16.pack(len(kb)) + kb
+        vals = sorted(sd.kvs[k])
+        out += _U16.pack(len(vals))
+        for v in vals:
+            vb = v.encode("utf-8")[:0xFFFF]
+            out += _U16.pack(len(vb)) + vb
+    return bytes(out)
+
+
+def decode_search_data(buf: bytes, trace_id: bytes = b"") -> SearchData:
+    off = 0
+
+    def u32():
+        nonlocal off
+        (v,) = _U32.unpack_from(buf, off)
+        off += 4
+        return v
+
+    def u16():
+        nonlocal off
+        (v,) = _U16.unpack_from(buf, off)
+        off += 2
+        return v
+
+    def s():
+        nonlocal off
+        n = u16()
+        v = buf[off:off + n].decode("utf-8", errors="replace")
+        off += n
+        return v
+
+    sd = SearchData(trace_id=trace_id)
+    sd.start_s, sd.end_s, sd.dur_ms = u32(), u32(), u32()
+    sd.root_service, sd.root_name = s(), s()
+    for _ in range(u16()):
+        k = s()
+        sd.kvs[k] = {s() for _ in range(u16())}
+    return sd

@@ -1,0 +1,50 @@
+"""Result collection: dedupe, limit, metrics.
+
+Role-equivalent to the reference's search.Results channel funnel
+(tempodb/search/results.go:14-141) and util.go result combination — here a
+simple synchronous collector (the device kernel already reduces per block;
+cross-block merge is cheap host work), carrying the same SearchMetrics
+counters the bench harness compares (inspectedTraces/Bytes/Blocks,
+skippedBlocks)."""
+
+from __future__ import annotations
+
+from tempo_tpu import tempopb
+
+
+class SearchResults:
+    def __init__(self, limit: int = 20):
+        self.limit = limit
+        self._by_id: dict[str, tempopb.TraceSearchMetadata] = {}
+        self.metrics = tempopb.SearchMetrics()
+
+    def add(self, meta: tempopb.TraceSearchMetadata) -> None:
+        prev = self._by_id.get(meta.trace_id)
+        if prev is None:
+            self._by_id[meta.trace_id] = meta
+        else:
+            # keep the earlier start / longer duration (combination rule of
+            # reference util.go:27-62)
+            if meta.start_time_unix_nano and (
+                not prev.start_time_unix_nano
+                or meta.start_time_unix_nano < prev.start_time_unix_nano
+            ):
+                prev.start_time_unix_nano = meta.start_time_unix_nano
+            prev.duration_ms = max(prev.duration_ms, meta.duration_ms)
+            if not prev.root_service_name:
+                prev.root_service_name = meta.root_service_name
+                prev.root_trace_name = meta.root_trace_name
+
+    @property
+    def complete(self) -> bool:
+        return len(self._by_id) >= self.limit
+
+    def response(self) -> tempopb.SearchResponse:
+        resp = tempopb.SearchResponse()
+        metas = sorted(
+            self._by_id.values(),
+            key=lambda m: m.start_time_unix_nano, reverse=True,
+        )[: self.limit]
+        resp.traces.extend(metas)
+        resp.metrics.CopyFrom(self.metrics)
+        return resp

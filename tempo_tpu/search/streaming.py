@@ -1,0 +1,107 @@
+"""WAL-side search block: appended live, linearly scanned, crash-replayed.
+
+Role-equivalent to the reference's StreamingSearchBlock
+(tempodb/search/streaming_search_block.go:22-237) and RescanBlocks
+(rescan_blocks.go:20-107): search data for traces in the head block is
+appended to a sidecar WAL file (`<wal name>.search`); searches over live /
+not-yet-completed data scan it on the host; on block completion the
+entries feed the columnar backend search block build.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tempo_tpu import tempopb
+from tempo_tpu.encoding.v2.objects import marshal_object, unmarshal_objects
+from .data import SearchData, decode_search_data, encode_search_data
+from .pipeline import UINT32_MAX
+from tempo_tpu.utils.ids import pad_trace_id
+
+
+class StreamingSearchBlock:
+    def __init__(self, path: str, _replay: bool = False):
+        self.path = path
+        self._entries: dict[bytes, SearchData] = {}
+        if _replay:
+            self._replay()
+            self._fh = open(path, "ab")
+        else:
+            self._fh = open(path, "wb")
+
+    def append(self, trace_id: bytes, sd: SearchData) -> None:
+        tid = pad_trace_id(trace_id)
+        self._fh.write(marshal_object(tid, encode_search_data(sd)))
+        self._fh.flush()
+        self._merge(tid, sd)
+
+    def _merge(self, tid: bytes, sd: SearchData) -> None:
+        cur = self._entries.get(tid)
+        if cur is None:
+            sd.trace_id = tid
+            self._entries[tid] = sd
+        else:
+            cur.merge(sd)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def entries(self) -> list[SearchData]:
+        """Merged entries in ascending trace-id order (feeds the columnar
+        build at completion)."""
+        return [self._entries[t] for t in sorted(self._entries)]
+
+    # ---- host linear scan (live/WAL data volume is small) ----
+
+    def search(self, req: tempopb.SearchRequest, results) -> None:
+        from .data import search_data_matches
+
+        for sd in self._entries.values():
+            results.metrics.inspected_traces += 1
+            if search_data_matches(sd, req):
+                results.add(_meta_from_sd(sd))
+                if results.complete:
+                    return
+
+    # ---- lifecycle ----
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def clear(self) -> None:
+        self.close()
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+    def _replay(self) -> None:
+        with open(self.path, "rb") as f:
+            buf = f.read()
+        off = 0
+        for tid, payload in unmarshal_objects(buf, tolerate_truncation=True):
+            off += 8 + len(tid) + len(payload)
+            try:
+                sd = decode_search_data(payload, tid)
+            except Exception:
+                continue  # skip a corrupt entry, keep scanning
+            self._merge(tid, sd)
+        if off < len(buf):
+            with open(self.path, "ab") as f:
+                f.truncate(off)
+
+    @classmethod
+    def rescan(cls, path: str) -> "StreamingSearchBlock":
+        return cls(path, _replay=True)
+
+
+def _meta_from_sd(sd: SearchData) -> "tempopb.TraceSearchMetadata":
+    m = tempopb.TraceSearchMetadata()
+    m.trace_id = sd.trace_id.hex()
+    m.start_time_unix_nano = sd.start_ns
+    m.duration_ms = min(sd.dur_ms, UINT32_MAX)
+    m.root_service_name = sd.root_service
+    m.root_trace_name = sd.root_name
+    return m

@@ -18,6 +18,7 @@ from dataclasses import dataclass
 from tempo_tpu.backend.types import BlockMeta, VERSION_VT1
 from tempo_tpu.encoding.v2.objects import marshal_object, unmarshal_objects
 from tempo_tpu.model.codec import segment_codec_for, CURRENT_ENCODING
+from tempo_tpu.utils.ids import pad_trace_id
 
 _SEP = "+"
 
@@ -76,7 +77,7 @@ class AppendBlock:
                start: int = 0, end: int = 0) -> None:
         # normalize to the padded 16-byte key so WAL iteration order matches
         # block index order (StreamingBlock pads the same way)
-        obj_id = obj_id.rjust(16, b"\x00")[-16:]
+        obj_id = pad_trace_id(obj_id)
         rec = marshal_object(obj_id, segment)
         self._fh.write(rec)
         self._fh.flush()
@@ -105,7 +106,7 @@ class AppendBlock:
 
     def find(self, obj_id: bytes) -> bytes | None:
         """Combined object bytes for an id, or None."""
-        idxs = self._by_id.get(obj_id.rjust(16, b"\x00")[-16:])
+        idxs = self._by_id.get(pad_trace_id(obj_id))
         if not idxs:
             return None
         segs = [self._read_entry(self._entries[i]) for i in idxs]

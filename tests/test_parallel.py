@@ -1,0 +1,56 @@
+import jax
+import numpy as np
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.parallel import DistributedScanEngine, make_mesh
+from tempo_tpu.search.columnar import ColumnarPages, PageGeometry
+from tempo_tpu.search.data import search_data_matches
+from tempo_tpu.search.engine import ScanEngine
+from tempo_tpu.search.pipeline import compile_query
+
+from tests.test_search import _corpus, _mk_req, QUERIES
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+
+
+@pytest.mark.parametrize("qi", [0, 2, 4, 7])
+def test_distributed_scan_matches_single_device(qi):
+    req = QUERIES[qi]
+    req.limit = 1000
+    entries = _corpus(500)
+    pages = ColumnarPages.build(entries, PageGeometry(32, 8))
+    cq = compile_query(pages.key_dict, pages.val_dict, req)
+    if cq is None:
+        pytest.skip("query prunes block")
+
+    single = ScanEngine(top_k=1024)
+    s_count, s_inspected, _, _ = single.scan(pages, cq)
+
+    mesh = make_mesh()
+    dist = DistributedScanEngine(mesh, top_k=1024)
+    sp = dist.stage(pages)
+    d_count, d_inspected, scores, idx = dist.scan_staged(sp, cq)
+
+    assert d_count == s_count
+    assert d_inspected == s_inspected
+
+    expected = {sd.trace_id for sd in entries if search_data_matches(sd, req)}
+    got = {bytes.fromhex(m.trace_id) for m in dist.results(sp, cq, scores, idx)}
+    assert got == expected
+
+
+def test_distributed_stage_shards_pages():
+    entries = _corpus(300)
+    pages = ColumnarPages.build(entries, PageGeometry(32, 8))
+    mesh = make_mesh()
+    dist = DistributedScanEngine(mesh)
+    sp = dist.stage(pages)
+    arr = sp.device["kv_key"]
+    assert arr.shape[0] % 8 == 0
+    # each of the 8 devices holds a distinct contiguous page shard
+    assert len(arr.sharding.device_set) == 8

@@ -1,0 +1,256 @@
+import os
+import random
+
+import numpy as np
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.backend import BlockMeta, MockBackend
+from tempo_tpu.model.matches import matches
+from tempo_tpu.search import (
+    BackendSearchBlock,
+    ColumnarPages,
+    PageGeometry,
+    SearchResults,
+    StreamingSearchBlock,
+    decode_search_data,
+    encode_search_data,
+    extract_search_data,
+    write_search_block,
+)
+from tempo_tpu.search.data import SearchData, search_data_matches
+from tempo_tpu.search.engine import ScanEngine, stage
+from tempo_tpu.search.pipeline import compile_query, substring_value_ids
+from tempo_tpu.utils.ids import random_trace_id
+from tempo_tpu.utils.test_data import make_trace
+
+
+def _mk_req(tags=None, **kw):
+    req = tempopb.SearchRequest()
+    for k, v in (tags or {}).items():
+        req.tags[k] = v
+    for k, v in kw.items():
+        setattr(req, k, v)
+    return req
+
+
+def _corpus(n=500, seed=0):
+    rng = random.Random(seed)
+    entries = []
+    for i in range(n):
+        tid = bytes([i % 256, i // 256]) + os.urandom(14)
+        sd = SearchData(trace_id=tid.rjust(16, b"\x00")[-16:])
+        sd.start_s = 1_600_000_000 + i
+        sd.end_s = sd.start_s + rng.randint(0, 10)
+        sd.dur_ms = rng.randint(1, 30_000)
+        sd.root_service = rng.choice(["frontend", "checkout", "cart"])
+        sd.root_name = "GET /"
+        sd.kvs = {
+            "service.name": {sd.root_service},
+            "http.status_code": {str(rng.choice([200, 404, 500]))},
+            "region": {rng.choice(["us-east-1", "us-west-2", "eu-west-1"])},
+        }
+        entries.append(sd)
+    return entries
+
+
+def test_search_data_codec_roundtrip():
+    sd = _corpus(3)[1]
+    sd2 = decode_search_data(encode_search_data(sd), sd.trace_id)
+    assert sd2.start_s == sd.start_s and sd2.end_s == sd.end_s
+    assert sd2.dur_ms == sd.dur_ms
+    assert sd2.root_service == sd.root_service
+    assert sd2.kvs == sd.kvs
+
+
+def test_extract_search_data_matches_proto_oracle():
+    """Extracted search data must agree with the proto-level matcher for
+    tag queries (the device kernel's semantics are defined by this)."""
+    for seed in range(10):
+        tid = random_trace_id()
+        tr = make_trace(tid, seed=seed)
+        sd = extract_search_data(tid, tr)
+        for req in [
+            _mk_req({"component": "grpc"}),
+            _mk_req({"component": "db"}),
+            _mk_req({"service.name": "check"}),
+            _mk_req({"http.status_code": "500"}),
+            _mk_req({"nonexistent": "x"}),
+        ]:
+            assert search_data_matches(sd, req) == matches(tr, req), (seed, req)
+
+
+def test_substring_value_ids():
+    vd = ["alpha", "beta", "alphabet", "gamma"]
+    assert substring_value_ids(vd, "alpha").tolist() == [0, 2]
+    assert substring_value_ids(vd, "bet").tolist() == [1, 2]
+    assert substring_value_ids(vd, "zzz").size == 0
+    assert substring_value_ids(vd, "").size == 4
+
+
+def test_columnar_roundtrip():
+    entries = _corpus(300)
+    pages = ColumnarPages.build(entries, PageGeometry(entries_per_page=64, kv_per_entry=8))
+    assert pages.n_entries == 300
+    assert pages.n_pages >= 300 // 64
+    blob = pages.to_bytes()
+    p2 = ColumnarPages.from_bytes(blob)
+    assert p2.n_entries == 300
+    np.testing.assert_array_equal(p2.kv_key, pages.kv_key)
+    np.testing.assert_array_equal(p2.trace_ids, pages.trace_ids)
+    assert p2.key_dict == pages.key_dict
+    assert p2.val_dict == pages.val_dict
+    assert p2.header["max_end_s"] == pages.header["max_end_s"]
+
+
+QUERIES = [
+    _mk_req({"service.name": "frontend"}),
+    _mk_req({"service.name": "front"}),                     # substring
+    _mk_req({"service.name": "frontend", "http.status_code": "500"}),
+    _mk_req({"region": "us"}),                              # multi-value substring
+    _mk_req({}, min_duration_ms=10_000),
+    _mk_req({}, max_duration_ms=500),
+    _mk_req({"service.name": "cart"}, min_duration_ms=5_000, max_duration_ms=25_000),
+    _mk_req({}, start=1_600_000_100, end=1_600_000_200),
+    _mk_req({"http.status_code": "404"}, start=1_600_000_050, end=1_600_000_400),
+    _mk_req({"service.name": "zzz-absent"}),
+]
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_engine_matches_host_oracle(qi):
+    """The jit kernel must agree exactly with the host predicate."""
+    req = QUERIES[qi]
+    req.limit = 1000
+    entries = _corpus(500)
+    pages = ColumnarPages.build(entries, PageGeometry(64, 8))
+    expected = {sd.trace_id for sd in entries if search_data_matches(sd, req)}
+
+    cq = compile_query(pages.key_dict, pages.val_dict, req)
+    if cq is None:
+        assert not expected
+        return
+    eng = ScanEngine(top_k=1024)
+    count, inspected, scores, idx = eng.scan(pages, cq)
+    assert count == len(expected)
+    assert inspected == 500
+    sp = stage(pages)
+    got = {bytes.fromhex(m.trace_id) for m in eng.results(sp, cq, scores, idx)}
+    assert got == expected
+
+
+def test_engine_topk_ordering_and_limit():
+    entries = _corpus(500)
+    pages = ColumnarPages.build(entries, PageGeometry(64, 8))
+    req = _mk_req({"service.name": "frontend"})
+    req.limit = 5
+    cq = compile_query(pages.key_dict, pages.val_dict, req)
+    eng = ScanEngine(top_k=128)
+    sp = stage(pages)
+    count, _, scores, idx = eng.scan_staged(sp, cq)
+    metas = eng.results(sp, cq, scores, idx)
+    assert len(metas) == 5
+    starts = [m.start_time_unix_nano for m in metas]
+    assert starts == sorted(starts, reverse=True)  # most recent first
+
+
+def test_backend_search_block_end_to_end():
+    be = MockBackend()
+    meta = BlockMeta(tenant_id="t1")
+    entries = _corpus(400)
+    hdr = write_search_block(be, meta, entries, PageGeometry(64, 8))
+    assert hdr["n_entries"] == 400
+
+    bsb = BackendSearchBlock(be, meta)
+    req = _mk_req({"service.name": "checkout"})
+    req.limit = 10
+    res = bsb.search(req)
+    resp = res.response()
+    assert 0 < len(resp.traces) <= 10
+    assert resp.metrics.inspected_blocks == 1
+    assert resp.metrics.inspected_traces == 400
+    for m in resp.traces:
+        assert m.root_service_name == "checkout"
+
+    # pruned by dictionary prefilter: absent key never touches the device
+    res2 = bsb.search(_mk_req({"absent.key": "x"}))
+    assert res2.metrics.skipped_blocks == 1
+
+    # pruned by header time range
+    res3 = bsb.search(_mk_req({}, start=1_700_000_000, end=1_700_000_100))
+    assert res3.metrics.skipped_blocks == 1
+
+
+def test_streaming_search_block_append_scan_replay(tmp_path):
+    path = str(tmp_path / "head.search")
+    ssb = StreamingSearchBlock(path)
+    entries = _corpus(50)
+    for sd in entries:
+        ssb.append(sd.trace_id, sd)
+    assert len(ssb) == 50
+
+    req = _mk_req({"service.name": "frontend"})
+    req.limit = 100
+    res = SearchResults(limit=100)
+    ssb.search(req, res)
+    expected = sum(1 for sd in entries if search_data_matches(sd, req))
+    assert len(res.response().traces) == expected
+    ssb.close()
+
+    # crash replay with torn tail
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 5)
+    ssb2 = StreamingSearchBlock.rescan(path)
+    assert len(ssb2) == 49
+    # entries() sorted by trace id, feeds columnar build
+    ids = [sd.trace_id for sd in ssb2.entries()]
+    assert ids == sorted(ids)
+    ssb2.clear()
+    assert not os.path.exists(path)
+
+
+def test_results_dedupe_and_sort():
+    res = SearchResults(limit=10)
+    m1 = tempopb.TraceSearchMetadata(trace_id="aa", start_time_unix_nano=5, duration_ms=10)
+    m2 = tempopb.TraceSearchMetadata(trace_id="aa", start_time_unix_nano=3, duration_ms=20)
+    m3 = tempopb.TraceSearchMetadata(trace_id="bb", start_time_unix_nano=9)
+    for m in (m1, m2, m3):
+        res.add(m)
+    resp = res.response()
+    assert len(resp.traces) == 2
+    assert resp.traces[0].trace_id == "bb"  # most recent first
+    aa = resp.traces[1]
+    assert aa.start_time_unix_nano == 3 and aa.duration_ms == 20
+
+
+def test_engine_limit_above_default_topk():
+    """Requesting more results than the engine's default top_k must not
+    silently truncate (regression: results were capped at top_k=128)."""
+    entries = _corpus(500)  # ~1/3 match frontend
+    pages = ColumnarPages.build(entries, PageGeometry(64, 8))
+    req = _mk_req({"service.name": "frontend"})
+    req.limit = 400
+    cq = compile_query(pages.key_dict, pages.val_dict, req)
+    eng = ScanEngine(top_k=16)  # deliberately tiny default
+    sp = stage(pages)
+    count, _, scores, idx = eng.scan_staged(sp, cq)
+    metas = eng.results(sp, cq, scores, idx)
+    assert len(metas) == count  # every match surfaced, not 16
+
+
+def test_columnar_adaptive_kv_capacity():
+    """Build sizes C to the widest entry (pow2), capped by geometry;
+    regression: a fixed small C silently dropped searchable tags."""
+    wide = SearchData(trace_id=b"\x01" * 16, start_s=1, end_s=2, dur_ms=5)
+    wide.kvs = {f"k{i}": {f"v{i}"} for i in range(11)}
+    pages = ColumnarPages.build([wide], PageGeometry(entries_per_page=4))
+    assert pages.geometry.kv_per_entry == 16  # next pow2 of 11
+    assert pages.header["truncated_entries"] == 0
+    req = _mk_req({"k10": "v10"})
+    cq = compile_query(pages.key_dict, pages.val_dict, req)
+    count, _, _, _ = ScanEngine().scan(pages, cq)
+    assert count == 1
+    # cap still enforced
+    pages2 = ColumnarPages.build([wide], PageGeometry(4, 8))
+    assert pages2.geometry.kv_per_entry == 8
+    assert pages2.header["truncated_entries"] == 1
