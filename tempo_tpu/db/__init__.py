@@ -1,0 +1,11 @@
+from .tempodb import TempoDB, TempoDBConfig
+from .blocklist import Blocklist
+from .poller import Poller
+from .pool import run_jobs
+from .compaction import TimeWindowBlockSelector, compact_blocks
+from .retention import apply_retention
+
+__all__ = [
+    "TempoDB", "TempoDBConfig", "Blocklist", "Poller", "run_jobs",
+    "TimeWindowBlockSelector", "compact_blocks", "apply_retention",
+]

@@ -1,0 +1,47 @@
+"""Per-tenant in-memory block lists with staged updates.
+
+Role-equivalent to the reference's tempodb/blocklist/list.go: pollers
+replace the lists wholesale; between polls, compaction stages its own
+add/remove updates so the view stays coherent until the next poll
+confirms them.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tempo_tpu.backend.types import BlockMeta, CompactedBlockMeta
+
+
+class Blocklist:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metas: dict[str, list[BlockMeta]] = {}
+        self._compacted: dict[str, list[CompactedBlockMeta]] = {}
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metas)
+
+    def metas(self, tenant: str) -> list[BlockMeta]:
+        with self._lock:
+            return list(self._metas.get(tenant, []))
+
+    def compacted(self, tenant: str) -> list[CompactedBlockMeta]:
+        with self._lock:
+            return list(self._compacted.get(tenant, []))
+
+    def apply_poll_results(self, metas: dict, compacted: dict) -> None:
+        with self._lock:
+            self._metas = {t: list(ms) for t, ms in metas.items()}
+            self._compacted = {t: list(cs) for t, cs in compacted.items()}
+
+    def update(self, tenant: str, add=None, remove=None, add_compacted=None) -> None:
+        """Staged update between polls (compaction results)."""
+        with self._lock:
+            ms = self._metas.setdefault(tenant, [])
+            removed = {m.block_id for m in (remove or [])}
+            ms[:] = [m for m in ms if m.block_id not in removed]
+            ms.extend(add or [])
+            if add_compacted:
+                self._compacted.setdefault(tenant, []).extend(add_compacted)

@@ -1,0 +1,153 @@
+"""Compaction: block selection + streaming k-way merge.
+
+Role-equivalent to the reference's compaction engine:
+  - timeWindowBlockSelector (tempodb/compaction_block_selector.go:48-156):
+    group blocks by (compaction level, time window) inside the active
+    window, pick 2..max contiguous same-level blocks under object/byte
+    caps;
+  - v2.Compactor (tempodb/encoding/v2/compactor.go:30-137 +
+    iterator_multiblock.go:38): open all input iterators, k-way merge by
+    object id, Combine duplicate trace objects, stream into a new block at
+    compaction_level+1.
+
+Improvement over the reference: the merged block's columnar search data is
+rebuilt from the inputs (the reference drops search data of compacted-away
+blocks — SURVEY.md §3.5 note), so search coverage survives compaction.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+
+from tempo_tpu.backend.raw import RawBackend, BackendError
+from tempo_tpu.backend.types import BlockMeta
+from tempo_tpu.encoding.v2 import BackendBlock, StreamingBlock
+from tempo_tpu.model.codec import codec_for
+
+DEFAULT_WINDOW_S = 3600
+DEFAULT_MAX_INPUTS = 8
+DEFAULT_MIN_INPUTS = 2
+DEFAULT_MAX_BLOCK_BYTES = 100 << 30
+
+
+@dataclass
+class TimeWindowBlockSelector:
+    window_s: int = DEFAULT_WINDOW_S
+    min_inputs: int = DEFAULT_MIN_INPUTS
+    max_inputs: int = DEFAULT_MAX_INPUTS
+    max_block_bytes: int = DEFAULT_MAX_BLOCK_BYTES
+    active_window_s: int = 24 * 3600
+
+    def blocks_to_compact(self, metas: list[BlockMeta], now_s: int) -> list[BlockMeta]:
+        """Pick one compaction job: the first group of >= min_inputs
+        same-(level, window) blocks, most-populated window first. Inside
+        the active window blocks group by (level, window); outside, by
+        window only (levels mix — cf. reference selector)."""
+        groups: dict[tuple, list[BlockMeta]] = {}
+        for m in metas:
+            window = m.end_time // self.window_s if self.window_s else 0
+            active = (now_s - m.end_time) < self.active_window_s
+            key = (m.compaction_level if active else -1, window)
+            groups.setdefault(key, []).append(m)
+
+        def order(item):
+            (_level, window), blocks = item
+            return (-len(blocks), -window)
+
+        for (_key, blocks) in sorted(groups.items(), key=order):
+            if len(blocks) < self.min_inputs:
+                continue
+            blocks.sort(key=lambda m: (m.min_id, m.block_id))
+            picked: list[BlockMeta] = []
+            total = 0
+            for m in blocks:
+                if len(picked) >= self.max_inputs:
+                    break
+                if total + m.size > self.max_block_bytes and picked:
+                    break
+                picked.append(m)
+                total += m.size
+            if len(picked) >= self.min_inputs:
+                return picked
+        return []
+
+
+def compact_blocks(backend: RawBackend, tenant: str, inputs: list[BlockMeta],
+                   page_size: int = 1 << 20,
+                   compact_search: bool = True,
+                   search_geometry=None,
+                   search_encoding: str | None = None) -> BlockMeta:
+    """Merge input blocks into one new block at level+1, combining
+    duplicate trace objects; mark inputs compacted."""
+    codec = codec_for(inputs[0].data_encoding)
+    out_meta = BlockMeta(
+        tenant_id=tenant,
+        encoding=inputs[0].encoding,
+        data_encoding=inputs[0].data_encoding,
+        compaction_level=max(m.compaction_level for m in inputs) + 1,
+    )
+    out = StreamingBlock(out_meta, page_size=page_size)
+
+    iters = [BackendBlock(backend, m).iter_objects() for m in inputs]
+    merged = heapq.merge(*iters, key=lambda kv: kv[0])
+
+    pending_id: bytes | None = None
+    pending: list[bytes] = []
+
+    def flush():
+        if pending_id is None:
+            return
+        obj = pending[0] if len(pending) == 1 else codec.combine(*pending)
+        r = codec.fast_range(obj) or (0, 0)
+        out.add_object(pending_id, obj, r[0], r[1])
+
+    for oid, data in merged:
+        if oid != pending_id:
+            flush()
+            pending_id, pending = oid, [data]
+        else:
+            pending.append(data)  # same trace in 2+ blocks → combine
+    flush()
+
+    new_meta = out.complete(backend)
+
+    if compact_search:
+        _compact_search_blocks(backend, tenant, inputs, new_meta,
+                               search_geometry, search_encoding)
+
+    for m in inputs:
+        backend.mark_compacted(m)
+    return new_meta
+
+
+def _compact_search_blocks(backend: RawBackend, tenant: str,
+                           inputs: list[BlockMeta], new_meta: BlockMeta,
+                           search_geometry=None,
+                           search_encoding: str | None = None) -> None:
+    from tempo_tpu.search.backend_search_block import write_search_block
+    from tempo_tpu.search.columnar import ColumnarPages, PageGeometry
+    from tempo_tpu.search.data import SearchData
+    from tempo_tpu.backend.types import NAME_SEARCH
+    from tempo_tpu.encoding.v2.compression import decompress
+    import json
+
+    merged: dict[bytes, SearchData] = {}
+    for m in inputs:
+        try:
+            hdr = json.loads(backend.read(tenant, m.block_id, "search-header.json"))
+            raw = decompress(backend.read(tenant, m.block_id, NAME_SEARCH),
+                             hdr.get("encoding", "zstd"))
+            for sd in ColumnarPages.from_bytes(raw).to_entries():
+                cur = merged.get(sd.trace_id)
+                if cur is None:
+                    merged[sd.trace_id] = sd
+                else:
+                    cur.merge(sd)
+        except (BackendError, ValueError):
+            continue  # inputs without search data contribute nothing
+    if merged:
+        entries = [merged[t] for t in sorted(merged)]
+        write_search_block(backend, new_meta, entries,
+                           geometry=search_geometry or PageGeometry(),
+                           encoding=search_encoding or "zstd")

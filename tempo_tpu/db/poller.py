@@ -1,0 +1,83 @@
+"""Blocklist poller + tenant index builder.
+
+Role-equivalent to the reference's tempodb/blocklist/poller.go:105-265:
+list tenants and blocks from the backend, read each block's meta (or
+compacted meta) with bounded concurrency, and — when this instance is the
+elected builder — write the gzipped tenant index so other instances can
+read one object instead of N metas. Readers fall back to a full poll when
+the index is stale or missing.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tempo_tpu.backend.raw import RawBackend, BackendError, DoesNotExist
+from tempo_tpu.backend.types import (
+    BlockMeta,
+    CompactedBlockMeta,
+    TenantIndex,
+    NAME_TENANT_INDEX,
+)
+from .pool import run_jobs
+
+
+class Poller:
+    def __init__(self, backend: RawBackend, build_index: bool = True,
+                 stale_index_s: int = 0, concurrency: int = 50):
+        self.backend = backend
+        self.build_index = build_index
+        self.stale_index_s = stale_index_s
+        self.concurrency = concurrency
+
+    def poll(self) -> tuple[dict, dict]:
+        """Returns ({tenant: [BlockMeta]}, {tenant: [CompactedBlockMeta]})."""
+        metas: dict[str, list[BlockMeta]] = {}
+        compacted: dict[str, list[CompactedBlockMeta]] = {}
+        for tenant in self.backend.list_tenants():
+            m, c = self.poll_tenant(tenant)
+            metas[tenant] = m
+            compacted[tenant] = c
+        return metas, compacted
+
+    def poll_tenant(self, tenant: str):
+        if not self.build_index:
+            idx = self._read_index(tenant)
+            if idx is not None:
+                return idx.metas, idx.compacted
+            # stale/missing index: fall through to a direct poll
+        m, c = self._poll_tenant_blocks(tenant)
+        if self.build_index:
+            idx = TenantIndex(created_at=int(time.time()), metas=m, compacted=c)
+            self.backend.write(tenant, None, NAME_TENANT_INDEX, idx.to_bytes())
+        return m, c
+
+    def _read_index(self, tenant: str) -> TenantIndex | None:
+        try:
+            idx = TenantIndex.from_bytes(
+                self.backend.read(tenant, None, NAME_TENANT_INDEX)
+            )
+        except (BackendError, ValueError):
+            return None
+        if self.stale_index_s and time.time() - idx.created_at > self.stale_index_s:
+            return None
+        return idx
+
+    def _poll_tenant_blocks(self, tenant: str):
+        def read_one(block_id: str):
+            try:
+                return ("live", self.backend.read_block_meta(tenant, block_id))
+            except DoesNotExist:
+                pass
+            try:
+                return ("compacted", self.backend.read_compacted_meta(tenant, block_id))
+            except DoesNotExist:
+                return None  # torn block: objects without (any) meta — skip
+
+        results, _ = run_jobs(self.backend.list_blocks(tenant), read_one,
+                              workers=self.concurrency)
+        metas = [m for kind, m in results if kind == "live"]
+        compacted = [m for kind, m in results if kind == "compacted"]
+        metas.sort(key=lambda m: (m.start_time, m.block_id))
+        compacted.sort(key=lambda c: (c.meta.start_time, c.meta.block_id))
+        return metas, compacted

@@ -1,0 +1,45 @@
+"""Bounded worker pool for per-block fan-out.
+
+Role-equivalent to the reference's tempodb/pool (pool.go:58-196): run one
+job per block with bounded concurrency; for point lookups, stop early on
+the first hit (trace-by-ID needs only one block to answer).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+
+
+def run_jobs(jobs, fn, workers: int = 50, stop_on_first: bool = False,
+             collect_errors: bool = True):
+    """Run fn(job) for each job. Returns (results, errors) where results
+    excludes None. With stop_on_first, pending jobs are skipped after the
+    first non-None result."""
+    results = []
+    errors = []
+    if not jobs:
+        return results, errors
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def _run(job):
+        if stop.is_set():
+            return
+        try:
+            r = fn(job)
+        except Exception as e:  # noqa: BLE001 — per-block failures are partial results
+            if collect_errors:
+                with lock:
+                    errors.append(e)
+            return
+        if r is not None:
+            with lock:
+                results.append(r)
+            if stop_on_first:
+                stop.set()
+
+    workers = max(1, min(workers, len(jobs)))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
+        list(ex.map(_run, jobs))
+    return results, errors

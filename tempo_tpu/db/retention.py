@@ -1,0 +1,49 @@
+"""Retention: two-phase block deletion.
+
+Role-equivalent to the reference's tempodb/retention.go:14-88: (1) mark
+live blocks past the retention window compacted (soft delete — queriers
+stop listing them), (2) hard-delete compacted blocks past the compacted
+retention window.
+"""
+
+from __future__ import annotations
+
+from tempo_tpu.backend.raw import RawBackend
+from .blocklist import Blocklist
+from .pool import run_jobs
+
+
+def apply_retention(backend: RawBackend, blocklist: Blocklist, tenant: str,
+                    now_s: int, retention_s: int,
+                    compacted_retention_s: int = 3600,
+                    concurrency: int = 10) -> tuple[int, int]:
+    """Returns (marked, deleted)."""
+    marked = deleted = 0
+
+    if retention_s:
+        to_mark = [m for m in blocklist.metas(tenant)
+                   if m.end_time and now_s - m.end_time > retention_s]
+
+        def mark(m):
+            backend.mark_compacted(m)
+            return m
+
+        done, _ = run_jobs(to_mark, mark, workers=concurrency)
+        marked = len(done)
+        if done:
+            from tempo_tpu.backend.types import CompactedBlockMeta
+
+            blocklist.update(tenant, remove=done,
+                             add_compacted=[CompactedBlockMeta.from_meta(m)
+                                            for m in done])
+
+    to_delete = [c for c in blocklist.compacted(tenant)
+                 if now_s - c.compacted_time > compacted_retention_s]
+
+    def delete(c):
+        backend.clear_block(tenant, c.meta.block_id)
+        return c
+
+    done, _ = run_jobs(to_delete, delete, workers=concurrency)
+    deleted = len(done)
+    return marked, deleted

@@ -1,0 +1,221 @@
+"""TempoDB facade: the storage engine's public Reader/Writer/Compactor.
+
+Role-equivalent to the reference's tempodb/tempodb.go:70-520: block
+completion from WAL blocks, trace-by-ID fan-out over the blocklist with a
+bounded pool, search across backend search blocks (device engine, staged
+cache), poller/compaction/retention enablement, and block inclusion
+predicates (id-range shard + time window).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tempo_tpu import tempopb
+from tempo_tpu.backend.raw import RawBackend
+from tempo_tpu.backend.types import BlockMeta
+from tempo_tpu.encoding.v2 import BackendBlock, StreamingBlock
+from tempo_tpu.model.codec import codec_for
+from tempo_tpu.search import SearchResults, write_search_block
+from tempo_tpu.search.backend_search_block import BackendSearchBlock
+from tempo_tpu.search.columnar import PageGeometry
+from tempo_tpu.search.engine import ScanEngine
+from tempo_tpu.utils.ids import pad_trace_id
+from tempo_tpu.wal import WAL, AppendBlock
+
+from .blocklist import Blocklist
+from .compaction import TimeWindowBlockSelector, compact_blocks
+from .poller import Poller
+from .pool import run_jobs
+from .retention import apply_retention
+
+
+@dataclass
+class TempoDBConfig:
+    block_encoding: str = "zstd"          # reference: block zstd
+    search_encoding: str = "zstd"         # reference: search snappy
+    block_page_size: int = 1 << 20
+    pool_workers: int = 50                # reference: pool 50 workers
+    blocklist_poll_s: int = 30
+    compaction_window_s: int = 3600
+    compaction_max_inputs: int = 8
+    retention_s: int = 14 * 24 * 3600
+    compacted_retention_s: int = 3600
+    search_geometry: PageGeometry = field(default_factory=PageGeometry)
+    tenant_index_builder: bool = True
+    search_cache_blocks: int = 64         # staged (HBM) blocks kept hot
+
+
+class TempoDB:
+    """Reader + Writer + Compactor over one backend."""
+
+    def __init__(self, backend: RawBackend, wal_dir: str,
+                 cfg: TempoDBConfig | None = None):
+        self.backend = backend
+        self.cfg = cfg or TempoDBConfig()
+        self.wal = WAL(wal_dir)
+        self.blocklist = Blocklist()
+        self.poller = Poller(backend, build_index=self.cfg.tenant_index_builder)
+        self.selector = TimeWindowBlockSelector(
+            window_s=self.cfg.compaction_window_s,
+            max_inputs=self.cfg.compaction_max_inputs,
+        )
+        self.engine = ScanEngine()
+        self._search_blocks: dict[str, BackendSearchBlock] = {}
+        self._search_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # Writer
+
+    def complete_block(self, block: AppendBlock, search_entries=None) -> BlockMeta:
+        """WAL block → immutable backend block (+ columnar search block).
+        Reference flow: instance.CompleteBlock → tempodb.CompleteBlock...
+        (SURVEY.md §3.2)."""
+        codec = codec_for(block.meta.data_encoding)
+        meta = BlockMeta(
+            tenant_id=block.meta.tenant_id,
+            block_id=block.meta.block_id,
+            encoding=self.cfg.block_encoding,
+            data_encoding=block.meta.data_encoding,
+        )
+        sb = StreamingBlock(meta, page_size=self.cfg.block_page_size)
+        for oid, obj in block.iterator():
+            r = codec.fast_range(obj) or (0, 0)
+            sb.add_object(oid, obj, r[0], r[1])
+        out = sb.complete(self.backend)
+        if search_entries:
+            write_search_block(self.backend, out, search_entries,
+                               geometry=self.cfg.search_geometry,
+                               encoding=self.cfg.search_encoding)
+        self.blocklist.update(out.tenant_id, add=[out])
+        return out
+
+    def write_block_direct(self, tenant: str, objects, search_entries=None,
+                           data_encoding: str = "v2") -> BlockMeta:
+        """Write a complete block from (id, obj, start, end) tuples —
+        used by tests/benchmarks and the compactor path."""
+        meta = BlockMeta(tenant_id=tenant, encoding=self.cfg.block_encoding,
+                         data_encoding=data_encoding)
+        sb = StreamingBlock(meta, page_size=self.cfg.block_page_size)
+        for oid, obj, s, e in objects:
+            sb.add_object(oid, obj, s, e)
+        out = sb.complete(self.backend)
+        if search_entries:
+            write_search_block(self.backend, out, search_entries,
+                               geometry=self.cfg.search_geometry,
+                               encoding=self.cfg.search_encoding)
+        self.blocklist.update(tenant, add=[out])
+        return out
+
+    # ------------------------------------------------------------------
+    # Reader
+
+    def poll(self) -> None:
+        metas, compacted = self.poller.poll()
+        self.blocklist.apply_poll_results(metas, compacted)
+        with self._search_lock:
+            live = {m.block_id for ms in metas.values() for m in ms}
+            for bid in [b for b in self._search_blocks if b not in live]:
+                del self._search_blocks[bid]
+
+    @staticmethod
+    def _include_block(m: BlockMeta, block_start: str, block_end: str,
+                       start_s: int = 0, end_s: int = 0) -> bool:
+        """Inclusion predicate (reference tempodb.go:492-520): block id in
+        the [block_start, block_end] shard range, time windows overlap."""
+        if block_start and m.block_id < block_start:
+            return False
+        if block_end and m.block_id > block_end:
+            return False
+        if start_s and m.end_time and m.end_time < start_s:
+            return False
+        if end_s and m.start_time and m.start_time > end_s:
+            return False
+        return True
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes,
+                         block_start: str = "", block_end: str = "") -> tuple[bytes | None, int]:
+        """Fan out over candidate blocks; combine partial objects (the same
+        trace can live in several blocks until compaction dedupes it).
+        Returns (object bytes or None, failed_block_count)."""
+        key = pad_trace_id(trace_id)
+        metas = [m for m in self.blocklist.metas(tenant)
+                 if self._include_block(m, block_start, block_end)]
+
+        def job(m: BlockMeta):
+            return BackendBlock(self.backend, m).find_by_id(key)
+
+        found, errors = run_jobs(metas, job, workers=self.cfg.pool_workers)
+        if not found:
+            return None, len(errors)
+        codec = codec_for(metas[0].data_encoding if metas else "v2")
+        return (found[0] if len(found) == 1 else codec.combine(*found)), len(errors)
+
+    def _search_block_for(self, meta: BlockMeta) -> BackendSearchBlock:
+        with self._search_lock:
+            bsb = self._search_blocks.get(meta.block_id)
+            if bsb is None:
+                bsb = BackendSearchBlock(self.backend, meta)
+                self._search_blocks[meta.block_id] = bsb
+                # bounded HBM cache: evict oldest staged blocks
+                while len(self._search_blocks) > self.cfg.search_cache_blocks:
+                    self._search_blocks.pop(next(iter(self._search_blocks)))
+            return bsb
+
+    def search(self, tenant: str, req: tempopb.SearchRequest,
+               results: SearchResults | None = None) -> SearchResults:
+        """Search all (time-pruned) blocks of a tenant through the device
+        engine, early-stopping at the result limit."""
+        results = results or SearchResults(limit=req.limit or 20)
+        for m in self.blocklist.metas(tenant):
+            if not self._include_block(m, "", "", req.start, req.end):
+                results.metrics.skipped_blocks += 1
+                continue
+            self._search_block_for(m).search(req, results, engine=self.engine)
+            if results.complete:
+                break
+        return results
+
+    def search_block(self, req: tempopb.SearchBlockRequest) -> SearchResults:
+        """One search job (the SearchBlockRequest protocol unit). The block
+        meta travels in the request, as in the reference querier
+        (internalSearchBlock rebuilding BlockMeta from params)."""
+        meta = BlockMeta(
+            tenant_id=req.tenant_id, block_id=req.block_id,
+            encoding=req.encoding or "zstd", version=req.version or "vT1",
+            data_encoding=req.data_encoding or "v2",
+        )
+        results = SearchResults(limit=req.search_req.limit or 20)
+        self._search_block_for(meta).search(req.search_req, results,
+                                            engine=self.engine)
+        return results
+
+    # ------------------------------------------------------------------
+    # Compactor
+
+    def compact_tenant_once(self, tenant: str, now_s: int | None = None) -> BlockMeta | None:
+        now_s = int(time.time()) if now_s is None else now_s
+        inputs = self.selector.blocks_to_compact(self.blocklist.metas(tenant), now_s)
+        if not inputs:
+            return None
+        new_meta = compact_blocks(self.backend, tenant, inputs,
+                                  page_size=self.cfg.block_page_size,
+                                  search_geometry=self.cfg.search_geometry,
+                                  search_encoding=self.cfg.search_encoding)
+        from tempo_tpu.backend.types import CompactedBlockMeta
+
+        self.blocklist.update(
+            tenant, add=[new_meta], remove=inputs,
+            add_compacted=[CompactedBlockMeta.from_meta(m) for m in inputs],
+        )
+        return new_meta
+
+    def retain_tenant(self, tenant: str, now_s: int | None = None) -> tuple[int, int]:
+        now_s = int(time.time()) if now_s is None else now_s
+        return apply_retention(
+            self.backend, self.blocklist, tenant, now_s,
+            retention_s=self.cfg.retention_s,
+            compacted_retention_s=self.cfg.compacted_retention_s,
+        )

@@ -1,0 +1,22 @@
+"""Distributed service modules.
+
+Role-equivalent to the reference's modules/ tree (SURVEY.md §2.2):
+distributor → ingester → (WAL/blocks) ← querier ← frontend, with
+overrides (per-tenant limits) and the ring (placement) shared by all.
+In-process wiring lives in app.py (the "single binary" / scalable
+single-binary target); each module keeps a narrow interface so a gRPC
+boundary can replace in-process calls without touching the logic.
+"""
+
+from .overrides import Overrides, Limits
+from .ring import Ring, RingInstance
+from .distributor import Distributor
+from .ingester import Ingester
+from .querier import Querier
+from .frontend import QueryFrontend
+from .app import App, AppConfig
+
+__all__ = [
+    "Overrides", "Limits", "Ring", "RingInstance", "Distributor",
+    "Ingester", "Querier", "QueryFrontend", "App", "AppConfig",
+]

@@ -1,0 +1,132 @@
+"""Single-binary app wiring.
+
+Role-equivalent to the reference's cmd/tempo/app (modules.go dependency
+DAG, target selection): builds the full pipeline in one process —
+distributor → ring → N ingesters → shared TempoDB ← queriers ←
+frontend — plus the maintenance loops (flush sweep, blocklist poll,
+compaction, retention) exposed as explicit tick methods so tests and
+operators drive them deterministically; `run_maintenance` starts the
+background threads for real deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tempo_tpu.backend import open_backend
+from tempo_tpu.db import TempoDB, TempoDBConfig
+from .distributor import Distributor
+from .frontend import QueryFrontend, FrontendConfig
+from .ingester import Ingester
+from .overrides import Overrides, Limits
+from .querier import Querier
+from .ring import Ring
+
+
+@dataclass
+class AppConfig:
+    backend: dict = field(default_factory=lambda: {"backend": "memory"})
+    wal_dir: str = "./wal"
+    n_ingesters: int = 1
+    n_queriers: int = 1
+    replication_factor: int = 1
+    db: TempoDBConfig = field(default_factory=TempoDBConfig)
+    frontend: FrontendConfig = field(default_factory=FrontendConfig)
+    limits: Limits = field(default_factory=Limits)
+    per_tenant_overrides: dict = field(default_factory=dict)
+    flush_tick_s: float = 10.0
+    poll_tick_s: float = 30.0
+    compaction_tick_s: float = 30.0
+
+
+class App:
+    def __init__(self, cfg: AppConfig | None = None):
+        self.cfg = cfg or AppConfig()
+        self.backend = open_backend(self.cfg.backend)
+        self.overrides = Overrides(self.cfg.limits,
+                                   self.cfg.per_tenant_overrides)
+        self.ring = Ring(replication_factor=self.cfg.replication_factor)
+
+        self.ingesters: dict[str, Ingester] = {}
+        self.dbs: list[TempoDB] = []
+        for i in range(self.cfg.n_ingesters):
+            iid = f"ingester-{i}"
+            db = TempoDB(self.backend, f"{self.cfg.wal_dir}/{iid}", self.cfg.db)
+            self.dbs.append(db)
+            self.ingesters[iid] = Ingester(db, self.overrides, instance_id=iid)
+            self.ring.register(iid)
+
+        # queriers share one reader db (blocklist + staged-block cache)
+        self.reader_db = TempoDB(self.backend, f"{self.cfg.wal_dir}/querier",
+                                 self.cfg.db)
+        self.distributor = Distributor(self.ring, self.ingesters, self.overrides)
+        self.queriers = [
+            Querier(self.reader_db, self.ring, self.ingesters, self.overrides)
+            for _ in range(self.cfg.n_queriers)
+        ]
+        self.frontend = QueryFrontend(self.queriers, self.cfg.frontend)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # ---- public API surface (what api/http.py routes onto) ----
+
+    def push(self, tenant: str, batches) -> None:
+        self.distributor.push_batches(tenant, batches)
+
+    def find_trace(self, tenant: str, trace_id: bytes):
+        return self.frontend.find_trace_by_id(tenant, trace_id)
+
+    def search(self, tenant: str, req):
+        return self.frontend.search(tenant, req)
+
+    # ---- maintenance ticks ----
+
+    def flush_tick(self, force: bool = False) -> list:
+        completed = []
+        for ing in self.ingesters.values():
+            completed.extend(ing.sweep(force=force))
+        return completed
+
+    def poll_tick(self) -> None:
+        self.reader_db.poll()
+
+    def compaction_tick(self) -> None:
+        for tenant in self.reader_db.blocklist.tenants():
+            self.reader_db.compact_tenant_once(tenant)
+            self.reader_db.retain_tenant(tenant)
+
+    def heartbeat_tick(self) -> None:
+        for iid in self.ingesters:
+            self.ring.heartbeat(iid)
+        self.ring.forget_unhealthy()
+
+    # ---- lifecycle ----
+
+    def run_maintenance(self) -> None:
+        def loop(tick_s, fn):
+            def body():
+                while not self._stop.wait(tick_s):
+                    try:
+                        fn()
+                    except Exception:  # noqa: BLE001 — keep loops alive
+                        pass
+            t = threading.Thread(target=body, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+        loop(self.cfg.flush_tick_s, self.flush_tick)
+        loop(self.cfg.poll_tick_s, self.poll_tick)
+        loop(self.cfg.compaction_tick_s, self.compaction_tick)
+        loop(5.0, self.heartbeat_tick)
+
+    def shutdown(self) -> None:
+        """Graceful: flush everything, stop loops (reference /shutdown)."""
+        self._stop.set()
+        for ing in self.ingesters.values():
+            ing.flush_all()
+        self.poll_tick()
+
+    def ready(self) -> bool:
+        return self.ring.healthy_count() >= self.cfg.replication_factor

@@ -1,0 +1,143 @@
+"""Distributor: validate, rate-limit, regroup, replicate.
+
+Role-equivalent to the reference's modules/distributor
+(distributor.go:272-516, search_data.go): incoming OTLP batches are
+regrouped by trace id (one trace's spans can arrive scattered across
+batches), validated against per-tenant limits, search data is extracted
+once, segments are marshalled once, and the ring routes each trace to RF
+ingesters (write extension past unhealthy ones happens inside Ring.get).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tempo_tpu import tempopb
+from tempo_tpu.model.codec import segment_codec_for, CURRENT_ENCODING
+from tempo_tpu.model.matches import trace_range_ns
+from tempo_tpu.search.data import extract_search_data, encode_search_data
+from tempo_tpu.utils.hashing import token_for
+from tempo_tpu.utils.ids import pad_trace_id, validate_trace_id
+from .overrides import Overrides
+from .ring import Ring
+
+
+class IngestError(Exception):
+    pass
+
+
+class RateLimited(IngestError):
+    pass
+
+
+@dataclass
+class DistributorMetrics:
+    spans_received: int = 0
+    traces_pushed: int = 0
+    push_failures: int = 0
+    bytes_received: int = 0
+
+
+class Distributor:
+    def __init__(self, ring: Ring, pushers: dict, overrides: Overrides | None = None):
+        """pushers: instance id → object with push_bytes(tenant, PushBytesRequest)
+        (in-process Ingester or a gRPC client stub)."""
+        self.ring = ring
+        self.pushers = pushers
+        self.overrides = overrides or Overrides()
+        self.codec = segment_codec_for(CURRENT_ENCODING)
+        self.metrics = DistributorMetrics()
+
+    def push_batches(self, tenant: str, batches: list) -> None:
+        """The write hot path (reference PushBatches → requestsByTraceID →
+        sendToIngestersViaBytes, SURVEY.md §3.1)."""
+        if not tenant:
+            raise IngestError("missing tenant")
+        size = sum(b.ByteSize() for b in batches)
+        if not self.overrides.allow_ingestion(tenant, size):
+            self.metrics.push_failures += 1
+            raise RateLimited(f"tenant {tenant} over ingestion rate")
+        self.metrics.bytes_received += size
+
+        by_trace = self._requests_by_trace_id(batches)
+
+        lim = self.overrides.limits(tenant)
+        req_per_ingester: dict[str, tempopb.PushBytesRequest] = {}
+        trace_replicas: dict[bytes, list[str]] = {}
+        for tid, trace in by_trace.items():
+            start_ns, end_ns = trace_range_ns(trace)
+            sd = extract_search_data(
+                tid, trace, max_bytes=lim.max_search_bytes_per_trace
+            )
+            seg = self.codec.prepare_for_write(
+                trace, start_ns // 1_000_000_000, end_ns // 1_000_000_000
+            )
+            if len(seg) > lim.max_bytes_per_trace:
+                self.metrics.push_failures += 1
+                raise IngestError(
+                    f"trace {tid.hex()} exceeds max_bytes_per_trace"
+                )
+            replicas = self.ring.get(token_for(tenant, tid))
+            if not replicas:
+                raise IngestError("no healthy ingesters in ring")
+            trace_replicas[tid] = replicas
+            for iid in replicas:
+                r = req_per_ingester.setdefault(iid, tempopb.PushBytesRequest())
+                r.ids.append(tid)
+                r.traces.append(seg)
+                r.search_data.append(encode_search_data(sd))
+            self.metrics.traces_pushed += 1
+
+        errs: dict[str, Exception] = {}
+        for iid, r in req_per_ingester.items():
+            try:
+                self.pushers[iid].push_bytes(tenant, r)
+            except Exception as e:  # noqa: BLE001 — quorum semantics below
+                errs[iid] = e
+        if errs:
+            # per-trace quorum over its OWN replica set (reference
+            # ring.DoBatch tracks success per item, not per batch): a trace
+            # is durable iff a majority of its replicas took the write
+            for tid, replicas in trace_replicas.items():
+                ok = sum(1 for iid in replicas if iid not in errs)
+                if ok < len(replicas) // 2 + 1:
+                    self.metrics.push_failures += 1
+                    raise IngestError(
+                        f"push quorum failed for trace {tid.hex()}: "
+                        f"{list(errs.items())[:2]}"
+                    )
+
+    def _requests_by_trace_id(self, batches: list) -> dict:
+        """Regroup spans by trace id (reference distributor.go:442-516 —
+        the hot loop: one trace's spans arrive scattered over resource
+        batches; rebuild one Trace per id preserving resource/scope)."""
+        out: dict[bytes, tempopb.Trace] = {}
+        for batch in batches:
+            for ss in batch.scope_spans:
+                for span in ss.spans:
+                    validate_trace_id(span.trace_id)
+                    tid = pad_trace_id(span.trace_id)
+                    self.metrics.spans_received += 1
+                    trace = out.get(tid)
+                    if trace is None:
+                        trace = out[tid] = tempopb.Trace()
+                    dest = None
+                    for rb in trace.batches:
+                        if rb.resource == batch.resource:
+                            dest = rb
+                            break
+                    if dest is None:
+                        dest = trace.batches.add()
+                        dest.resource.CopyFrom(batch.resource)
+                        dest.schema_url = batch.schema_url
+                    dss = None
+                    for cand in dest.scope_spans:
+                        if cand.scope == ss.scope:
+                            dss = cand
+                            break
+                    if dss is None:
+                        dss = dest.scope_spans.add()
+                        dss.scope.CopyFrom(ss.scope)
+                        dss.schema_url = ss.schema_url
+                    dss.spans.append(span)
+        return out

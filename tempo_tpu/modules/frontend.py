@@ -1,0 +1,145 @@
+"""Query frontend: shard, dispatch, retry, merge.
+
+Role-equivalent to the reference's modules/frontend (frontend.go,
+tracebyidsharding.go:30-48, searchsharding.go:163-407, retry.go):
+trace-by-ID requests shard into block-id-range sub-queries plus an
+ingester query; search requests shard into per-block SearchBlockRequest
+jobs plus one recent/ingester request; sub-requests run with bounded
+concurrency, retry on failure, and merge (trace combine / result dedupe +
+metrics sum).
+
+In-process the "queue" is a worker pool; the same job protocol maps onto
+the reference's queue + querier-worker pull model for multi-process
+deployments.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass
+
+from tempo_tpu import tempopb
+from tempo_tpu.db.pool import run_jobs
+from tempo_tpu.model.codec import codec_for, CURRENT_ENCODING
+from tempo_tpu.model.combine import combine_trace_protos
+from tempo_tpu.search import SearchResults
+
+
+@dataclass
+class FrontendConfig:
+    query_shards: int = 20           # reference default, 2-256
+    max_concurrent_jobs: int = 50    # reference: bounded fan-out 50
+    retries: int = 2                 # reference retry ware
+    tolerate_failed_blocks: int = 0
+
+
+def create_block_boundaries(shards: int) -> list[str]:
+    """Split the 128-bit block-id (uuid) space into `shards` ranges
+    (reference tracebyidsharding.go createBlockBoundaries)."""
+    bounds = []
+    step = (1 << 128) // max(1, shards)
+    for i in range(shards + 1):
+        v = min(i * step, (1 << 128) - 1)
+        bounds.append(str(uuid.UUID(int=v)))
+    bounds[-1] = "ffffffff-ffff-ffff-ffff-ffffffffffff"
+    return bounds
+
+
+class QueryFrontend:
+    def __init__(self, queriers: list, cfg: FrontendConfig | None = None):
+        """queriers: round-robin pool of Querier-interface objects."""
+        self.queriers = queriers
+        self.cfg = cfg or FrontendConfig()
+        self._rr = 0
+
+    def _querier(self):
+        q = self.queriers[self._rr % len(self.queriers)]
+        self._rr += 1
+        return q
+
+    def _retrying(self, fn, job):
+        last = None
+        for _ in range(self.cfg.retries + 1):
+            try:
+                return fn(job)
+            except Exception as e:  # noqa: BLE001 — retried, then surfaced
+                last = e
+        raise last
+
+    # ---- trace by id (reference frontend.go:91-176) ----
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes) -> tempopb.TraceByIDResponse:
+        bounds = create_block_boundaries(self.cfg.query_shards - 1)
+        jobs = [("ingesters", "", "")] + [
+            ("blocks", bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)
+        ]
+
+        def run(job):
+            mode, start, end = job
+            return self._retrying(
+                lambda j: self._querier().find_trace_by_id(
+                    tenant, trace_id, block_start=j[1], block_end=j[2], mode=j[0]
+                ),
+                job,
+            )
+
+        responses, errors = run_jobs(jobs, run,
+                                     workers=self.cfg.max_concurrent_jobs)
+        failed = sum(r.metrics.failed_blocks for r in responses) + len(errors)
+        if errors and failed > self.cfg.tolerate_failed_blocks:
+            raise errors[0]
+
+        out = tempopb.TraceByIDResponse()
+        out.metrics.failed_blocks = failed
+        partials = [r.trace for r in responses if len(r.trace.batches)]
+        if partials:
+            out.trace.CopyFrom(combine_trace_protos(partials))
+        return out
+
+    # ---- search (reference searchsharding.go:163-306) ----
+
+    def search(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
+        db = self.queriers[0].db  # block metas come from the shared reader
+        metas = [
+            m for m in db.blocklist.metas(tenant)
+            if not (req.start and m.end_time and m.end_time < req.start)
+            and not (req.end and m.start_time and m.start_time > req.end)
+        ]
+
+        jobs = [("recent", None)] + [("block", m) for m in metas]
+
+        def run(job):
+            kind, m = job
+            if kind == "recent":
+                return self._retrying(
+                    lambda _: self._querier().search_recent(tenant, req), job
+                )
+            breq = tempopb.SearchBlockRequest()
+            breq.search_req.CopyFrom(req)
+            breq.tenant_id = tenant
+            breq.block_id = m.block_id
+            breq.encoding = "zstd"
+            breq.version = m.version
+            breq.data_encoding = m.data_encoding
+            return self._retrying(
+                lambda _: self._querier().search_block(breq), job
+            )
+
+        responses, errors = run_jobs(jobs, run,
+                                     workers=self.cfg.max_concurrent_jobs)
+        # partial failures past the tolerance are an error, not a silently
+        # smaller answer (reference tolerate_failed_blocks → HTTP 206/5xx)
+        if len(errors) > self.cfg.tolerate_failed_blocks:
+            raise errors[0]
+
+        merged = SearchResults(limit=req.limit or 20)
+        merged.metrics.skipped_blocks += len(errors)  # tolerated failures
+        for r in responses:
+            for t in r.traces:
+                merged.add(t)
+            m = merged.metrics
+            m.inspected_traces += r.metrics.inspected_traces
+            m.inspected_bytes += r.metrics.inspected_bytes
+            m.inspected_blocks += r.metrics.inspected_blocks
+            m.skipped_blocks += r.metrics.skipped_blocks
+        return merged.response()

@@ -1,0 +1,324 @@
+"""Ingester: live traces → WAL head block → completed backend blocks.
+
+Role-equivalent to the reference's modules/ingester (ingester.go:53-416,
+instance.go:92-661, flush.go:124-389): per-tenant instances hold live
+traces in memory under byte/count limits; a sweep cuts idle/complete
+traces into the WAL head block (trace WAL + parallel search WAL); when the
+head block is big or old enough it is cut and completed into an immutable
+backend block; on restart both WALs replay (SURVEY.md §5 checkpoint).
+
+Divergence from the reference: completed blocks go straight to the shared
+backend via TempoDB.complete_block (the reference stages them on an
+ingester-local backend first and flushes async with retry/backoff —
+flush.go opKindComplete/opKindFlush; collapse is safe in-process because
+the backend write is atomic, and the retry queue lives one level up).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tempo_tpu import tempopb
+from tempo_tpu.db import TempoDB
+from tempo_tpu.model.codec import segment_codec_for, CURRENT_ENCODING
+from tempo_tpu.search import SearchResults, decode_search_data
+from tempo_tpu.search.data import SearchData, search_data_matches
+from tempo_tpu.search.streaming import StreamingSearchBlock, _meta_from_sd
+from tempo_tpu.utils.ids import pad_trace_id
+from .overrides import Overrides
+
+
+class LimitError(Exception):
+    pass
+
+
+@dataclass
+class _LiveTrace:
+    segments: list = field(default_factory=list)
+    nbytes: int = 0
+    last_append: float = 0.0
+    search: SearchData | None = None
+
+
+class TenantInstance:
+    # completed blocks stay queryable on the ingester until readers have
+    # had time to poll the new block into their blocklists (reference
+    # complete_block_timeout, instance.ClearFlushedBlocks :373)
+    COMPLETE_BLOCK_TIMEOUT_S = 300.0
+
+    def __init__(self, tenant: str, db: TempoDB, overrides: Overrides):
+        self.tenant = tenant
+        self.db = db
+        self.overrides = overrides
+        self.lock = threading.Lock()
+        self.live: dict[bytes, _LiveTrace] = {}
+        self.codec = segment_codec_for(CURRENT_ENCODING)
+        self._new_head()
+        self.completing = []  # [(AppendBlock, StreamingSearchBlock)]
+        self.recent = []      # [(BlockMeta, completed_at)]
+
+    def _new_head(self):
+        self.head = self.db.wal.new_block(self.tenant)
+        self.head_search = StreamingSearchBlock(self.head.path + ".search")
+        self.head_created = time.monotonic()
+
+    # ---- write path ----
+
+    def push(self, trace_id: bytes, segment: bytes,
+             search_data: bytes = b"") -> None:
+        tid = pad_trace_id(trace_id)
+        lim = self.overrides.limits(self.tenant)
+        with self.lock:
+            t = self.live.get(tid)
+            if t is None:
+                if len(self.live) >= lim.max_live_traces:
+                    raise LimitError(
+                        f"max live traces ({lim.max_live_traces}) reached"
+                    )
+                t = self.live[tid] = _LiveTrace()
+            if t.nbytes + len(segment) > lim.max_bytes_per_trace:
+                raise LimitError("max bytes per trace reached")
+            t.segments.append(segment)
+            t.nbytes += len(segment)
+            t.last_append = time.monotonic()
+            if search_data:
+                sd = decode_search_data(search_data, tid)
+                if t.search is None:
+                    t.search = sd
+                else:
+                    t.search.merge(sd)
+
+    # ---- sweep / cut (reference CutCompleteTraces instance.go:222) ----
+
+    def cut_complete_traces(self, max_idle_s: float = 10.0,
+                            force: bool = False) -> int:
+        now = time.monotonic()
+        cut = 0
+        with self.lock:
+            for tid in list(self.live):
+                t = self.live[tid]
+                if not force and now - t.last_append < max_idle_s:
+                    continue
+                obj = self.codec.to_object(t.segments)
+                r = self.codec.fast_range(obj) or (0, 0)
+                self.head.append(tid, obj, r[0], r[1])
+                if t.search is not None:
+                    self.head_search.append(tid, t.search)
+                del self.live[tid]
+                cut += 1
+        return cut
+
+    def cut_block_if_ready(self, max_block_bytes: int = 500 << 20,
+                           max_block_age_s: float = 1800.0,
+                           force: bool = False) -> bool:
+        with self.lock:
+            if len(self.head) == 0:
+                return False
+            age = time.monotonic() - self.head_created
+            if not (force or self.head.data_length >= max_block_bytes
+                    or age >= max_block_age_s):
+                return False
+            self.completing.append((self.head, self.head_search))
+            self._new_head()
+            return True
+
+    def complete_one(self) -> "tempopb.Trace | None":
+        """Complete the oldest completing block to the backend and clear
+        its WAL files (reference handleComplete flush.go:235-281). On a
+        backend failure the block is RESTORED to the completing queue so a
+        later sweep retries it (reference flush backoff :359-389)."""
+        with self.lock:
+            if not self.completing:
+                return None
+            blk, search = self.completing.pop(0)
+        try:
+            meta = self.db.complete_block(blk, search.entries())
+        except Exception:
+            with self.lock:
+                self.completing.insert(0, (blk, search))
+            raise
+        blk.clear()
+        search.clear()
+        with self.lock:
+            self.recent.append((meta, time.monotonic()))
+        return meta
+
+    def clear_flushed(self) -> None:
+        """Drop completed blocks past the query-visibility window."""
+        cutoff = time.monotonic() - self.COMPLETE_BLOCK_TIMEOUT_S
+        with self.lock:
+            self.recent = [(m, t) for m, t in self.recent if t > cutoff]
+
+    # ---- read path (reference instance.FindTraceByID :406) ----
+
+    def find(self, trace_id: bytes) -> list[bytes]:
+        tid = pad_trace_id(trace_id)
+        partials = []
+        with self.lock:
+            t = self.live.get(tid)
+            if t is not None and t.segments:
+                partials.append(self.codec.to_object(list(t.segments)))
+            heads = [self.head] + [b for b, _ in self.completing]
+            recent = [m for m, _ in self.recent]
+        for blk in heads:
+            obj = blk.find(tid)
+            if obj is not None:
+                partials.append(obj)
+        # recently completed blocks: cover the reader's blocklist-poll gap
+        from tempo_tpu.encoding.v2 import BackendBlock
+
+        for meta in recent:
+            try:
+                obj = BackendBlock(self.db.backend, meta).find_by_id(tid)
+            except Exception:  # noqa: BLE001 — backend flake → partial
+                continue
+            if obj is not None:
+                partials.append(obj)
+        return partials
+
+    def search(self, req, results: SearchResults) -> None:
+        with self.lock:
+            live_sds = [t.search for t in self.live.values() if t.search]
+            searches = [self.head_search] + [s for _, s in self.completing]
+            recent = [m for m, _ in self.recent]
+        for sd in live_sds:
+            results.metrics.inspected_traces += 1
+            if search_data_matches(sd, req):
+                results.add(_meta_from_sd(sd))
+                if results.complete:
+                    return
+        for ssb in searches:
+            ssb.search(req, results)
+            if results.complete:
+                return
+        for meta in recent:  # blocklist-poll gap, as in find()
+            try:
+                self.db._search_block_for(meta).search(req, results)  # noqa: SLF001
+            except Exception:  # noqa: BLE001
+                continue
+            if results.complete:
+                return
+
+    def search_tags(self) -> set:
+        tags = set()
+        with self.lock:
+            for t in self.live.values():
+                if t.search:
+                    tags.update(t.search.kvs)
+            for ssb in [self.head_search] + [s for _, s in self.completing]:
+                for sd in ssb.entries():
+                    tags.update(sd.kvs)
+        return tags
+
+    def search_tag_values(self, tag: str, max_bytes: int) -> set:
+        vals: set[str] = set()
+        size = 0
+        with self.lock:
+            sds = [t.search for t in self.live.values() if t.search]
+            for ssb in [self.head_search] + [s for _, s in self.completing]:
+                sds.extend(ssb.entries())
+        for sd in sds:
+            for v in sd.kvs.get(tag, ()):
+                if v not in vals:
+                    size += len(v)
+                    if size > max_bytes:
+                        return vals
+                    vals.add(v)
+        return vals
+
+
+class Ingester:
+    """One ingester process: tenant instances + flush machinery + replay."""
+
+    def __init__(self, db: TempoDB, overrides: Overrides | None = None,
+                 instance_id: str = "ingester-0"):
+        self.db = db
+        self.overrides = overrides or Overrides()
+        self.id = instance_id
+        self._instances: dict[str, TenantInstance] = {}
+        self._lock = threading.Lock()
+        self.replayed_blocks = 0
+        self._replay()
+
+    def instance(self, tenant: str) -> TenantInstance:
+        with self._lock:
+            inst = self._instances.get(tenant)
+            if inst is None:
+                inst = self._instances[tenant] = TenantInstance(
+                    tenant, self.db, self.overrides
+                )
+            return inst
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return sorted(self._instances)
+
+    # ---- gRPC-facing surface (Pusher/Querier services) ----
+
+    def push_bytes(self, tenant: str, req: tempopb.PushBytesRequest) -> None:
+        inst = self.instance(tenant)
+        for tid, seg, sd in zip(req.ids, req.traces, req.search_data):
+            inst.push(tid, seg, sd)
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes) -> list[bytes]:
+        with self._lock:
+            inst = self._instances.get(tenant)
+        return inst.find(trace_id) if inst else []
+
+    def search(self, tenant: str, req, results: SearchResults) -> None:
+        with self._lock:
+            inst = self._instances.get(tenant)
+        if inst:
+            inst.search(req, results)
+
+    # ---- flush machinery (reference ingester.loop flush.go:144-218) ----
+
+    def sweep(self, max_idle_s: float = 10.0, force: bool = False,
+              max_block_bytes: int = 500 << 20,
+              max_block_age_s: float = 1800.0) -> list:
+        """One flush-loop tick: cut idle traces, cut ready blocks, complete
+        them. Returns completed block metas."""
+        completed = []
+        for tenant in self.tenants():
+            inst = self.instance(tenant)
+            inst.cut_complete_traces(max_idle_s=max_idle_s, force=force)
+            inst.cut_block_if_ready(max_block_bytes=max_block_bytes,
+                                    max_block_age_s=max_block_age_s,
+                                    force=force)
+            while True:
+                try:
+                    meta = inst.complete_one()
+                except Exception:  # noqa: BLE001 — block restored, retried next tick
+                    break
+                if meta is None:
+                    break
+                completed.append(meta)
+            inst.clear_flushed()
+        return completed
+
+    def flush_all(self) -> list:
+        """Graceful shutdown / scale-down: force everything to the backend
+        (reference /shutdown handler flush.go:91-115)."""
+        return self.sweep(force=True)
+
+    # ---- replay (reference replayWal ingester.go:327-416) ----
+
+    def _replay(self) -> None:
+        blocks, _removed = self.db.wal.replay_all()
+        for blk in blocks:
+            tenant = blk.meta.tenant_id
+            inst = self.instance(tenant)
+            import os
+
+            spath = blk.path + ".search"
+            if os.path.exists(spath):
+                ssb = StreamingSearchBlock.rescan(spath)
+            else:
+                ssb = StreamingSearchBlock(spath)
+            # replayed head blocks go straight to completing: they will be
+            # completed by the next sweep (reference re-enqueues completion
+            # ops for replayed blocks)
+            inst.completing.append((blk, ssb))
+            self.replayed_blocks += 1

@@ -1,0 +1,83 @@
+"""Per-tenant runtime limits.
+
+Role-equivalent to the reference's modules/overrides (limits.go:46-96,
+overrides.go:30-55): global defaults + hot-reloadable per-tenant
+overrides; ingestion rate limiting is a token bucket (the reference uses
+golang.org/x/time/rate with local/global strategies — the global strategy
+divides the rate by the distributor count, distributor/ingestion_rate_strategy.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field, replace
+
+
+@dataclass(frozen=True)
+class Limits:
+    # reference defaults: limits.go:85-96
+    ingestion_rate_bytes: int = 15_000_000
+    ingestion_burst_bytes: int = 20_000_000
+    max_live_traces: int = 10_000
+    max_bytes_per_trace: int = 5_000_000
+    max_search_bytes_per_trace: int = 5_000
+    max_bytes_per_tag_values: int = 5_000_000
+    block_retention_s: int = 0  # 0 → use the db default
+    ingestion_rate_strategy: str = "local"  # or "global"
+
+
+class _TokenBucket:
+    def __init__(self, rate: float, burst: float):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.t = time.monotonic()
+        self.lock = threading.Lock()
+
+    def allow(self, n: float) -> bool:
+        with self.lock:
+            now = time.monotonic()
+            self.tokens = min(self.burst, self.tokens + (now - self.t) * self.rate)
+            self.t = now
+            if n <= self.tokens:
+                self.tokens -= n
+                return True
+            return False
+
+
+class Overrides:
+    def __init__(self, defaults: Limits | None = None,
+                 per_tenant: dict[str, dict] | None = None,
+                 distributor_count=lambda: 1):
+        self.defaults = defaults or Limits()
+        self._per_tenant = dict(per_tenant or {})
+        self._buckets: dict[str, _TokenBucket] = {}
+        self._lock = threading.Lock()
+        self._distributor_count = distributor_count
+
+    def limits(self, tenant: str) -> Limits:
+        over = self._per_tenant.get(tenant)
+        if not over:
+            return self.defaults
+        return replace(self.defaults, **{
+            k: v for k, v in over.items() if k in Limits.__dataclass_fields__
+        })
+
+    def reload(self, per_tenant: dict[str, dict]) -> None:
+        """Hot reload (reference: runtimeconfig poll every 10s)."""
+        with self._lock:
+            self._per_tenant = dict(per_tenant)
+            self._buckets.clear()
+
+    def allow_ingestion(self, tenant: str, nbytes: int) -> bool:
+        lim = self.limits(tenant)
+        rate = lim.ingestion_rate_bytes
+        if lim.ingestion_rate_strategy == "global":
+            rate = max(1.0, rate / max(1, self._distributor_count()))
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is None or b.rate != rate:
+                b = _TokenBucket(rate, lim.ingestion_burst_bytes)
+                self._buckets[tenant] = b
+        return b.allow(nbytes)

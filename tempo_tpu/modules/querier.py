@@ -1,0 +1,134 @@
+"""Querier: stateless read worker.
+
+Role-equivalent to the reference's modules/querier (querier.go:60-452):
+trace-by-ID queries the ingester replica set AND the backend blocklist,
+combining partials; SearchRecent fans out to ingesters; SearchBlock
+executes one frontend-sharded job against the TPU engine; tag queries
+aggregate ingester + block dictionaries under byte limits.
+"""
+
+from __future__ import annotations
+
+from tempo_tpu import tempopb
+from tempo_tpu.db import TempoDB
+from tempo_tpu.model.codec import codec_for, CURRENT_ENCODING
+from tempo_tpu.model.matches import trace_search_metadata
+from tempo_tpu.search import SearchResults
+from tempo_tpu.utils.hashing import token_for
+from tempo_tpu.utils.ids import pad_trace_id
+from .overrides import Overrides
+from .ring import Ring
+
+QUERY_MODE_INGESTERS = "ingesters"
+QUERY_MODE_BLOCKS = "blocks"
+QUERY_MODE_ALL = "all"
+
+
+class Querier:
+    def __init__(self, db: TempoDB, ring: Ring, ingesters: dict,
+                 overrides: Overrides | None = None):
+        """ingesters: instance id → object with find_trace_by_id/search/
+        instance() (in-process Ingester or gRPC stub)."""
+        self.db = db
+        self.ring = ring
+        self.ingesters = ingesters
+        self.overrides = overrides or Overrides()
+
+    # ---- trace by id (reference querier.go:171-249) ----
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes,
+                         block_start: str = "", block_end: str = "",
+                         mode: str = QUERY_MODE_ALL) -> tempopb.TraceByIDResponse:
+        tid = pad_trace_id(trace_id)
+        partials: list[bytes] = []
+        failed = 0
+
+        if mode in (QUERY_MODE_INGESTERS, QUERY_MODE_ALL):
+            replicas = self.ring.get(token_for(tenant, tid))
+            for iid in replicas:
+                ing = self.ingesters.get(iid)
+                if ing is None:
+                    failed += 1
+                    continue
+                try:
+                    partials.extend(ing.find_trace_by_id(tenant, tid))
+                except Exception:  # noqa: BLE001 — replica failure → partial
+                    failed += 1
+
+        if mode in (QUERY_MODE_BLOCKS, QUERY_MODE_ALL):
+            obj, block_failed = self.db.find_trace_by_id(
+                tenant, tid, block_start, block_end
+            )
+            failed += block_failed
+            if obj is not None:
+                partials.append(obj)
+
+        resp = tempopb.TraceByIDResponse()
+        resp.metrics.failed_blocks = failed
+        if partials:
+            codec = codec_for(CURRENT_ENCODING)
+            obj = partials[0] if len(partials) == 1 else codec.combine(*partials)
+            resp.trace.CopyFrom(codec.prepare_for_read(obj))
+        return resp
+
+    # ---- search (reference SearchRecent :278, SearchBlock :397) ----
+
+    def search_recent(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
+        results = SearchResults(limit=req.limit or 20)
+        for ing in self.ingesters.values():
+            ing.search(tenant, req, results)
+            if results.complete:
+                break
+        return results.response()
+
+    def search_block(self, req: tempopb.SearchBlockRequest) -> tempopb.SearchResponse:
+        return self.db.search_block(req).response()
+
+    # ---- tags ----
+
+    def search_tags(self, tenant: str) -> tempopb.SearchTagsResponse:
+        tags: set[str] = set()
+        for ing in self.ingesters.values():
+            inst = ing._instances.get(tenant)  # noqa: SLF001 — in-process fast path
+            if inst:
+                tags.update(inst.search_tags())
+        for m in self.db.blocklist.metas(tenant):
+            try:
+                sp = self.db._search_block_for(m).staged()  # noqa: SLF001
+                tags.update(sp.pages.key_dict)
+            except Exception:  # noqa: BLE001 — blocks without search data
+                continue
+        resp = tempopb.SearchTagsResponse()
+        resp.tag_names.extend(sorted(tags))
+        return resp
+
+    def search_tag_values(self, tenant: str, tag: str) -> tempopb.SearchTagValuesResponse:
+        lim = self.overrides.limits(tenant)
+        vals: set[str] = set()
+        size = 0
+        for ing in self.ingesters.values():
+            inst = ing._instances.get(tenant)  # noqa: SLF001
+            if inst:
+                vals.update(inst.search_tag_values(tag, lim.max_bytes_per_tag_values))
+        for m in self.db.blocklist.metas(tenant):
+            try:
+                sp = self.db._search_block_for(m).staged()  # noqa: SLF001
+            except Exception:  # noqa: BLE001
+                continue
+            pages = sp.pages
+            if tag not in pages.key_dict:
+                continue
+            import numpy as np
+
+            kid = pages.key_dict.index(tag)
+            hit_vals = np.unique(pages.kv_val[pages.kv_key == kid])
+            for v in hit_vals.tolist():
+                if v >= 0:
+                    s = pages.val_dict[v]
+                    size += len(s)
+                    if size > lim.max_bytes_per_tag_values:
+                        break
+                    vals.add(s)
+        resp = tempopb.SearchTagValuesResponse()
+        resp.tag_values.extend(sorted(vals))
+        return resp

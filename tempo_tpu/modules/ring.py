@@ -1,0 +1,124 @@
+"""Consistent-hash ring for write placement and job ownership.
+
+Role-equivalent to the reference's dskit ring + lifecycler (SURVEY.md §2.5
+write replication row): instances register token sets; a key's token walks
+the ring clockwise collecting the first RF distinct healthy instances
+(replication set). Also provides `owns` for compactor-style job-ownership
+sharding (modules/compactor/compactor.go:186-221).
+
+This is the in-process implementation; the interface (register/heartbeat/
+get/owns) is what a memberlist-gossip backend would implement for
+multi-process deployments.
+"""
+
+from __future__ import annotations
+
+import bisect
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+DEFAULT_TOKENS = 128
+HEARTBEAT_TIMEOUT_S = 60
+
+
+@dataclass
+class RingInstance:
+    id: str
+    tokens: list = field(default_factory=list)
+    last_heartbeat: float = 0.0
+    state: str = "ACTIVE"  # ACTIVE | LEAVING
+
+    def healthy(self, now: float, timeout: float = HEARTBEAT_TIMEOUT_S) -> bool:
+        return self.state == "ACTIVE" and now - self.last_heartbeat < timeout
+
+
+class Ring:
+    def __init__(self, replication_factor: int = 3):
+        self.rf = replication_factor
+        self._lock = threading.Lock()
+        self._instances: dict[str, RingInstance] = {}
+        self._tokens: list[tuple[int, str]] = []  # sorted (token, instance id)
+
+    # ---- membership (lifecycler role) ----
+
+    def register(self, instance_id: str, n_tokens: int = DEFAULT_TOKENS,
+                 seed: int | None = None) -> RingInstance:
+        rng = random.Random(seed if seed is not None else instance_id)
+        inst = RingInstance(
+            id=instance_id,
+            tokens=sorted(rng.randrange(2**32) for _ in range(n_tokens)),
+            last_heartbeat=time.monotonic(),
+        )
+        with self._lock:
+            self._instances[instance_id] = inst
+            self._rebuild()
+        return inst
+
+    def heartbeat(self, instance_id: str) -> None:
+        with self._lock:
+            if instance_id in self._instances:
+                self._instances[instance_id].last_heartbeat = time.monotonic()
+
+    def leave(self, instance_id: str) -> None:
+        with self._lock:
+            self._instances.pop(instance_id, None)
+            self._rebuild()
+
+    def forget_unhealthy(self) -> list[str]:
+        """Auto-forget (reference: compactor/generator rings)."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [i for i, inst in self._instances.items()
+                    if not inst.healthy(now)]
+            for i in dead:
+                del self._instances[i]
+            if dead:
+                self._rebuild()
+        return dead
+
+    def _rebuild(self) -> None:
+        self._tokens = sorted(
+            (t, i) for i, inst in self._instances.items() for t in inst.tokens
+        )
+
+    # ---- placement ----
+
+    def get(self, token: int, rf: int | None = None) -> list[str]:
+        """Replication set: first `rf` distinct healthy instances clockwise
+        from token. Unhealthy instances are skipped (write extension,
+        reference distributor.go:359-362)."""
+        rf = rf or self.rf
+        now = time.monotonic()
+        with self._lock:
+            if not self._tokens:
+                return []
+            out: list[str] = []
+            start = bisect.bisect_left(self._tokens, (token & 0xFFFFFFFF, ""))
+            n = len(self._tokens)
+            for k in range(n):
+                _, iid = self._tokens[(start + k) % n]
+                if iid in out:
+                    continue
+                if not self._instances[iid].healthy(now):
+                    continue
+                out.append(iid)
+                if len(out) >= rf:
+                    break
+            return out
+
+    def owns(self, instance_id: str, token: int) -> bool:
+        """Job-ownership: does this instance lead the replica set for the
+        token?"""
+        got = self.get(token, rf=1)
+        return bool(got) and got[0] == instance_id
+
+    def healthy_count(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            return sum(1 for i in self._instances.values() if i.healthy(now))
+
+    def instance_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._instances)

@@ -181,6 +181,46 @@ class ColumnarPages:
         )
 
     # ------------------------------------------------------------------
+    # decode back to entries (search-block compaction: the reference never
+    # compacts search data — its search blocks just age out, SURVEY.md §3.5;
+    # we rebuild the merged block's search data from the inputs instead)
+
+    def to_entries(self) -> list:
+        """Vectorized: touch only valid entries and real (non-pad) kv
+        slots — interpreter work is O(live data), not O(P*E*C)."""
+        E = self.entry_valid.shape[1]
+        ps, es = np.nonzero(self.entry_valid)
+        starts = self.entry_start[ps, es].tolist()
+        ends = self.entry_end[ps, es].tolist()
+        durs = self.entry_dur[ps, es].tolist()
+        svcs = self.entry_root_svc[ps, es].tolist()
+        names = self.entry_root_name[ps, es].tolist()
+        tids = self.trace_ids[ps, es]  # [N,16]
+
+        slot_index = {}
+        out = []
+        for i in range(len(ps)):
+            sd = SearchData(
+                trace_id=tids[i].tobytes(),
+                start_s=starts[i], end_s=ends[i], dur_ms=durs[i],
+            )
+            if svcs[i] >= 0:
+                sd.root_service = self.val_dict[svcs[i]]
+            if names[i] >= 0:
+                sd.root_name = self.val_dict[names[i]]
+            out.append(sd)
+            slot_index[(int(ps[i]), int(es[i]))] = sd
+
+        kp, ke, _kc = np.nonzero(self.kv_key >= 0)
+        kkeys = self.kv_key[self.kv_key >= 0].tolist()
+        kvals = self.kv_val[self.kv_key >= 0].tolist()
+        for p, e, k, v in zip(kp.tolist(), ke.tolist(), kkeys, kvals):
+            sd = slot_index.get((p, e))
+            if sd is not None:
+                sd.kvs.setdefault(self.key_dict[k], set()).add(self.val_dict[v])
+        return out
+
+    # ------------------------------------------------------------------
     # container codec
 
     _ARRAYS = (

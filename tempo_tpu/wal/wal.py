@@ -177,9 +177,15 @@ class WAL:
         (reference wal.go:119-143 corrupt-file removal)."""
         blocks: list[AppendBlock] = []
         removed: list[str] = []
+        sidecars: list[str] = []
         for name in sorted(os.listdir(self.dir)):
             path = os.path.join(self.dir, name)
             if not os.path.isfile(path):
+                continue
+            if name.endswith(".search"):
+                # search-WAL sidecars replay with their paired trace block
+                # (ingester pairs them by path), never on their own
+                sidecars.append(name)
                 continue
             try:
                 meta = parse_wal_filename(name)
@@ -192,4 +198,10 @@ class WAL:
                 removed.append(name)
                 continue
             blocks.append(AppendBlock(self.dir, meta, _replay=True))
+        # sidecars whose paired trace WAL is gone would otherwise leak forever
+        kept = {os.path.basename(b.path) for b in blocks}
+        for name in sidecars:
+            if name[: -len(".search")] not in kept:
+                os.unlink(os.path.join(self.dir, name))
+                removed.append(name)
         return blocks, removed

@@ -1,0 +1,229 @@
+import time
+
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.backend import BlockMeta, LocalBackend, MockBackend, DoesNotExist
+from tempo_tpu.db import TempoDB, TempoDBConfig, Poller, TimeWindowBlockSelector
+from tempo_tpu.db.pool import run_jobs
+from tempo_tpu.model import codec_for, segment_codec_for
+from tempo_tpu.search import extract_search_data
+from tempo_tpu.utils.ids import random_trace_id
+from tempo_tpu.utils.test_data import make_trace
+
+from tests.test_search import _mk_req
+
+
+def _ingest(db, tenant, n, seed_base=0):
+    """Push n traces through WAL + search extraction, complete the block."""
+    blk = db.wal.new_block(tenant)
+    sc = segment_codec_for("v2")
+    entries = {}
+    traces = {}
+    for i in range(n):
+        tid = random_trace_id()
+        tr = make_trace(tid, seed=seed_base + i)
+        sd = extract_search_data(tid, tr)
+        seg = sc.prepare_for_write(tr, sd.start_s, sd.end_s)
+        blk.append(tid, seg, sd.start_s, sd.end_s)
+        entries[tid] = sd
+        traces[tid] = tr
+    meta = db.complete_block(
+        blk, [entries[t] for t in sorted(entries)]
+    )
+    blk.clear()
+    return meta, traces
+
+
+def _db(tmp_path, **cfg):
+    be = LocalBackend(str(tmp_path / "blocks"))
+    return TempoDB(be, str(tmp_path / "wal"), TempoDBConfig(**cfg))
+
+
+def test_run_jobs_early_stop_and_errors():
+    calls = []
+
+    def fn(x):
+        calls.append(x)
+        if x == 3:
+            raise RuntimeError("boom")
+        return x if x == 5 else None
+
+    results, errors = run_jobs(list(range(10)), fn, workers=1, stop_on_first=True)
+    assert results == [5]
+    assert len(errors) == 1
+    assert len(calls) <= 7  # stopped early
+
+
+def test_complete_block_and_find(tmp_path):
+    db = _db(tmp_path)
+    meta, traces = _ingest(db, "t1", 50)
+    assert meta.total_objects == 50
+
+    c = codec_for("v2")
+    for tid, tr in list(traces.items())[:10]:
+        obj, failed = db.find_trace_by_id("t1", tid)
+        assert obj is not None and failed == 0
+        assert c.prepare_for_read(obj) == tr
+    assert db.find_trace_by_id("t1", b"\x42" * 16)[0] is None
+
+
+def test_find_combines_across_blocks(tmp_path):
+    """Same trace id in two blocks (pre-compaction) → combined on read."""
+    db = _db(tmp_path)
+    tid = random_trace_id()
+    sc = segment_codec_for("v2")
+    for seed in (1, 2):
+        blk = db.wal.new_block("t1")
+        tr = make_trace(tid, seed=seed, batches=1)
+        blk.append(tid, sc.prepare_for_write(tr, 10, 20), 10, 20)
+        db.complete_block(blk)
+        blk.clear()
+    obj, _ = db.find_trace_by_id("t1", tid)
+    got = codec_for("v2").prepare_for_read(obj)
+    assert len(got.batches) == 2
+
+
+def test_search_across_blocks_with_limit(tmp_path):
+    db = _db(tmp_path)
+    for i in range(3):
+        _ingest(db, "t1", 40, seed_base=i * 100)
+    req = _mk_req({})  # match-all
+    req.limit = 25
+    res = db.search("t1", req)
+    resp = res.response()
+    assert len(resp.traces) == 25
+    # early stop: not all 3 blocks necessarily inspected
+    assert resp.metrics.inspected_blocks <= 3
+
+
+def test_search_block_request_protocol(tmp_path):
+    db = _db(tmp_path)
+    meta, traces = _ingest(db, "t1", 30)
+    req = tempopb.SearchBlockRequest()
+    req.tenant_id = "t1"
+    req.block_id = meta.block_id
+    req.encoding = db.cfg.search_encoding
+    req.version = meta.version
+    req.data_encoding = meta.data_encoding
+    req.search_req.limit = 50
+    res = db.search_block(req)
+    assert len(res.response().traces) == 30
+
+
+def test_poller_tenant_index_roundtrip(tmp_path):
+    db = _db(tmp_path)
+    _ingest(db, "t1", 5)
+    _ingest(db, "t2", 3)
+    metas, compacted = db.poller.poll()
+    assert {t: len(m) for t, m in metas.items()} == {"t1": 1, "t2": 1}
+
+    # a reader (non-builder) uses the index written by the builder
+    reader = Poller(db.backend, build_index=False)
+    m2, c2 = reader.poll()
+    assert [m.block_id for m in m2["t1"]] == [m.block_id for m in metas["t1"]]
+
+    db.poll()
+    assert db.blocklist.tenants() == ["t1", "t2"]
+
+
+def test_selector_groups_by_level_and_window():
+    sel = TimeWindowBlockSelector(window_s=100, min_inputs=2, max_inputs=3)
+    now = 10_000
+
+    def meta(end, level=0, size=10):
+        m = BlockMeta(tenant_id="t", compaction_level=level)
+        m.end_time = end
+        m.size = size
+        return m
+
+    # 4 blocks in one window, level 0 → picks 3 (max_inputs)
+    metas = [meta(9_950) for _ in range(4)]
+    picked = sel.blocks_to_compact(metas, now)
+    assert len(picked) == 3
+
+    # different levels in active window don't mix
+    metas = [meta(9_950, level=0), meta(9_950, level=1)]
+    assert sel.blocks_to_compact(metas, now) == []
+
+    # outside the active window levels DO mix
+    old = now - 25 * 3600
+    metas = [meta(old, level=0), meta(old, level=1)]
+    assert len(sel.blocks_to_compact(metas, now)) == 2
+
+    # single block never compacts
+    assert sel.blocks_to_compact([meta(9_950)], now) == []
+
+
+def test_compaction_merges_and_dedupes(tmp_path):
+    db = _db(tmp_path, compaction_window_s=10_000_000_000)
+    shared = random_trace_id()
+    sc = segment_codec_for("v2")
+
+    metas = []
+    for seed in (1, 2):
+        blk = db.wal.new_block("t1")
+        tr = make_trace(shared, seed=seed, batches=1)
+        blk.append(shared, sc.prepare_for_write(tr, 100, 200), 100, 200)
+        for i in range(10):
+            tid = random_trace_id()
+            tr = make_trace(tid, seed=seed * 50 + i)
+            sd = extract_search_data(tid, tr)
+            blk.append(tid, sc.prepare_for_write(tr, sd.start_s, sd.end_s),
+                       sd.start_s, sd.end_s)
+        sds = {}
+        # rebuild search entries for completeness
+        metas.append(db.complete_block(blk))
+        blk.clear()
+
+    new_meta = db.compact_tenant_once("t1", now_s=250)
+    assert new_meta is not None
+    assert new_meta.compaction_level == 1
+    assert new_meta.total_objects == 21  # 10 + 10 + 1 shared (deduped)
+
+    # inputs are marked compacted on the backend
+    for m in metas:
+        with pytest.raises(DoesNotExist):
+            db.backend.read_block_meta("t1", m.block_id)
+        assert db.backend.read_compacted_meta("t1", m.block_id)
+
+    # blocklist staged update took effect
+    live = db.blocklist.metas("t1")
+    assert [m.block_id for m in live] == [new_meta.block_id]
+
+    # the shared trace combined both batches
+    obj, _ = db.find_trace_by_id("t1", shared)
+    assert len(codec_for("v2").prepare_for_read(obj).batches) == 2
+
+
+def test_compaction_preserves_search(tmp_path):
+    """Unlike the reference (which drops search data at compaction), the
+    merged block gets a rebuilt columnar search block."""
+    db = _db(tmp_path, compaction_window_s=10_000_000_000)
+    all_traces = {}
+    for i in range(2):
+        _, traces = _ingest(db, "t1", 20, seed_base=i * 1000)
+        all_traces.update(traces)
+    new_meta = db.compact_tenant_once("t1", now_s=int(time.time()))
+    assert new_meta is not None
+
+    req = _mk_req({})
+    req.limit = 100
+    res = db.search("t1", req)
+    assert len(res.response().traces) == 40
+
+
+def test_retention_two_phase(tmp_path):
+    db = _db(tmp_path, retention_s=1000, compacted_retention_s=500)
+    meta, _ = _ingest(db, "t1", 5)
+    now = meta.end_time + 2000  # past retention
+
+    marked, deleted = db.retain_tenant("t1", now_s=now)
+    assert marked == 1 and deleted == 0
+    assert db.blocklist.metas("t1") == []
+
+    # second phase after compacted retention passes
+    cm = db.backend.read_compacted_meta("t1", meta.block_id)
+    marked2, deleted2 = db.retain_tenant("t1", now_s=cm.compacted_time + 1000)
+    assert deleted2 == 1
+    assert db.backend.list_blocks("t1") == []
