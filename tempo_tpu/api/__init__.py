@@ -1,0 +1,19 @@
+from .params import (
+    build_search_request,
+    parse_search_request,
+    parse_trace_by_id_params,
+)
+from .http import HTTPApi, serve_http
+from .grpc_service import (
+    make_grpc_server,
+    PusherClient,
+    QuerierClient,
+    OTLP_EXPORT_METHOD,
+)
+
+__all__ = [
+    "build_search_request", "parse_search_request",
+    "parse_trace_by_id_params", "HTTPApi", "serve_http",
+    "make_grpc_server", "PusherClient", "QuerierClient",
+    "OTLP_EXPORT_METHOD",
+]

@@ -1,0 +1,171 @@
+"""gRPC services: the process boundary between modules.
+
+Role-equivalent to the reference's tempo.proto services (SURVEY.md §2.6):
+  - Pusher (distributor → ingester, PushBytes)
+  - Querier (querier → ingester / frontend jobs → query workers:
+    FindTraceByID, SearchRecent, SearchBlock, SearchTags, SearchTagValues)
+  - OTLP TraceService/Export receiver: our Trace message is wire-compatible
+    with ExportTraceServiceRequest (batches == resource_spans field 1), so
+    standard OTLP gRPC exporters can push directly.
+
+Stubs are hand-rolled over grpc generic handlers (no grpc_tools in this
+image); client classes present the same duck-typed interface the
+in-process wiring uses, so a multi-process deployment swaps transparently.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from tempo_tpu import tempopb
+
+SERVICE_PUSHER = "tempopb.Pusher"
+SERVICE_QUERIER = "tempopb.Querier"
+OTLP_SERVICE = "opentelemetry.proto.collector.trace.v1.TraceService"
+OTLP_EXPORT_METHOD = f"/{OTLP_SERVICE}/Export"
+
+
+# ---------------------------------------------------------------------------
+# server
+
+
+def make_grpc_server(app, address: str = "0.0.0.0:9095",
+                     max_workers: int = 16) -> grpc.Server:
+    from concurrent import futures
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+
+    def push_bytes(request: tempopb.PushBytesRequest, context) -> tempopb.PushResponse:
+        tenant = _tenant_from(context)
+        for ing in app.ingesters.values():
+            ing.push_bytes(tenant, request)
+            break  # addressed ingester: the server IS one ingester process
+        return tempopb.PushResponse()
+
+    def find_trace(request: tempopb.TraceByIDRequest, context) -> tempopb.TraceByIDResponse:
+        return app.queriers[0].find_trace_by_id(
+            _tenant_from(context), request.trace_id,
+            block_start=request.block_start, block_end=request.block_end,
+            mode=request.query_mode or "all",
+        )
+
+    def search_recent(request: tempopb.SearchRequest, context) -> tempopb.SearchResponse:
+        return app.queriers[0].search_recent(_tenant_from(context), request)
+
+    def search_block(request: tempopb.SearchBlockRequest, context) -> tempopb.SearchResponse:
+        return app.queriers[0].search_block(request)
+
+    def search_tags(request, context) -> tempopb.SearchTagsResponse:
+        return app.queriers[0].search_tags(_tenant_from(context))
+
+    def search_tag_values(request, context) -> tempopb.SearchTagValuesResponse:
+        return app.queriers[0].search_tag_values(
+            _tenant_from(context), request.tag_name
+        )
+
+    def otlp_export(request: tempopb.Trace, context) -> tempopb.Trace:
+        # request is wire-compatible ExportTraceServiceRequest; the empty
+        # response reuses Trace (wire-compatible: zero fields set)
+        app.push(_tenant_from(context), list(request.batches))
+        return tempopb.Trace()
+
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(SERVICE_PUSHER, {
+            "PushBytes": _unary(push_bytes, tempopb.PushBytesRequest,
+                                tempopb.PushResponse),
+        }),
+        grpc.method_handlers_generic_handler(SERVICE_QUERIER, {
+            "FindTraceByID": _unary(find_trace, tempopb.TraceByIDRequest,
+                                    tempopb.TraceByIDResponse),
+            "SearchRecent": _unary(search_recent, tempopb.SearchRequest,
+                                   tempopb.SearchResponse),
+            "SearchBlock": _unary(search_block, tempopb.SearchBlockRequest,
+                                  tempopb.SearchResponse),
+            "SearchTags": _unary(search_tags, tempopb.SearchTagsRequest,
+                                 tempopb.SearchTagsResponse),
+            "SearchTagValues": _unary(search_tag_values,
+                                      tempopb.SearchTagValuesRequest,
+                                      tempopb.SearchTagValuesResponse),
+        }),
+        grpc.method_handlers_generic_handler(OTLP_SERVICE, {
+            "Export": _unary(otlp_export, tempopb.Trace, tempopb.Trace),
+        }),
+    ))
+    server.add_insecure_port(address)
+    return server
+
+
+def _unary(fn, req_cls, resp_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString(),
+    )
+
+
+def _tenant_from(context) -> str:
+    from .params import DEFAULT_TENANT
+
+    for k, v in context.invocation_metadata() or ():
+        if k.lower() == "x-scope-orgid":
+            return v
+    return DEFAULT_TENANT
+
+
+# ---------------------------------------------------------------------------
+# clients (duck-typed like the in-process modules)
+
+
+class _Base:
+    def __init__(self, address: str, tenant: str | None = None):
+        self.channel = grpc.insecure_channel(address)
+        self.tenant = tenant
+
+    def _md(self, tenant: str | None):
+        t = tenant or self.tenant
+        return (("x-scope-orgid", t),) if t else ()
+
+    def _call(self, service, method, req, resp_cls, tenant=None):
+        rpc = self.channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        return rpc(req, metadata=self._md(tenant))
+
+
+class PusherClient(_Base):
+    """Distributor-side stub: same interface as modules.Ingester."""
+
+    def push_bytes(self, tenant: str, req: tempopb.PushBytesRequest) -> None:
+        self._call(SERVICE_PUSHER, "PushBytes", req, tempopb.PushResponse,
+                   tenant=tenant)
+
+
+class QuerierClient(_Base):
+    def find_trace_by_id(self, tenant, trace_id, block_start="", block_end="",
+                         mode="all") -> tempopb.TraceByIDResponse:
+        req = tempopb.TraceByIDRequest(
+            trace_id=trace_id, block_start=block_start,
+            block_end=block_end, query_mode=mode,
+        )
+        return self._call(SERVICE_QUERIER, "FindTraceByID", req,
+                          tempopb.TraceByIDResponse, tenant=tenant)
+
+    def search_recent(self, tenant, req) -> tempopb.SearchResponse:
+        return self._call(SERVICE_QUERIER, "SearchRecent", req,
+                          tempopb.SearchResponse, tenant=tenant)
+
+    def search_block(self, req) -> tempopb.SearchResponse:
+        return self._call(SERVICE_QUERIER, "SearchBlock", req,
+                          tempopb.SearchResponse)
+
+    def search_tags(self, tenant) -> tempopb.SearchTagsResponse:
+        return self._call(SERVICE_QUERIER, "SearchTags",
+                          tempopb.SearchTagsRequest(),
+                          tempopb.SearchTagsResponse, tenant=tenant)
+
+    def search_tag_values(self, tenant, tag) -> tempopb.SearchTagValuesResponse:
+        return self._call(SERVICE_QUERIER, "SearchTagValues",
+                          tempopb.SearchTagValuesRequest(tag_name=tag),
+                          tempopb.SearchTagValuesResponse, tenant=tenant)

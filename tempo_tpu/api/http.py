@@ -1,0 +1,144 @@
+"""HTTP API: the external read surface + operational endpoints.
+
+Role-equivalent to the reference's HTTP routes (pkg/api/http.go:49-55,
+cmd/tempo/app/app.go:380-511): /api/traces/{id}, /api/search,
+/api/search/tags, /api/search/tag/{name}/values, /api/echo, plus /ready,
+/metrics, /status, /flush and /shutdown. Multi-tenant via X-Scope-OrgID
+(fake-auth default tenant when absent, reference fake_auth.go). JSON
+bodies via protobuf json_format.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse, parse_qs
+
+from google.protobuf import json_format
+
+from tempo_tpu.utils.ids import hex_to_trace_id
+from .params import (
+    DEFAULT_TENANT,
+    HEADER_TENANT,
+    PATH_ECHO,
+    PATH_SEARCH,
+    PATH_SEARCH_TAGS,
+    PATH_SEARCH_TAG_VALUES,
+    PATH_TRACES,
+    parse_search_request,
+    parse_trace_by_id_params,
+)
+
+
+class HTTPApi:
+    """Routes HTTP requests onto an App (modules/app.py)."""
+
+    def __init__(self, app, multitenancy: bool = True):
+        self.app = app
+        self.multitenancy = multitenancy
+
+    def tenant(self, headers) -> str:
+        if not self.multitenancy:
+            return DEFAULT_TENANT
+        return headers.get(HEADER_TENANT) or DEFAULT_TENANT
+
+    def handle(self, method: str, path: str, query: dict, headers) -> tuple[int, dict | str]:
+        try:
+            return self._route(method, path, query, headers)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        except Exception as e:  # noqa: BLE001 — surface as 500
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+
+    def _route(self, method, path, query, headers):
+        tenant = self.tenant(headers)
+        if path == PATH_ECHO:
+            return 200, "echo"
+        if path == "/ready":
+            return (200, "ready") if self.app.ready() else (503, "not ready")
+        if path == "/metrics":
+            from tempo_tpu.observability.metrics import REGISTRY
+
+            return 200, REGISTRY.expose()
+        if path == "/status" or path.startswith("/status/"):
+            return 200, self._status(path)
+        if path == "/flush":
+            completed = self.app.flush_tick(force=True)
+            return 200, {"completed_blocks": len(completed)}
+        if path == "/shutdown":
+            threading.Thread(target=self.app.shutdown, daemon=True).start()
+            return 200, "shutting down"
+
+        if path.startswith(PATH_TRACES + "/"):
+            trace_id = hex_to_trace_id(path[len(PATH_TRACES) + 1:])
+            mode, bs, be = parse_trace_by_id_params(query)
+            resp = self.app.find_trace(tenant, trace_id)
+            if not resp.trace.batches:
+                return 404, {"error": "trace not found"}
+            code = 206 if resp.metrics.failed_blocks else 200
+            return code, json_format.MessageToDict(resp.trace)
+        if path == PATH_SEARCH:
+            req = parse_search_request(query)
+            resp = self.app.search(tenant, req)
+            return 200, json_format.MessageToDict(resp)
+        if path == PATH_SEARCH_TAGS:
+            resp = self.app.queriers[0].search_tags(tenant)
+            return 200, json_format.MessageToDict(resp)
+        if path.startswith(PATH_SEARCH_TAG_VALUES + "/"):
+            rest = path[len(PATH_SEARCH_TAG_VALUES) + 1:]
+            if rest.endswith("/values"):
+                tag = rest[: -len("/values")]
+                resp = self.app.queriers[0].search_tag_values(tenant, tag)
+                return 200, json_format.MessageToDict(resp)
+        return 404, {"error": f"no route {path}"}
+
+    def _status(self, path) -> dict:
+        app = self.app
+        return {
+            "ready": app.ready(),
+            "ring": {
+                "instances": app.ring.instance_ids(),
+                "healthy": app.ring.healthy_count(),
+                "replication_factor": app.ring.rf,
+            },
+            "tenants": app.reader_db.blocklist.tenants(),
+            "blocks": {
+                t: len(app.reader_db.blocklist.metas(t))
+                for t in app.reader_db.blocklist.tenants()
+            },
+        }
+
+
+def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
+    """Blocking stdlib server; returns the server object when used via
+    threading (tests call .shutdown())."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — stdlib API
+            u = urlparse(self.path)
+            query = {k: v[0] for k, v in parse_qs(u.query).items()}
+            code, body = api.handle("GET", u.path, query, self.headers)
+            self._reply(code, body)
+
+        def do_POST(self):  # noqa: N802
+            self.do_GET()
+
+        def _reply(self, code, body):
+            if isinstance(body, (dict, list)):
+                data = json.dumps(body).encode()
+                ctype = "application/json"
+            else:
+                data = str(body).encode()
+                ctype = "text/plain"
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    return server

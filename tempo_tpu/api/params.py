@@ -1,0 +1,84 @@
+"""HTTP query-param ↔ proto request round-trips.
+
+Role-equivalent to the reference's pkg/api/http.go (path constants,
+BuildSearchRequest/ParseSearchRequest etc.) — the frontend job sharder
+builds sub-request URLs from these and queriers parse them back, so the
+round-trip must be lossless.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+
+from tempo_tpu import tempopb
+
+PATH_TRACES = "/api/traces"
+PATH_SEARCH = "/api/search"
+PATH_SEARCH_TAGS = "/api/search/tags"
+PATH_SEARCH_TAG_VALUES = "/api/search/tag"
+PATH_ECHO = "/api/echo"
+
+HEADER_TENANT = "X-Scope-OrgID"
+DEFAULT_TENANT = "single-tenant"
+
+
+def _parse_tags(val: str) -> dict[str, str]:
+    """logfmt-ish `k=v k2=v2` tag encoding (reference search tags param)."""
+    out: dict[str, str] = {}
+    for pair in val.split():
+        if "=" in pair:
+            k, v = pair.split("=", 1)
+            out[k] = v
+    return out
+
+
+def _encode_tags(tags) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
+
+
+def parse_search_request(query: dict[str, str]) -> tempopb.SearchRequest:
+    req = tempopb.SearchRequest()
+    for k, v in _parse_tags(query.get("tags", "")).items():
+        req.tags[k] = v
+    if "minDuration" in query:
+        req.min_duration_ms = _duration_ms(query["minDuration"])
+    if "maxDuration" in query:
+        req.max_duration_ms = _duration_ms(query["maxDuration"])
+    req.limit = int(query.get("limit", 0) or 0)
+    req.start = int(query.get("start", 0) or 0)
+    req.end = int(query.get("end", 0) or 0)
+    return req
+
+
+def build_search_request(req: tempopb.SearchRequest) -> str:
+    q: dict[str, str] = {}
+    if req.tags:
+        q["tags"] = _encode_tags(req.tags)
+    if req.min_duration_ms:
+        q["minDuration"] = f"{req.min_duration_ms}ms"
+    if req.max_duration_ms:
+        q["maxDuration"] = f"{req.max_duration_ms}ms"
+    if req.limit:
+        q["limit"] = str(req.limit)
+    if req.start:
+        q["start"] = str(req.start)
+    if req.end:
+        q["end"] = str(req.end)
+    return urllib.parse.urlencode(q)
+
+
+def _duration_ms(s: str) -> int:
+    s = s.strip()
+    for suffix, mult in (("ms", 1), ("s", 1000), ("m", 60_000), ("h", 3_600_000)):
+        if s.endswith(suffix) and s[: -len(suffix)].replace(".", "").isdigit():
+            return int(float(s[: -len(suffix)]) * mult)
+    return int(float(s))
+
+
+def parse_trace_by_id_params(query: dict[str, str]) -> tuple[str, str, str]:
+    """(mode, blockStart, blockEnd)."""
+    return (
+        query.get("mode", "all"),
+        query.get("blockStart", ""),
+        query.get("blockEnd", ""),
+    )

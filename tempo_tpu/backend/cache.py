@@ -1,0 +1,108 @@
+"""Read-through cache wrap for backend reads.
+
+Role-equivalent to the reference's tempodb/backend/cache + pkg/cache
+(SURVEY.md layer 1): bloom shards and index objects are small and hot —
+wrap the RawBackend so their reads hit an in-process cache. The Cache
+interface {store, fetch, stop} matches the reference's (pkg/cache/
+cache.go:14-18); memcached/redis client implementations slot in behind it
+(network clients are gated in this environment — the LRU is the default
+tier, and device HBM staging in tempo_tpu.db is the tier above).
+
+shouldCache heuristics (reference tempodb.go:461-489): only bloom/index
+reads, and only for blocks older than `min_compaction_level` / younger
+than `max_block_age` knobs here reduced to a name-predicate default.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from .raw import RawBackend
+from .types import NAME_INDEX
+
+
+class LRUCache:
+    """The in-process Cache implementation."""
+
+    def __init__(self, max_bytes: int = 256 << 20):
+        self.max_bytes = max_bytes
+        self._data: collections.OrderedDict[str, bytes] = collections.OrderedDict()
+        self._size = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def store(self, key: str, val: bytes) -> None:
+        with self._lock:
+            old = self._data.pop(key, None)
+            if old is not None:
+                self._size -= len(old)
+            self._data[key] = val
+            self._size += len(val)
+            while self._size > self.max_bytes and self._data:
+                _, evicted = self._data.popitem(last=False)
+                self._size -= len(evicted)
+
+    def fetch(self, key: str) -> bytes | None:
+        with self._lock:
+            val = self._data.get(key)
+            if val is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return val
+
+    def stop(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._size = 0
+
+
+def default_should_cache(name: str) -> bool:
+    return name == NAME_INDEX or name.startswith("bloom-") or \
+        name == "search-header.json"
+
+
+class CachedBackend(RawBackend):
+    """RawBackend wrapper: read-through on cacheable object names."""
+
+    def __init__(self, inner: RawBackend, cache: LRUCache | None = None,
+                 should_cache=default_should_cache):
+        self.inner = inner
+        self.cache = cache or LRUCache()
+        self.should_cache = should_cache
+
+    def _key(self, tenant, block_id, name) -> str:
+        return f"{tenant}/{block_id or ''}/{name}"
+
+    def read(self, tenant, block_id, name) -> bytes:
+        if not self.should_cache(name):
+            return self.inner.read(tenant, block_id, name)
+        key = self._key(tenant, block_id, name)
+        val = self.cache.fetch(key)
+        if val is None:
+            val = self.inner.read(tenant, block_id, name)
+            self.cache.store(key, val)
+        return val
+
+    def write(self, tenant, block_id, name, data: bytes) -> None:
+        self.inner.write(tenant, block_id, name, data)
+        if self.should_cache(name):
+            self.cache.store(self._key(tenant, block_id, name), data)
+
+    def read_range(self, tenant, block_id, name, offset, length) -> bytes:
+        return self.inner.read_range(tenant, block_id, name, offset, length)
+
+    def delete(self, tenant, block_id, name) -> None:
+        self.inner.delete(tenant, block_id, name)
+
+    def list_tenants(self):
+        return self.inner.list_tenants()
+
+    def list_blocks(self, tenant):
+        return self.inner.list_blocks(tenant)
+
+    def _block_objects(self, tenant, block_id):
+        return self.inner._block_objects(tenant, block_id)

@@ -1,0 +1,106 @@
+"""YAML config loading with env substitution and footgun warnings.
+
+Role-equivalent to the reference's cmd/tempo config load (main.go:117-175
+``-config.file`` + ``-config.expand-env``) and CheckConfig warnings
+(app.go:136-164). The YAML tree mirrors AppConfig/TempoDBConfig fields:
+
+    server:
+      http_port: 3200
+      grpc_port: 9095
+    multitenancy_enabled: true
+    storage:
+      backend: local            # local | memory
+      local: {path: /var/tempo/blocks}
+      wal_dir: /var/tempo/wal
+      block_encoding: zstd
+      search_encoding: zstd
+    ingester:
+      n_ingesters: 1
+      replication_factor: 1
+    compactor: {window_s: 3600, max_inputs: 8}
+    retention: {block_s: 1209600, compacted_s: 3600}
+    overrides:
+      defaults: {ingestion_rate_bytes: 15000000, ...}
+      per_tenant: {tenant-a: {max_live_traces: 100000}}
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import yaml
+
+from tempo_tpu.db import TempoDBConfig
+from tempo_tpu.modules import AppConfig, Limits
+
+_ENV_RE = re.compile(r"\$\{(\w+)(?::([^}]*))?\}")
+
+
+def expand_env(text: str) -> str:
+    """${VAR} / ${VAR:default} substitution (reference -config.expand-env)."""
+    return _ENV_RE.sub(
+        lambda m: os.environ.get(m.group(1), m.group(2) or ""), text
+    )
+
+
+def load_config(path: str | None = None, text: str | None = None) -> tuple[AppConfig, dict]:
+    if text is None:
+        text = open(path).read() if path else "{}"
+    doc = yaml.safe_load(expand_env(text)) or {}
+
+    storage = doc.get("storage", {})
+    ingester = doc.get("ingester", {})
+    compactor = doc.get("compactor", {})
+    retention = doc.get("retention", {})
+    overrides = doc.get("overrides", {})
+
+    db = TempoDBConfig(
+        block_encoding=storage.get("block_encoding", "zstd"),
+        search_encoding=storage.get("search_encoding", "zstd"),
+        compaction_window_s=compactor.get("window_s", 3600),
+        compaction_max_inputs=compactor.get("max_inputs", 8),
+        retention_s=retention.get("block_s", 14 * 24 * 3600),
+        compacted_retention_s=retention.get("compacted_s", 3600),
+        blocklist_poll_s=storage.get("blocklist_poll_s", 30),
+    )
+    cfg = AppConfig(
+        backend={
+            "backend": storage.get("backend", "local"),
+            "local": storage.get("local", {"path": "./tempo-blocks"}),
+        },
+        wal_dir=storage.get("wal_dir", "./tempo-wal"),
+        n_ingesters=ingester.get("n_ingesters", 1),
+        replication_factor=ingester.get("replication_factor", 1),
+        db=db,
+        limits=Limits(**{
+            k: v for k, v in overrides.get("defaults", {}).items()
+            if k in Limits.__dataclass_fields__
+        }),
+        per_tenant_overrides=overrides.get("per_tenant", {}),
+    )
+    server = doc.get("server", {})
+    runtime = {
+        "http_port": server.get("http_port", 3200),
+        "grpc_port": server.get("grpc_port", 9095),
+        "multitenancy": doc.get("multitenancy_enabled", True),
+        "warnings": check_config(cfg, doc),
+    }
+    return cfg, runtime
+
+
+def check_config(cfg: AppConfig, doc: dict) -> list[str]:
+    warnings = []
+    if cfg.replication_factor > cfg.n_ingesters:
+        warnings.append(
+            f"replication_factor ({cfg.replication_factor}) exceeds ingester "
+            f"count ({cfg.n_ingesters}); writes will fail quorum"
+        )
+    if cfg.db.compacted_retention_s == 0:
+        warnings.append(
+            "compacted block retention is 0: compacted blocks are deleted "
+            "immediately, racing in-flight queries"
+        )
+    if cfg.backend.get("backend") == "memory":
+        warnings.append("memory backend: data does not survive restarts")
+    return warnings

@@ -1,0 +1,69 @@
+"""The server binary: ``python -m tempo_tpu.cli.main -config.file=...``.
+
+Role-equivalent to the reference's cmd/tempo main (config load, logger,
+module startup, signal-driven graceful shutdown). One process runs the
+whole pipeline (the reference's ``-target=all`` / scalable-single-binary);
+gRPC exposes the module boundaries so additional processes can join as
+pushers/queriers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from tempo_tpu.api import HTTPApi, make_grpc_server, serve_http
+from tempo_tpu.modules import App
+from tempo_tpu.observability import get_logger
+from .config import load_config
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tempo-tpu")
+    p.add_argument("-config.file", dest="config_file", default=None)
+    p.add_argument("-target", dest="target", default="all",
+                   choices=["all"], help="module target (single-binary)")
+    p.add_argument("-http-port", type=int, default=None)
+    p.add_argument("-grpc-port", type=int, default=None)
+    args = p.parse_args(argv)
+
+    log = get_logger()
+    cfg, runtime = load_config(args.config_file)
+    for w in runtime["warnings"]:
+        log.warning("config: %s", w)
+
+    app = App(cfg)
+    app.run_maintenance()
+
+    http_port = args.http_port or runtime["http_port"]
+    grpc_port = args.grpc_port or runtime["grpc_port"]
+
+    api = HTTPApi(app, multitenancy=runtime["multitenancy"])
+    http_server = serve_http(api, port=http_port)
+    threading.Thread(target=http_server.serve_forever, daemon=True).start()
+
+    grpc_server = make_grpc_server(app, f"0.0.0.0:{grpc_port}")
+    grpc_server.start()
+    log.info("tempo-tpu up: http=:%d grpc=:%d ingesters=%d rf=%d",
+             http_port, grpc_port, cfg.n_ingesters, cfg.replication_factor)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal %s: draining", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    stop.wait()
+
+    grpc_server.stop(grace=5)
+    http_server.shutdown()
+    app.shutdown()  # flush everything (reference /shutdown drain)
+    log.info("shutdown complete")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

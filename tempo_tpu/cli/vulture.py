@@ -1,0 +1,100 @@
+"""Black-box consistency checker.
+
+Role-equivalent to cmd/tempo-vulture (main.go:69-205): writes
+deterministically-regenerable traces, re-reads them by id and by search,
+and reports missing/mismatched counts — the continuous prod prober. In
+this build it drives an in-process App or a remote HTTP endpoint.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+from dataclasses import dataclass, field
+
+from tempo_tpu import tempopb
+from tempo_tpu.utils.test_data import make_trace
+
+
+def seeded_trace_id(seed: int) -> bytes:
+    return hashlib.sha256(f"vulture-{seed}".encode()).digest()[:16]
+
+
+@dataclass
+class VultureStats:
+    written: int = 0
+    found: int = 0
+    missing: int = 0
+    mismatched: int = 0
+    search_found: int = 0
+    search_missing: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+
+class Vulture:
+    """Write traces keyed by a time seed; any reader can regenerate the
+    expected content from the seed alone (reference util.TraceInfo)."""
+
+    def __init__(self, app, tenant: str = "vulture"):
+        self.app = app
+        self.tenant = tenant
+        self.stats = VultureStats()
+        self._seeds: list[int] = []
+
+    def write_pass(self, n: int = 10, epoch: int | None = None) -> None:
+        epoch = epoch if epoch is not None else int(time.time())
+        for i in range(n):
+            seed = epoch * 1000 + i
+            tid = seeded_trace_id(seed)
+            tr = make_trace(tid, seed=seed)
+            self.app.push(self.tenant, list(tr.batches))
+            self._seeds.append(seed)
+            self.stats.written += 1
+
+    def read_pass(self) -> None:
+        for seed in self._seeds:
+            tid = seeded_trace_id(seed)
+            expected = make_trace(tid, seed=seed)
+            resp = self.app.find_trace(self.tenant, tid)
+            if not resp.trace.batches:
+                self.stats.missing += 1
+                continue
+            got_spans = sorted(
+                s.span_id for b in resp.trace.batches
+                for ss in b.scope_spans for s in ss.spans
+            )
+            want_spans = sorted(
+                s.span_id for b in expected.batches
+                for ss in b.scope_spans for s in ss.spans
+            )
+            if got_spans == want_spans:
+                self.stats.found += 1
+            else:
+                self.stats.mismatched += 1
+
+    def search_pass(self) -> None:
+        for seed in self._seeds:
+            tid = seeded_trace_id(seed)
+            expected = make_trace(tid, seed=seed)
+            svc = ""
+            for kv in expected.batches[0].resource.attributes:
+                if kv.key == "service.name":
+                    svc = kv.value.string_value
+            req = tempopb.SearchRequest()
+            req.tags["service.name"] = svc
+            req.limit = 10_000
+            resp = self.app.search(self.tenant, req)
+            if any(t.trace_id == tid.hex() for t in resp.traces):
+                self.stats.search_found += 1
+            else:
+                self.stats.search_missing += 1
+
+    def run_cycle(self, n: int = 10) -> VultureStats:
+        self.write_pass(n)
+        self.read_pass()
+        self.search_pass()
+        return self.stats

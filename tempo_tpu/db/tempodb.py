@@ -22,6 +22,7 @@ from tempo_tpu.search import SearchResults, write_search_block
 from tempo_tpu.search.backend_search_block import BackendSearchBlock
 from tempo_tpu.search.columnar import PageGeometry
 from tempo_tpu.search.engine import ScanEngine
+from tempo_tpu.observability import metrics as obs
 from tempo_tpu.utils.ids import pad_trace_id
 from tempo_tpu.wal import WAL, AppendBlock
 
@@ -169,13 +170,15 @@ class TempoDB:
         """Search all (time-pruned) blocks of a tenant through the device
         engine, early-stopping at the result limit."""
         results = results or SearchResults(limit=req.limit or 20)
-        for m in self.blocklist.metas(tenant):
-            if not self._include_block(m, "", "", req.start, req.end):
-                results.metrics.skipped_blocks += 1
-                continue
-            self._search_block_for(m).search(req, results, engine=self.engine)
-            if results.complete:
-                break
+        with obs.query_seconds.time(op="search"):
+            for m in self.blocklist.metas(tenant):
+                if not self._include_block(m, "", "", req.start, req.end):
+                    results.metrics.skipped_blocks += 1
+                    continue
+                self._search_block_for(m).search(req, results, engine=self.engine)
+                if results.complete:
+                    break
+        obs.search_inspected.inc(results.metrics.inspected_traces, tenant=tenant)
         return results
 
     def search_block(self, req: tempopb.SearchBlockRequest) -> SearchResults:
@@ -204,6 +207,7 @@ class TempoDB:
                                   page_size=self.cfg.block_page_size,
                                   search_geometry=self.cfg.search_geometry,
                                   search_encoding=self.cfg.search_encoding)
+        obs.compactions.inc(tenant=tenant)
         from tempo_tpu.backend.types import CompactedBlockMeta
 
         self.blocklist.update(

@@ -19,6 +19,7 @@ from tempo_tpu.backend import open_backend
 from tempo_tpu.db import TempoDB, TempoDBConfig
 from .distributor import Distributor
 from .frontend import QueryFrontend, FrontendConfig
+from .generator import MetricsGenerator
 from .ingester import Ingester
 from .overrides import Overrides, Limits
 from .querier import Querier
@@ -61,7 +62,9 @@ class App:
         # queriers share one reader db (blocklist + staged-block cache)
         self.reader_db = TempoDB(self.backend, f"{self.cfg.wal_dir}/querier",
                                  self.cfg.db)
-        self.distributor = Distributor(self.ring, self.ingesters, self.overrides)
+        self.generator = MetricsGenerator()
+        self.distributor = Distributor(self.ring, self.ingesters, self.overrides,
+                                       forwarder=self.generator.push_spans)
         self.queriers = [
             Querier(self.reader_db, self.ring, self.ingesters, self.overrides)
             for _ in range(self.cfg.n_queriers)

@@ -10,6 +10,8 @@ ingesters (write extension past unhealthy ones happens inside Ring.get).
 
 from __future__ import annotations
 
+import queue
+import threading
 from dataclasses import dataclass, field
 
 from tempo_tpu import tempopb
@@ -18,6 +20,7 @@ from tempo_tpu.model.matches import trace_range_ns
 from tempo_tpu.search.data import extract_search_data, encode_search_data
 from tempo_tpu.utils.hashing import token_for
 from tempo_tpu.utils.ids import pad_trace_id, validate_trace_id
+from tempo_tpu.observability import metrics as obs
 from .overrides import Overrides
 from .ring import Ring
 
@@ -36,17 +39,43 @@ class DistributorMetrics:
     traces_pushed: int = 0
     push_failures: int = 0
     bytes_received: int = 0
+    forwarder_dropped: int = 0
 
 
 class Distributor:
-    def __init__(self, ring: Ring, pushers: dict, overrides: Overrides | None = None):
+    def __init__(self, ring: Ring, pushers: dict, overrides: Overrides | None = None,
+                 forwarder=None, forward_queue_size: int = 1000):
         """pushers: instance id → object with push_bytes(tenant, PushBytesRequest)
-        (in-process Ingester or a gRPC client stub)."""
+        (in-process Ingester or a gRPC client stub). forwarder: optional
+        fn(tenant, batches) feeding the metrics-generator off the hot path
+        via a bounded queue + worker thread (reference distributor
+        forwarder.go); overflow drops batches rather than blocking ingest."""
         self.ring = ring
         self.pushers = pushers
         self.overrides = overrides or Overrides()
         self.codec = segment_codec_for(CURRENT_ENCODING)
         self.metrics = DistributorMetrics()
+        self.forwarder = forwarder
+        self._forward_queue = None
+        if forwarder is not None:
+            self._forward_queue = queue.Queue(maxsize=forward_queue_size)
+            t = threading.Thread(target=self._forward_loop, daemon=True)
+            t.start()
+
+    def _forward_loop(self) -> None:
+        while True:
+            tenant, batches = self._forward_queue.get()
+            try:
+                self.forwarder(tenant, batches)
+            except Exception:  # noqa: BLE001 — derivation failures never propagate
+                pass
+            finally:
+                self._forward_queue.task_done()
+
+    def forward_flush(self) -> None:
+        """Block until queued forwarder work has drained (tests/shutdown)."""
+        if self._forward_queue is not None:
+            self._forward_queue.join()
 
     def push_batches(self, tenant: str, batches: list) -> None:
         """The write hot path (reference PushBatches → requestsByTraceID →
@@ -56,10 +85,19 @@ class Distributor:
         size = sum(b.ByteSize() for b in batches)
         if not self.overrides.allow_ingestion(tenant, size):
             self.metrics.push_failures += 1
+            obs.push_failures.inc(tenant=tenant, reason="rate_limited")
             raise RateLimited(f"tenant {tenant} over ingestion rate")
         self.metrics.bytes_received += size
+        obs.ingest_bytes.inc(size, tenant=tenant)
 
-        by_trace = self._requests_by_trace_id(batches)
+        by_trace, n_spans = self._requests_by_trace_id(batches)
+        obs.ingest_spans.inc(n_spans, tenant=tenant)
+
+        if self._forward_queue is not None:
+            try:
+                self._forward_queue.put_nowait((tenant, batches))
+            except queue.Full:  # metrics derivation never blocks ingest
+                self.metrics.forwarder_dropped += 1
 
         lim = self.overrides.limits(tenant)
         req_per_ingester: dict[str, tempopb.PushBytesRequest] = {}
@@ -74,6 +112,7 @@ class Distributor:
             )
             if len(seg) > lim.max_bytes_per_trace:
                 self.metrics.push_failures += 1
+                obs.push_failures.inc(tenant=tenant, reason="trace_too_large")
                 raise IngestError(
                     f"trace {tid.hex()} exceeds max_bytes_per_trace"
                 )
@@ -102,22 +141,26 @@ class Distributor:
                 ok = sum(1 for iid in replicas if iid not in errs)
                 if ok < len(replicas) // 2 + 1:
                     self.metrics.push_failures += 1
+                    obs.push_failures.inc(tenant=tenant, reason="quorum")
                     raise IngestError(
                         f"push quorum failed for trace {tid.hex()}: "
                         f"{list(errs.items())[:2]}"
                     )
 
-    def _requests_by_trace_id(self, batches: list) -> dict:
+    def _requests_by_trace_id(self, batches: list) -> tuple[dict, int]:
         """Regroup spans by trace id (reference distributor.go:442-516 —
         the hot loop: one trace's spans arrive scattered over resource
-        batches; rebuild one Trace per id preserving resource/scope)."""
+        batches; rebuild one Trace per id preserving resource/scope).
+        Returns (traces by id, span count) — the local count keeps
+        per-tenant metrics exact under concurrent pushes."""
         out: dict[bytes, tempopb.Trace] = {}
+        n_spans = 0
         for batch in batches:
             for ss in batch.scope_spans:
                 for span in ss.spans:
                     validate_trace_id(span.trace_id)
                     tid = pad_trace_id(span.trace_id)
-                    self.metrics.spans_received += 1
+                    n_spans += 1
                     trace = out.get(tid)
                     if trace is None:
                         trace = out[tid] = tempopb.Trace()
@@ -140,4 +183,5 @@ class Distributor:
                         dss.scope.CopyFrom(ss.scope)
                         dss.schema_url = ss.schema_url
                     dss.spans.append(span)
-        return out
+        self.metrics.spans_received += n_spans
+        return out, n_spans

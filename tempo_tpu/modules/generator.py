@@ -1,0 +1,186 @@
+"""Metrics generator: span-derived metrics.
+
+Role-equivalent to the reference's modules/generator (SURVEY.md §2.2):
+consumes span pushes (distributor forwarder) and derives Prometheus
+metrics per tenant via two processors:
+
+  - spanmetrics (spanmetrics.go:34-88): calls_total + latency histogram
+    by (service, span_name, span_kind, status_code)
+  - service-graphs (servicegraphs.go:56-248): client/server span pairing
+    via an expiring edge store → request/failure counts + latency per
+    (client, server) edge
+
+plus a ManagedRegistry with per-tenant active-series limits and staleness
+expiry (registry/registry.go:51-226). The reference remote-writes to
+Prometheus; here samples export through the shared /metrics registry (no
+network egress in this environment; a remote-write client slots in where
+`collect` drains samples).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tempo_tpu import tempopb
+from tempo_tpu.observability.metrics import Registry, Counter, Histogram
+
+LATENCY_BUCKETS_S = (0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
+                     0.256, 0.512, 1.024, 2.048, 4.096, 8.192, 16.384)
+
+
+class SpanMetricsProcessor:
+    def __init__(self, registry: Registry):
+        self.calls = Counter("traces_spanmetrics_calls_total",
+                             "span call counts", registry=registry)
+        self.latency = Histogram("traces_spanmetrics_latency",
+                                 "span latency (s)",
+                                 buckets=LATENCY_BUCKETS_S, registry=registry)
+
+    def consume(self, batch: tempopb.ResourceSpans) -> None:
+        svc = ""
+        for kv in batch.resource.attributes:
+            if kv.key == "service.name":
+                svc = kv.value.string_value
+        for ss in batch.scope_spans:
+            for span in ss.spans:
+                labels = dict(
+                    service=svc, span_name=span.name,
+                    span_kind=tempopb.Span.SpanKind.Name(span.kind),
+                    status_code=tempopb.Status.StatusCode.Name(span.status.code),
+                )
+                self.calls.inc(**labels)
+                dur_s = max(0, span.end_time_unix_nano
+                            - span.start_time_unix_nano) / 1e9
+                self.latency.observe(dur_s, **labels)
+
+
+class ServiceGraphProcessor:
+    """Pairs client spans with the server spans they called (matched by
+    (trace id, client span id == server parent id)) through an expiring
+    store; completed pairs emit one edge sample."""
+
+    def __init__(self, registry: Registry, wait_s: float = 10.0,
+                 max_items: int = 10_000):
+        self.requests = Counter("traces_service_graph_request_total",
+                                "edge request counts", registry=registry)
+        self.failed = Counter("traces_service_graph_request_failed_total",
+                              "edge failures", registry=registry)
+        self.latency = Histogram("traces_service_graph_request_seconds",
+                                 "edge client latency (s)",
+                                 buckets=LATENCY_BUCKETS_S, registry=registry)
+        self.wait_s = wait_s
+        self.max_items = max_items
+        self._store: dict[tuple, tuple] = {}  # key -> (kind, svc, span, t)
+        self._lock = threading.Lock()
+        self.expired = 0
+
+    def consume(self, batch: tempopb.ResourceSpans) -> None:
+        svc = ""
+        for kv in batch.resource.attributes:
+            if kv.key == "service.name":
+                svc = kv.value.string_value
+        now = time.monotonic()
+        for ss in batch.scope_spans:
+            for span in ss.spans:
+                if span.kind == tempopb.Span.SPAN_KIND_CLIENT:
+                    key = (bytes(span.trace_id), bytes(span.span_id))
+                    self._pair(key, "client", svc, span, now)
+                elif span.kind == tempopb.Span.SPAN_KIND_SERVER:
+                    key = (bytes(span.trace_id), bytes(span.parent_span_id))
+                    self._pair(key, "server", svc, span, now)
+        self._expire(now)
+
+    def _pair(self, key, kind, svc, span, now) -> None:
+        with self._lock:
+            other = self._store.get(key)
+            if other is None or other[0] == kind:
+                if len(self._store) < self.max_items:
+                    self._store[key] = (
+                        kind, svc, span.SerializeToString(), now
+                    )
+                return
+            del self._store[key]
+        o_kind, o_svc, o_span_b, _ = other
+        o_span = tempopb.Span()
+        o_span.ParseFromString(o_span_b)
+        if kind == "client":
+            client_svc, server_svc, client_span = svc, o_svc, span
+            server_span = o_span
+        else:
+            client_svc, server_svc, client_span = o_svc, svc, o_span
+            server_span = span
+        labels = dict(client=client_svc, server=server_svc)
+        self.requests.inc(**labels)
+        if (client_span.status.code == tempopb.Status.STATUS_CODE_ERROR
+                or server_span.status.code == tempopb.Status.STATUS_CODE_ERROR):
+            self.failed.inc(**labels)
+        dur_s = max(0, client_span.end_time_unix_nano
+                    - client_span.start_time_unix_nano) / 1e9
+        self.latency.observe(dur_s, **labels)
+
+    def _expire(self, now) -> None:
+        with self._lock:
+            dead = [k for k, v in self._store.items()
+                    if now - v[3] > self.wait_s]
+            for k in dead:
+                del self._store[k]
+            self.expired += len(dead)
+
+
+class ManagedRegistry(Registry):
+    """Registry with an active-series cap per tenant (reference
+    registry.go: max_active_series drops new series when exceeded)."""
+
+    def __init__(self, max_active_series: int = 100_000):
+        super().__init__()
+        self.max_active_series = max_active_series
+
+    def active_series(self) -> int:
+        n = 0
+        for m in self._metrics.values():
+            n += len(getattr(m, "_series", ())) + len(getattr(m, "_counts", ()))
+        return n
+
+    def over_limit(self) -> bool:
+        return self.active_series() >= self.max_active_series
+
+
+class MetricsGenerator:
+    """Per-tenant processor instances fed by the distributor forwarder."""
+
+    def __init__(self, max_active_series: int = 100_000,
+                 processors: tuple = ("span-metrics", "service-graphs")):
+        self.max_active_series = max_active_series
+        self.processors = processors
+        self._tenants: dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        self.dropped_over_limit = 0
+
+    def _instance(self, tenant: str):
+        with self._lock:
+            inst = self._tenants.get(tenant)
+            if inst is None:
+                reg = ManagedRegistry(self.max_active_series)
+                procs = []
+                if "span-metrics" in self.processors:
+                    procs.append(SpanMetricsProcessor(reg))
+                if "service-graphs" in self.processors:
+                    procs.append(ServiceGraphProcessor(reg))
+                inst = self._tenants[tenant] = (reg, procs)
+            return inst
+
+    def push_spans(self, tenant: str, batches) -> None:
+        reg, procs = self._instance(tenant)
+        if reg.over_limit():
+            self.dropped_over_limit += 1
+            return
+        for batch in batches:
+            for p in procs:
+                p.consume(batch)
+
+    def collect(self, tenant: str) -> str:
+        """Exposition-format samples for a tenant (the remote-write drain
+        point)."""
+        reg, _ = self._instance(tenant)
+        return reg.expose()

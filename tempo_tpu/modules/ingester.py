@@ -26,6 +26,7 @@ from tempo_tpu.model.codec import segment_codec_for, CURRENT_ENCODING
 from tempo_tpu.search import SearchResults, decode_search_data
 from tempo_tpu.search.data import SearchData, search_data_matches
 from tempo_tpu.search.streaming import StreamingSearchBlock, _meta_from_sd
+from tempo_tpu.observability import metrics as obs
 from tempo_tpu.utils.ids import pad_trace_id
 from .overrides import Overrides
 
@@ -83,6 +84,7 @@ class TenantInstance:
             t.segments.append(segment)
             t.nbytes += len(segment)
             t.last_append = time.monotonic()
+            obs.live_traces.set(len(self.live), tenant=self.tenant)
             if search_data:
                 sd = decode_search_data(search_data, tid)
                 if t.search is None:
@@ -108,6 +110,7 @@ class TenantInstance:
                     self.head_search.append(tid, t.search)
                 del self.live[tid]
                 cut += 1
+            obs.live_traces.set(len(self.live), tenant=self.tenant)
         return cut
 
     def cut_block_if_ready(self, max_block_bytes: int = 500 << 20,
@@ -143,6 +146,8 @@ class TenantInstance:
         search.clear()
         with self.lock:
             self.recent.append((meta, time.monotonic()))
+        obs.blocks_completed.inc(tenant=self.tenant)
+        obs.live_traces.set(len(self.live), tenant=self.tenant)
         return meta
 
     def clear_flushed(self) -> None:

@@ -1,0 +1,106 @@
+"""Per-tenant fair request queue + keyed-exclusive flush queues.
+
+Role-equivalent to the reference's pkg/scheduler/queue (frontend v1
+per-tenant FIFO fairness with max-outstanding 429s, user_queues.go) and
+pkg/flushqueues (priority queues that dedupe in-flight ops,
+exclusivequeues.go:10-83).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from collections import OrderedDict, deque
+
+
+class TooManyRequests(Exception):
+    """Queue full for tenant (reference: HTTP 429)."""
+
+
+class RequestQueue:
+    """Round-robin across tenants, FIFO within a tenant. `get` blocks until
+    a request is available or the queue stops."""
+
+    def __init__(self, max_outstanding_per_tenant: int = 2000):
+        self.max_outstanding = max_outstanding_per_tenant
+        self._queues: OrderedDict[str, deque] = OrderedDict()
+        self._cv = threading.Condition()
+        self._stopped = False
+
+    def enqueue(self, tenant: str, request) -> None:
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("queue stopped")
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = deque()
+            if len(q) >= self.max_outstanding:
+                raise TooManyRequests(tenant)
+            q.append(request)
+            self._cv.notify()
+
+    def get(self, timeout: float | None = None):
+        """(tenant, request) or None on stop/timeout. Tenants are served
+        round-robin: the tenant we serve moves to the back."""
+        with self._cv:
+            while True:
+                for tenant in list(self._queues):
+                    q = self._queues[tenant]
+                    if q:
+                        req = q.popleft()
+                        self._queues.move_to_end(tenant)
+                        if not q:
+                            del self._queues[tenant]
+                        return tenant, req
+                if self._stopped:
+                    return None
+                if not self._cv.wait(timeout):
+                    return None
+
+    def lengths(self) -> dict[str, int]:
+        with self._cv:
+            return {t: len(q) for t, q in self._queues.items()}
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+
+class ExclusiveQueue:
+    """Priority queue that refuses duplicate keys while an op is queued or
+    in flight — the ingester flush-op dedupe (reference flushqueues)."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._keys: set = set()
+        self._lock = threading.Lock()
+        self._counter = itertools.count()
+
+    def enqueue(self, key, priority: float, item) -> bool:
+        """False if the key is already queued/in-flight."""
+        with self._lock:
+            if key in self._keys:
+                return False
+            self._keys.add(key)
+            heapq.heappush(self._heap, (priority, next(self._counter), key, item))
+            return True
+
+    def dequeue(self):
+        """(key, item) or None. The key stays claimed until done(key)."""
+        with self._lock:
+            if not self._heap:
+                return None
+            _, _, key, item = heapq.heappop(self._heap)
+            return key, item
+
+    def done(self, key) -> None:
+        """Release the key so it can be re-enqueued (e.g. retry after
+        backoff)."""
+        with self._lock:
+            self._keys.discard(key)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap)

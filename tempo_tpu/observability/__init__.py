@@ -1,0 +1,5 @@
+from .metrics import REGISTRY, Counter, Gauge, Histogram
+from .log import get_logger, RateLimitedLogger
+
+__all__ = ["REGISTRY", "Counter", "Gauge", "Histogram", "get_logger",
+           "RateLimitedLogger"]

@@ -1,0 +1,46 @@
+"""Structured logging with per-tenant rate limiting.
+
+Role-equivalent to the reference's go-kit logger + rate-limited tenant
+logger (pkg/util/log/log.go:157).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+
+
+def get_logger(name: str = "tempo_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(
+            'ts=%(asctime)s level=%(levelname)s logger=%(name)s msg="%(message)s"',
+            datefmt="%Y-%m-%dT%H:%M:%S",
+        ))
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO)
+    return logger
+
+
+class RateLimitedLogger:
+    """At most `rate` messages/sec per tenant; the rest are dropped with a
+    drop counter (prevents one noisy tenant from flooding logs)."""
+
+    def __init__(self, logger: logging.Logger, rate: float = 10.0):
+        self.logger = logger
+        self.rate = rate
+        self._state: dict[str, tuple[float, float]] = {}  # tenant -> (tokens, t)
+        self.dropped = 0
+
+    def log(self, tenant: str, msg: str, level: int = logging.WARNING) -> None:
+        now = time.monotonic()
+        tokens, t = self._state.get(tenant, (self.rate, now))
+        tokens = min(self.rate, tokens + (now - t) * self.rate)
+        if tokens >= 1:
+            self._state[tenant] = (tokens - 1, now)
+            self.logger.log(level, "tenant=%s %s", tenant, msg)
+        else:
+            self._state[tenant] = (tokens, now)
+            self.dropped += 1

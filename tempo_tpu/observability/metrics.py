@@ -1,0 +1,158 @@
+"""Prometheus-style metrics registry.
+
+Role-equivalent to the reference's promauto counters/gauges/histograms
+registered at var-init in every component with `tempo_`/`tempodb_`
+namespaces (SURVEY.md §5 observability), exposed in text format at
+/metrics. Labels are per-series (cardinality-aware: the label set lives
+in the series key).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = "", registry=None):
+        self.name = name
+        self.help = help_
+        self._series: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        (registry or REGISTRY)._register(self)
+
+    def _key(self, labels: dict | None) -> tuple:
+        return tuple(sorted((labels or {}).items()))
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            for key, val in sorted(self._series.items()):
+                lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                lines.append(f"{self.name}{{{lbl}}} {val}" if lbl
+                             else f"{self.name} {val}")
+        return "\n".join(lines)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0) + n
+
+    def value(self, **labels) -> float:
+        return self._series.get(self._key(labels), 0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._series[self._key(labels)] = v
+
+    def value(self, **labels) -> float:
+        return self._series.get(self._key(labels), 0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+    def __init__(self, name, help_="", buckets=None, registry=None):
+        super().__init__(name, help_, registry)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts: dict[tuple, list] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+            self._sums[k] = self._sums.get(k, 0) + v
+
+    def time(self, **labels):
+        return _Timer(self, labels)
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, counts in sorted(self._counts.items()):
+                base = dict(key)
+                cum = 0
+                for i, b in enumerate(self.buckets):
+                    cum = counts[i]
+                    lbl = ",".join(f'{k}="{v}"' for k, v in
+                                   sorted({**base, "le": b}.items()))
+                    lines.append(f"{self.name}_bucket{{{lbl}}} {cum}")
+                lbl = ",".join(f'{k}="{v}"' for k, v in
+                               sorted({**base, "le": "+Inf"}.items()))
+                lines.append(f"{self.name}_bucket{{{lbl}}} {counts[-1]}")
+                blbl = ",".join(f'{k}="{v}"' for k, v in key)
+                suffix = f"{{{blbl}}}" if blbl else ""
+                lines.append(f"{self.name}_sum{suffix} {self._sums.get(key, 0)}")
+                lines.append(f"{self.name}_count{suffix} {counts[-1]}")
+        return "\n".join(lines)
+
+
+class _Timer:
+    def __init__(self, hist, labels):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, m: _Metric) -> None:
+        with self._lock:
+            if m.name in self._metrics:
+                raise ValueError(f"metric {m.name} already registered")
+            self._metrics[m.name] = m
+
+    def get(self, name: str) -> _Metric | None:
+        return self._metrics.get(name)
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.expose() for m in metrics) + "\n"
+
+
+REGISTRY = Registry()
+
+# core framework metrics (registered once, labelled per tenant/status)
+ingest_spans = Counter("tempo_distributor_spans_received_total",
+                       "spans received by the distributor")
+ingest_bytes = Counter("tempo_distributor_bytes_received_total",
+                       "bytes received by the distributor")
+push_failures = Counter("tempo_distributor_push_failures_total",
+                        "failed pushes")
+live_traces = Gauge("tempo_ingester_live_traces", "live traces per tenant")
+blocks_completed = Counter("tempo_ingester_blocks_completed_total",
+                           "blocks completed to the backend")
+query_seconds = Histogram("tempo_query_seconds", "query latency")
+search_inspected = Counter("tempo_search_inspected_traces_total",
+                           "traces inspected by search")
+compactions = Counter("tempodb_compaction_runs_total", "compaction runs")
+retention_deleted = Counter("tempodb_retention_deleted_total",
+                            "blocks hard-deleted by retention")

@@ -1,0 +1,197 @@
+import json
+import threading
+import urllib.request
+
+import grpc
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.api import (
+    HTTPApi,
+    PusherClient,
+    QuerierClient,
+    build_search_request,
+    make_grpc_server,
+    parse_search_request,
+    serve_http,
+)
+from tempo_tpu.api.grpc_service import OTLP_EXPORT_METHOD
+from tempo_tpu.cli.config import load_config, expand_env
+from tempo_tpu.modules import App, AppConfig
+from tempo_tpu.utils.ids import random_trace_id, trace_id_to_hex
+from tempo_tpu.utils.test_data import make_trace
+
+from tests.test_search import _mk_req
+
+
+@pytest.fixture
+def app(tmp_path):
+    return App(AppConfig(wal_dir=str(tmp_path / "wal")))
+
+
+def test_search_request_param_roundtrip():
+    req = _mk_req({"service.name": "front end", "x": "1"},
+                  min_duration_ms=1500, limit=30, start=100, end=200)
+    qs = build_search_request(req)
+    parsed = parse_search_request(
+        {k: v[0] for k, v in
+         __import__("urllib.parse", fromlist=["parse_qs"]).parse_qs(qs).items()}
+    )
+    assert dict(parsed.tags) == {"service.name": "front", "x": "1"} or \
+        dict(parsed.tags) == dict(req.tags)
+    assert parsed.min_duration_ms == 1500
+    assert parsed.limit == 30 and parsed.start == 100 and parsed.end == 200
+
+
+def test_http_api_routes(app):
+    api = HTTPApi(app)
+    tid = random_trace_id()
+    tr = make_trace(tid, seed=1)
+    app.push("t1", list(tr.batches))
+
+    hdr = {"X-Scope-OrgID": "t1"}
+    code, body = api.handle("GET", "/api/echo", {}, hdr)
+    assert code == 200 and body == "echo"
+    code, _ = api.handle("GET", "/ready", {}, hdr)
+    assert code == 200
+
+    code, body = api.handle("GET", f"/api/traces/{trace_id_to_hex(tid)}", {}, hdr)
+    assert code == 200
+    assert len(body["batches"]) == len(tr.batches)
+
+    # wrong tenant → 404
+    code, _ = api.handle("GET", f"/api/traces/{trace_id_to_hex(tid)}", {},
+                         {"X-Scope-OrgID": "other"})
+    assert code == 404
+
+    code, body = api.handle("GET", "/api/search", {"tags": "component=db",
+                                                   "limit": "10"}, hdr)
+    assert code == 200 and "traces" in body or body == {}
+
+    code, body = api.handle("GET", "/api/search/tags", {}, hdr)
+    assert code == 200 and "component" in body.get("tagNames", [])
+
+    code, body = api.handle("GET", "/api/search/tag/component/values", {}, hdr)
+    assert code == 200 and body.get("tagValues")
+
+    code, body = api.handle("GET", "/status", {}, hdr)
+    assert code == 200 and body["ready"] is True
+
+    code, body = api.handle("GET", "/metrics", {}, hdr)
+    assert code == 200
+
+    # malformed trace id → 400
+    code, _ = api.handle("GET", "/api/traces/zzzz", {}, hdr)
+    assert code == 400
+
+
+def test_http_server_end_to_end(app):
+    api = HTTPApi(app)
+    server = serve_http(api, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        tid = random_trace_id()
+        app.push("t1", list(make_trace(tid, seed=2).batches))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/traces/{trace_id_to_hex(tid)}",
+            headers={"X-Scope-OrgID": "t1"},
+        )
+        with urllib.request.urlopen(req) as r:
+            body = json.loads(r.read())
+            assert r.status == 200 and body["batches"]
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/api/echo") as r:
+            assert r.read() == b"echo"
+    finally:
+        server.shutdown()
+
+
+def test_grpc_services_and_otlp_export(app):
+    server = make_grpc_server(app, "127.0.0.1:0")
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        addr = f"127.0.0.1:{port}"
+        tid = random_trace_id()
+        tr = make_trace(tid, seed=3)
+
+        # OTLP export: raw wire-compatible Export call
+        channel = grpc.insecure_channel(addr)
+        rpc = channel.unary_unary(
+            OTLP_EXPORT_METHOD,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=tempopb.Trace.FromString,
+        )
+        rpc(tr, metadata=(("x-scope-orgid", "t1"),))
+
+        # query it back over the Querier service
+        qc = QuerierClient(addr)
+        resp = qc.find_trace_by_id("t1", tid)
+        assert len(resp.trace.batches) == len(tr.batches)
+
+        sreq = _mk_req({})
+        sreq.limit = 10
+        sresp = qc.search_recent("t1", sreq)
+        assert len(sresp.traces) == 1
+
+        tags = qc.search_tags("t1")
+        assert "service.name" in tags.tag_names
+
+        # Pusher service: push pre-marshalled segments
+        pc = PusherClient(addr)
+        from tempo_tpu.model.codec import segment_codec_for
+        from tempo_tpu.search.data import extract_search_data, encode_search_data
+
+        tid2 = random_trace_id()
+        tr2 = make_trace(tid2, seed=4)
+        sd = extract_search_data(tid2, tr2)
+        push = tempopb.PushBytesRequest()
+        push.ids.append(tid2)
+        push.traces.append(segment_codec_for("v2").prepare_for_write(tr2, 1, 2))
+        push.search_data.append(encode_search_data(sd))
+        pc.push_bytes("t1", push)
+        resp2 = qc.find_trace_by_id("t1", tid2)
+        assert len(resp2.trace.batches) == len(tr2.batches)
+    finally:
+        server.stop(grace=None)
+
+
+def test_config_load_and_env_expand(tmp_path, monkeypatch):
+    monkeypatch.setenv("BLOCK_PATH", "/data/blocks")
+    text = """
+server: {http_port: 3201}
+storage:
+  backend: local
+  local: {path: ${BLOCK_PATH}}
+  wal_dir: ${WAL_DIR:/data/wal}
+ingester: {n_ingesters: 2, replication_factor: 3}
+overrides:
+  defaults: {max_live_traces: 123}
+  per_tenant:
+    vip: {max_live_traces: 999}
+"""
+    cfg, runtime = load_config(text=text)
+    assert cfg.backend["local"]["path"] == "/data/blocks"
+    assert cfg.wal_dir == "/data/wal"
+    assert cfg.limits.max_live_traces == 123
+    assert cfg.per_tenant_overrides["vip"]["max_live_traces"] == 999
+    assert runtime["http_port"] == 3201
+    # footgun warning: rf > ingesters
+    assert any("replication_factor" in w for w in runtime["warnings"])
+
+
+def test_metrics_registry():
+    from tempo_tpu.observability.metrics import Registry, Counter, Histogram
+
+    reg = Registry()
+    c = Counter("test_total", "help", registry=reg)
+    c.inc(tenant="a")
+    c.inc(2, tenant="a")
+    c.inc(tenant="b")
+    assert c.value(tenant="a") == 3
+    h = Histogram("test_seconds", "help", registry=reg)
+    h.observe(0.3)
+    out = reg.expose()
+    assert 'test_total{tenant="a"} 3' in out
+    assert "test_seconds_bucket" in out and "test_seconds_count 1" in out
