@@ -100,25 +100,40 @@ def cpu_scan(pages, cq):
     return int(mask.sum())
 
 
-def main():
-    n_entries = int(os.environ.get("BENCH_ENTRIES", 1_000_000))
-    iters = int(os.environ.get("BENCH_ITERS", 20))
+def _timed_rate(enqueue_fn, fetch_fn, n_entries, iters):
+    """Through the axon relay, block_until_ready returns early; only a real
+    D2H fetch synchronizes. Device execution is in-order, so enqueue N
+    kernels and fetch the last — the delta over a single enqueue+fetch
+    isolates true per-iteration device time from relay fetch latency."""
+    def run(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = enqueue_fn()
+        fetch_fn(out)
+        return time.perf_counter() - t0
 
+    t_one = run(1)
+    t_many = run(iters + 1)
+    per_iter = max((t_many - t_one) / iters, 1e-9)
+    return n_entries / per_iter
+
+
+def bench_single_block(n_entries, iters):
+    """Config 1+3: single corpus, 2-term AND + duration (the headline)."""
     from tempo_tpu import tempopb
     from tempo_tpu.search.engine import ScanEngine, stage
     from tempo_tpu.search.pipeline import compile_query
 
     pages = build_corpus(n_entries)
-
     req = tempopb.SearchRequest()
     req.tags["service.name"] = "svc-007"
     req.tags["http.status_code"] = "500"
     req.min_duration_ms = 500
     req.limit = 20
     cq = compile_query(pages.key_dict, pages.val_dict, req)
-    assert cq is not None
+    assert cq is not None, "bench query pruned the corpus block"
 
-    # ---- CPU baseline ----
     cpu_count = cpu_scan(pages, cq)
     t0 = time.perf_counter()
     cpu_iters = max(1, min(3, iters))
@@ -126,28 +141,96 @@ def main():
         cpu_scan(pages, cq)
     cpu_rate = n_entries * cpu_iters / (time.perf_counter() - t0)
 
-    # ---- TPU engine ----
-    # NOTE on timing: through the axon relay, block_until_ready returns
-    # early; only a real D2H fetch synchronizes. Device execution is
-    # in-order, so enqueue N kernels and fetch the last — the delta over a
-    # single enqueue+fetch isolates true per-iteration device time from
-    # the (relay-inflated) fetch latency.
     eng = ScanEngine(top_k=128)
     sp = stage(pages)
-    count, inspected, scores, idx = eng.scan_staged(sp, cq)  # compile+warm
+    count, _, _, _ = eng.scan_staged(sp, cq)  # compile+warm
     assert count == cpu_count, f"device {count} != cpu {cpu_count}"
+    tpu_rate = _timed_rate(lambda: eng.scan_staged_async(sp, cq),
+                           lambda out: int(out[0]), n_entries, iters)
 
-    def enqueue_n_fetch(n):
-        t0 = time.perf_counter()
-        for _ in range(n):
-            c, _, s_, i_ = eng.scan_staged_async(sp, cq)
-        _ = int(c)  # fetch of the last result waits for all prior kernels
-        return time.perf_counter() - t0
+    # duration-only filter (config 3) on the same staged corpus
+    dreq = tempopb.SearchRequest()
+    dreq.min_duration_ms = 30_000
+    dreq.limit = 20
+    dcq = compile_query(pages.key_dict, pages.val_dict, dreq)
+    eng.scan_staged(sp, dcq)
+    dur_rate = _timed_rate(lambda: eng.scan_staged_async(sp, dcq),
+                           lambda out: int(out[0]), n_entries, iters)
+    return tpu_rate, cpu_rate, int(count), dur_rate
 
-    t_one = enqueue_n_fetch(1)
-    t_many = enqueue_n_fetch(iters + 1)
-    per_iter = max((t_many - t_one) / iters, 1e-9)
-    tpu_rate = n_entries / per_iter
+
+def bench_multiblock(n_blocks, entries_per_block, iters):
+    """Config 2: many blocks batched into one kernel call."""
+    from tempo_tpu import tempopb
+    from tempo_tpu.search.multiblock import (
+        MultiBlockEngine, compile_multi, stack_blocks,
+    )
+
+    blocks = [build_corpus(entries_per_block, seed=s) for s in range(n_blocks)]
+    req = tempopb.SearchRequest()
+    req.tags["service.name"] = "svc-007"
+    req.tags["http.status_code"] = "500"
+    req.limit = 20
+    mq = compile_multi(blocks, req)
+    assert mq is not None, "bench query pruned every block"
+    batch = stack_blocks(blocks)
+    eng = MultiBlockEngine(top_k=128)
+    count, inspected, _, _ = eng.scan(batch, mq)
+    total = n_blocks * entries_per_block
+    assert inspected == total
+    rate = _timed_rate(lambda: eng.scan_async(batch, mq),
+                       lambda out: int(out[0]), total, iters)
+    return rate, int(count)
+
+
+def bench_high_cardinality(n_entries, cardinality, iters):
+    """Config 4: substring search against a huge value dictionary — the
+    dictionary prefilter (native memmem scan) + device scan."""
+    import numpy as np
+
+    from tempo_tpu import tempopb
+    from tempo_tpu.search.engine import ScanEngine, stage
+    from tempo_tpu.search.pipeline import compile_query, pack_val_dict
+
+    pages = build_corpus(n_entries)
+    # swap the region column for a high-cardinality id attribute
+    vd = [f"session-{i:08d}" for i in range(cardinality)]
+    rng = np.random.default_rng(3)
+    hits = rng.integers(0, cardinality, size=pages.kv_val[:, :, 2].shape)
+    base = len(pages.val_dict)
+    pages.val_dict = pages.val_dict + vd
+    pages.kv_val[:, :, 2] = base + hits
+
+    req = tempopb.SearchRequest()
+    req.tags["region"] = "session-0000123"  # prefix → 10 matching values
+    req.limit = 20
+    packed = pack_val_dict(pages.val_dict)
+    t0 = time.perf_counter()
+    cq = compile_query(pages.key_dict, pages.val_dict, req, packed_vals=packed)
+    compile_ms = (time.perf_counter() - t0) * 1e3
+    assert cq is not None, (
+        "high-cardinality query matched no dictionary values — "
+        "BENCH_CARDINALITY must exceed ~1240 so the session prefix exists"
+    )
+    eng = ScanEngine(top_k=128)
+    sp = stage(pages)
+    count, _, _, _ = eng.scan_staged(sp, cq)
+    rate = _timed_rate(lambda: eng.scan_staged_async(sp, cq),
+                       lambda out: int(out[0]), n_entries, iters)
+    return rate, int(count), compile_ms
+
+
+def main():
+    n_entries = int(os.environ.get("BENCH_ENTRIES", 1_000_000))
+    iters = int(os.environ.get("BENCH_ITERS", 20))
+    n_blocks = int(os.environ.get("BENCH_BLOCKS", 100))
+    cardinality = int(os.environ.get("BENCH_CARDINALITY", 1_000_000))
+
+    tpu_rate, cpu_rate, matches, dur_rate = bench_single_block(n_entries, iters)
+    mb_rate, mb_matches = bench_multiblock(
+        n_blocks, max(1024, n_entries // n_blocks), iters)
+    hc_rate, hc_matches, hc_compile_ms = bench_high_cardinality(
+        n_entries, cardinality, iters)
 
     import jax
 
@@ -160,10 +243,23 @@ def main():
             "platform": jax.devices()[0].platform,
             "device": str(jax.devices()[0]),
             "n_entries": n_entries,
-            "n_pages": pages.n_pages,
-            "matches": int(count),
+            "matches": matches,
             "cpu_traces_per_sec": round(cpu_rate),
             "query": "service.name=svc-007 AND http.status_code=500 AND dur>=500ms",
+            "configs": {
+                "duration_only_traces_per_sec": round(dur_rate),
+                "multiblock": {
+                    "blocks": n_blocks,
+                    "traces_per_sec": round(mb_rate),
+                    "matches": mb_matches,
+                },
+                "high_cardinality": {
+                    "distinct_values": cardinality,
+                    "traces_per_sec": round(hc_rate),
+                    "dict_prefilter_ms": round(hc_compile_ms, 1),
+                    "matches": hc_matches,
+                },
+            },
         },
     }))
 

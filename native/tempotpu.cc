@@ -5,6 +5,9 @@
 // Exposed as a C ABI consumed via ctypes (tempo_tpu/ops/native.py).
 // All functions return the produced byte count, or a negative error code.
 
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE  // memmem
+#endif
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
@@ -80,6 +83,34 @@ long long tt_snappy_decompress(const char* src, size_t src_len,
   size_t out_len = dst_cap;
   if (snappy_uncompress(src, src_len, dst, &out_len) != SNAPPY_OK) return -1;
   return (long long)out_len;
+}
+
+// Dictionary substring scan: find all strings in a packed dictionary
+// containing `needle`. Packed layout: concatenated utf-8 bytes + an
+// (n+1)-entry offset table. This is the 10M-distinct-values answer for
+// substring (bytes.Contains) semantics — the host-side prefilter of the
+// TPU search engine — where python-level scanning is too slow.
+long long tt_substr_scan(const char* buf, const long long* offsets,
+                         long long n_strs, const char* needle,
+                         long long needle_len, int* out_ids,
+                         long long out_cap) {
+  long long found = 0;
+  if (needle_len == 0) {
+    if (n_strs > out_cap) return -2;  // grow, never truncate silently
+    for (long long i = 0; i < n_strs; i++)
+      out_ids[found++] = (int)i;
+    return found;
+  }
+  for (long long i = 0; i < n_strs; i++) {
+    long long len = offsets[i + 1] - offsets[i];
+    if (len < needle_len) continue;
+    const char* s = buf + offsets[i];
+    if (memmem(s, (size_t)len, needle, (size_t)needle_len) != nullptr) {
+      if (found >= out_cap) return -2;  // caller must grow out buffer
+      out_ids[found++] = (int)i;
+    }
+  }
+  return found;
 }
 
 // xxhash64 (XXH64) — self-contained implementation so we do not depend on

@@ -27,6 +27,7 @@ def _load():
     if _TRIED:
         return _LIB
     _TRIED = True
+    rebuilt = False
     for attempt in (0, 1):
         for p in _SO_PATHS:
             p = os.path.abspath(p)
@@ -36,9 +37,17 @@ def _load():
                     _bind(lib)
                     _LIB = lib
                     return _LIB
+                except AttributeError:
+                    # stale .so missing a newer symbol — rebuild once, then
+                    # give up gracefully (fallback paths take over)
+                    if not rebuilt:
+                        rebuilt = True
+                        os.unlink(p)
+                        _try_build()
+                        continue
                 except OSError:
                     continue
-        if attempt == 0:
+        if attempt == 0 and not rebuilt:
             _try_build()
     return _LIB
 
@@ -73,6 +82,12 @@ def _bind(lib):
                                      ctypes.c_int]
     lib.tt_xxhash64.restype = ctypes.c_ulonglong
     lib.tt_xxhash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_ulonglong]
+    lib.tt_substr_scan.restype = ctypes.c_longlong
+    lib.tt_substr_scan.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+        ctypes.c_char_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_longlong,
+    ]
 
 
 def available() -> bool:
@@ -137,3 +152,26 @@ def snappy_decompress(data: bytes) -> bytes:
 def xxhash64(data: bytes, seed: int = 0) -> int:
     lib = _load()
     return int(lib.tt_xxhash64(data, len(data), seed))
+
+
+def substr_scan(packed: bytes, offsets, needle: bytes):
+    """Ids of packed-dictionary strings containing `needle`.
+    `offsets` is an int64 numpy array of n+1 byte offsets."""
+    import numpy as np
+
+    lib = _load()
+    n = len(offsets) - 1
+    cap = max(1024, n // 8)
+    off_p = offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+    while True:
+        out = np.empty(cap, dtype=np.int32)
+        got = lib.tt_substr_scan(
+            packed, off_p, n, needle, len(needle),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), cap,
+        )
+        if got == -2:
+            cap = min(n, cap * 8)
+            continue
+        if got < 0:
+            raise RuntimeError(f"tt_substr_scan failed ({got})")
+        return out[:got].copy()

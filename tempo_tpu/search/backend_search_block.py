@@ -86,7 +86,14 @@ class BackendSearchBlock:
             return results
 
         sp = self.staged()
-        cq = compile_query(sp.pages.key_dict, sp.pages.val_dict, req)
+        from tempo_tpu.ops import native
+        from tempo_tpu.search.pipeline import NATIVE_SCAN_THRESHOLD
+
+        packed = (sp.pages.packed_val_dict()
+                  if req.tags and native.available()
+                  and len(sp.pages.val_dict) >= NATIVE_SCAN_THRESHOLD else None)
+        cq = compile_query(sp.pages.key_dict, sp.pages.val_dict, req,
+                           packed_vals=packed)
         if cq is None:  # dictionary prefilter pruned the block
             results.metrics.skipped_blocks += 1
             return results

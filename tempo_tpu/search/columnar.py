@@ -78,6 +78,16 @@ class ColumnarPages:
     def n_pages(self) -> int:
         return self.kv_key.shape[0]
 
+    def packed_val_dict(self) -> tuple:
+        """Cached (bytes, offsets) packing for the native substring scan
+        (huge dictionaries — see pipeline.substring_value_ids)."""
+        cached = getattr(self, "_packed_vals", None)
+        if cached is None:
+            from .pipeline import pack_val_dict
+
+            cached = self._packed_vals = pack_val_dict(self.val_dict)
+        return cached
+
     # ------------------------------------------------------------------
     # build
 

@@ -1,0 +1,239 @@
+"""Multi-block batched scanning.
+
+The reference searches blocks one job at a time (10 MiB page ranges per
+job, searchsharding.go); on TPU the economics invert — kernel dispatch
+has fixed cost and HBM is huge, so MANY blocks batch into ONE kernel
+call: block page-arrays concatenate along the page axis (geometry is
+uniform per (E, C) bucket), a per-page block-id column maps results back,
+and the query compiles once against a MERGED dictionary space.
+
+Dictionary merging: each block has private key/val dictionaries. Rather
+than re-encoding blocks to a global dictionary (expensive write-side),
+the query compiles per block — per-page TERM COLUMNS: for block b and
+term t, the key id and value ranges differ; we build [P_total] per-term
+key-id arrays and range tables indexed by each page's block, so the
+kernel's compares stay uniform. This is the context-parallel analog of
+SURVEY.md §5 long-context: the corpus axis (blocks × pages) is the
+sequence axis, sharded over the mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tempo_tpu import tempopb
+from .columnar import ColumnarPages
+from .engine import DEFAULT_TOP_K, masked_topk
+from .pipeline import (
+    CompiledQuery,
+    compile_query,
+    ids_to_ranges,
+    INT32_SENTINEL,
+    UINT32_MAX,
+)
+
+import functools
+
+
+@dataclass
+class BlockBatch:
+    """Several blocks' pages stacked along the page axis on device."""
+    device: dict                    # arrays [P_total, ...]
+    page_block: np.ndarray          # int32 [P_total] block index per page
+    blocks: list                    # list[ColumnarPages]
+    page_offset: list               # start page of each block in the stack
+
+    @property
+    def n_pages(self) -> int:
+        return int(self.page_block.shape[0])
+
+
+def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None) -> BlockBatch:
+    """Concatenate uniform-geometry blocks along the page axis."""
+    E = blocks[0].geometry.entries_per_page
+    C = max(b.geometry.kv_per_entry for b in blocks)
+    arrays = {name: [] for name in ("kv_key", "kv_val", "entry_start",
+                                    "entry_end", "entry_dur", "entry_valid")}
+    page_block = []
+    page_offset = []
+    total = 0
+    for bi, b in enumerate(blocks):
+        if b.geometry.entries_per_page != E:
+            raise ValueError("blocks must share entries_per_page to batch")
+        page_offset.append(total)
+        P = b.n_pages
+        for name in arrays:
+            arr = getattr(b, name)
+            if name in ("kv_key", "kv_val") and arr.shape[2] < C:
+                pad = np.full((P, E, C - arr.shape[2]), -1, dtype=np.int32)
+                arr = np.concatenate([arr, pad], axis=2)
+            arrays[name].append(arr)
+        page_block.extend([bi] * P)
+        total += P
+    cat = {k: np.concatenate(v, axis=0) for k, v in arrays.items()}
+    page_block = np.asarray(page_block, dtype=np.int32)
+
+    if pad_to and pad_to > total:
+        extra = pad_to - total
+        for name, arr in cat.items():
+            pad = np.zeros((extra,) + arr.shape[1:], dtype=arr.dtype)
+            if name in ("kv_key", "kv_val"):
+                pad -= 1
+            cat[name] = np.concatenate([arr, pad], axis=0)
+        page_block = np.concatenate([
+            page_block, np.full(extra, -1, dtype=np.int32)
+        ])
+
+    dev = {k: jnp.asarray(v) for k, v in cat.items()}
+    dev["page_block"] = jnp.asarray(page_block)
+    return BlockBatch(device=dev, page_block=page_block, blocks=blocks,
+                      page_offset=page_offset)
+
+
+@dataclass
+class MultiQuery:
+    """Per-block compiled query folded into block-indexed tables."""
+    term_keys: np.ndarray    # int32 [B, T] key id per (block, term); -1 = prune
+    val_ranges: np.ndarray   # int32 [B, T, R, 2]
+    dur_lo: int
+    dur_hi: int
+    win_start: int
+    win_end: int
+    limit: int
+    n_terms: int
+
+
+def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest) -> MultiQuery | None:
+    """Compile the request against every block's dictionaries; blocks that
+    prune get key id -1 (no page of theirs can match)."""
+    from tempo_tpu.ops import native
+    from .pipeline import NATIVE_SCAN_THRESHOLD
+
+    use_packed = bool(req.tags) and native.available()
+    per_block: list[CompiledQuery | None] = [
+        compile_query(
+            b.key_dict, b.val_dict, req,
+            packed_vals=(b.packed_val_dict()
+                         if use_packed and len(b.val_dict) >= NATIVE_SCAN_THRESHOLD
+                         else None),
+        )
+        for b in blocks
+    ]
+    if all(cq is None for cq in per_block):
+        return None
+    T = len(req.tags)
+    B = len(blocks)
+    rmax = 1
+    for cq in per_block:
+        if cq is not None and cq.n_terms:
+            rmax = max(rmax, cq.val_ranges.shape[1])
+    R = 1
+    while R < rmax:
+        R *= 2
+    term_keys = np.full((B, max(1, T)), -1, dtype=np.int32)
+    val_ranges = np.tile(np.array([1, 0], dtype=np.int32), (B, max(1, T), R, 1))
+    for b, cq in enumerate(per_block):
+        if cq is None:
+            continue
+        for t in range(cq.n_terms):
+            term_keys[b, t] = cq.term_keys[t]
+            r = cq.val_ranges[t]
+            val_ranges[b, t, : r.shape[0]] = r
+
+    any_cq = next(cq for cq in per_block if cq is not None)
+    return MultiQuery(
+        term_keys=term_keys, val_ranges=val_ranges,
+        dur_lo=any_cq.dur_lo, dur_hi=any_cq.dur_hi,
+        win_start=any_cq.win_start, win_end=any_cq.win_end,
+        limit=any_cq.limit, n_terms=T,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_terms", "top_k"))
+def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
+                      entry_valid, page_block, term_keys, val_ranges,
+                      dur_lo, dur_hi, win_start, win_end,
+                      *, n_terms: int, top_k: int):
+    """Like scan_kernel but term columns are selected per page through the
+    page_block index: key id and ranges become [P]-indexed gathers over the
+    SMALL [B,...] tables (cheap — B entries, not 8M)."""
+    safe_block = jnp.maximum(page_block, 0)
+    mask = entry_valid & (page_block >= 0)[:, None]
+    if n_terms:
+        def term_body(t, acc):
+            k_per_page = term_keys[safe_block, t]          # [P]
+            keym = kv_key == k_per_page[:, None, None]     # [P,E,C]
+            lo = val_ranges[safe_block, t, :, 0]           # [P,R]
+            hi = val_ranges[safe_block, t, :, 1]
+            v = kv_val[..., None]                          # [P,E,C,1]
+            valm = ((v >= lo[:, None, None, :]) &
+                    (v <= hi[:, None, None, :])).any(-1)   # [P,E,C]
+            return acc & jnp.any(keym & valm, axis=-1)
+
+        mask = jax.lax.fori_loop(0, n_terms, term_body, mask)
+
+    dur = entry_dur.astype(jnp.uint32)
+    mask = mask & (dur >= dur_lo.astype(jnp.uint32)) & (dur <= dur_hi.astype(jnp.uint32))
+    mask = mask & (entry_end.astype(jnp.uint32) >= win_start.astype(jnp.uint32))
+    mask = mask & (entry_start.astype(jnp.uint32) <= win_end.astype(jnp.uint32))
+
+    count = jnp.sum(mask, dtype=jnp.int32)
+    inspected = jnp.sum(entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
+    scores, idx = masked_topk(mask, entry_start, top_k)
+    return count, inspected, scores, idx
+
+
+class MultiBlockEngine:
+    def __init__(self, top_k: int = DEFAULT_TOP_K):
+        self.top_k = top_k
+
+    def scan_async(self, batch: BlockBatch, mq: MultiQuery):
+        """Dispatch without device→host sync; returns device arrays."""
+        k = self.top_k
+        while k < mq.limit:
+            k *= 2
+        d = batch.device
+        return multi_scan_kernel(
+            d["kv_key"], d["kv_val"], d["entry_start"], d["entry_end"],
+            d["entry_dur"], d["entry_valid"], d["page_block"],
+            jnp.asarray(mq.term_keys), jnp.asarray(mq.val_ranges),
+            jnp.uint32(mq.dur_lo), jnp.uint32(min(mq.dur_hi, UINT32_MAX)),
+            jnp.uint32(mq.win_start), jnp.uint32(min(mq.win_end, UINT32_MAX)),
+            n_terms=mq.n_terms, top_k=k,
+        )
+
+    def scan(self, batch: BlockBatch, mq: MultiQuery):
+        count, inspected, scores, idx = self.scan_async(batch, mq)
+        return int(count), int(inspected), np.asarray(scores), np.asarray(idx)
+
+    def results(self, batch: BlockBatch, mq: MultiQuery,
+                scores: np.ndarray, idx: np.ndarray) -> list:
+        E = batch.blocks[0].geometry.entries_per_page
+        out = []
+        for s, i in zip(scores.tolist(), idx.tolist()):
+            if s < 0 or len(out) >= mq.limit:
+                break
+            p, e = divmod(i, E)
+            if p >= batch.n_pages:
+                continue
+            bi = int(batch.page_block[p])
+            if bi < 0:
+                continue
+            pages = batch.blocks[bi]
+            lp = p - batch.page_offset[bi]
+            m = tempopb.TraceSearchMetadata()
+            m.trace_id = bytes(pages.trace_ids[lp, e]).hex()
+            m.start_time_unix_nano = int(pages.entry_start[lp, e]) * 1_000_000_000
+            m.duration_ms = int(pages.entry_dur[lp, e])
+            svc = int(pages.entry_root_svc[lp, e])
+            name = int(pages.entry_root_name[lp, e])
+            if svc >= 0:
+                m.root_service_name = pages.val_dict[svc]
+            if name >= 0:
+                m.root_trace_name = pages.val_dict[name]
+            out.append(m)
+        return out

@@ -69,21 +69,45 @@ def matches_block_header(header: dict, req: tempopb.SearchRequest) -> bool:
     return True
 
 
-def substring_value_ids(val_dict: list, needle: str) -> np.ndarray:
+NATIVE_SCAN_THRESHOLD = 50_000
+
+
+def substring_value_ids(val_dict: list, needle: str,
+                        packed: tuple | None = None) -> np.ndarray:
     """Ids of dictionary values containing `needle` — the host-side answer
-    to bytes.Contains semantics (SURVEY.md §7 hard parts). Vectorized over
-    the whole dictionary; empty needle matches everything."""
+    to bytes.Contains semantics (SURVEY.md §7 hard parts). Small
+    dictionaries scan vectorized in numpy; huge ones (the 10M-distinct-
+    values BASELINE config) go through the native C++ memmem scan over a
+    packed byte dictionary (`packed` = (bytes, int64 offsets), cacheable
+    per block via ColumnarPages.packed_val_dict)."""
     if not needle:
         return np.arange(len(val_dict), dtype=np.int32)
     if not val_dict:
         return np.zeros(0, dtype=np.int32)
+    if len(val_dict) >= NATIVE_SCAN_THRESHOLD:
+        from tempo_tpu.ops import native
+
+        if native.available():
+            if packed is None:
+                packed = pack_val_dict(val_dict)
+            buf, offsets = packed
+            return native.substr_scan(buf, offsets, needle.encode("utf-8"))
     arr = np.array(val_dict, dtype=np.str_)
     hits = np.char.find(arr, needle) >= 0
     return np.nonzero(hits)[0].astype(np.int32)
 
 
+def pack_val_dict(val_dict: list) -> tuple:
+    """(concatenated utf-8 bytes, int64 offsets[n+1]) for the native scan."""
+    blobs = [v.encode("utf-8") for v in val_dict]
+    offsets = np.zeros(len(blobs) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    return b"".join(blobs), offsets
+
+
 def compile_query(key_dict: list, val_dict: list,
-                  req: tempopb.SearchRequest) -> CompiledQuery | None:
+                  req: tempopb.SearchRequest,
+                  packed_vals: tuple | None = None) -> CompiledQuery | None:
     """Returns None when the block provably cannot match (key absent from
     the key dictionary, or no dictionary value satisfies a term)."""
     term_key_ids = []
@@ -92,7 +116,7 @@ def compile_query(key_dict: list, val_dict: list,
         i = bisect.bisect_left(key_dict, k)
         if i >= len(key_dict) or key_dict[i] != k:
             return None
-        ids = substring_value_ids(val_dict, v)
+        ids = substring_value_ids(val_dict, v, packed=packed_vals)
         if ids.size == 0:
             return None
         term_key_ids.append(i)

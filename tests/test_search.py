@@ -254,3 +254,72 @@ def test_columnar_adaptive_kv_capacity():
     pages2 = ColumnarPages.build([wide], PageGeometry(4, 8))
     assert pages2.geometry.kv_per_entry == 8
     assert pages2.header["truncated_entries"] == 1
+
+
+def test_native_substr_scan_matches_numpy():
+    from tempo_tpu.ops import native
+    from tempo_tpu.search.pipeline import pack_val_dict
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    vd = sorted({f"val-{i:06d}-{'x' if i % 3 else 'special'}" for i in range(10_000)})
+    buf, offsets = pack_val_dict(vd)
+    for needle in ("special", "val-0001", "zzz", "", "-x"):
+        got = native.substr_scan(buf, offsets, needle.encode()).tolist()
+        arr = np.array(vd, dtype=np.str_)
+        want = np.nonzero(np.char.find(arr, needle) >= 0)[0].tolist()
+        assert got == want, needle
+
+
+def test_multiblock_scan_matches_per_block():
+    from tempo_tpu.search.multiblock import (
+        MultiBlockEngine, compile_multi, stack_blocks,
+    )
+
+    corpora = [_corpus(120, seed=s) for s in range(4)]
+    blocks = [
+        ColumnarPages.build(entries, PageGeometry(32, 8))
+        for entries in corpora
+    ]
+    req = _mk_req({"service.name": "frontend"})
+    req.limit = 1000
+    mq = compile_multi(blocks, req)
+    assert mq is not None
+    batch = stack_blocks(blocks, pad_to=32)
+    eng = MultiBlockEngine(top_k=1024)
+    count, inspected, scores, idx = eng.scan(batch, mq)
+
+    expected = {
+        sd.trace_id
+        for entries in corpora for sd in entries
+        if search_data_matches(sd, req)
+    }
+    assert inspected == 480
+    assert count == len(expected)
+    got = {bytes.fromhex(m.trace_id) for m in eng.results(batch, mq, scores, idx)}
+    assert got == expected
+
+
+def test_multiblock_per_block_dictionaries_differ():
+    """The same tag value gets DIFFERENT ids in different blocks — the
+    per-page term tables must still resolve correctly."""
+    from tempo_tpu.search.multiblock import (
+        MultiBlockEngine, compile_multi, stack_blocks,
+    )
+
+    a = SearchData(trace_id=b"\x01" * 16, start_s=10, end_s=20, dur_ms=5)
+    a.kvs = {"k": {"target"}, "zz": {"aaaa"}}
+    b = SearchData(trace_id=b"\x02" * 16, start_s=10, end_s=20, dur_ms=5)
+    b.kvs = {"k": {"target"}, "aa": {"zzzz"}}  # shifts dictionary ids
+    c = SearchData(trace_id=b"\x03" * 16, start_s=10, end_s=20, dur_ms=5)
+    c.kvs = {"k": {"other"}}
+    blocks = [ColumnarPages.build([a], PageGeometry(4, 8)),
+              ColumnarPages.build([b, c], PageGeometry(4, 8))]
+    req = _mk_req({"k": "target"})
+    req.limit = 10
+    mq = compile_multi(blocks, req)
+    batch = stack_blocks(blocks)
+    eng = MultiBlockEngine()
+    count, _, scores, idx = eng.scan(batch, mq)
+    assert count == 2
+    got = {m.trace_id for m in eng.results(batch, mq, scores, idx)}
+    assert got == {(b"\x01" * 16).hex(), (b"\x02" * 16).hex()}
