@@ -43,13 +43,38 @@ class HTTPApi:
             return DEFAULT_TENANT
         return headers.get(HEADER_TENANT) or DEFAULT_TENANT
 
-    def handle(self, method: str, path: str, query: dict, headers) -> tuple[int, dict | str]:
+    def handle(self, method: str, path: str, query: dict, headers,
+               body: bytes = b"") -> tuple[int, dict | str]:
         try:
+            if method == "POST" and path in ("/v1/traces", "/api/v2/spans"):
+                return self._ingest(path, body, headers)
             return self._route(method, path, query, headers)
         except ValueError as e:
             return 400, {"error": str(e)}
         except Exception as e:  # noqa: BLE001 — surface as 500
             return 500, {"error": f"{type(e).__name__}: {e}"}
+
+    def _ingest(self, path: str, body: bytes, headers):
+        """HTTP ingest receivers: OTLP/HTTP protobuf and Zipkin v2 JSON
+        (api/receivers.py). Malformed payloads are CLIENT errors — a 500
+        would make exporters retry their own bad bodies forever."""
+        import json as _json
+
+        from google.protobuf.message import DecodeError
+
+        from .receivers import otlp_http_to_batches, zipkin_json_to_batches
+
+        tenant = self.tenant(headers)
+        try:
+            if path == "/v1/traces":
+                batches = otlp_http_to_batches(body)
+            else:
+                batches = zipkin_json_to_batches(body)
+        except (DecodeError, KeyError, TypeError, _json.JSONDecodeError) as e:
+            return 400, {"error": f"malformed payload: {type(e).__name__}: {e}"}
+        if batches:
+            self.app.push(tenant, batches)
+        return 200, {"accepted_batches": len(batches)}
 
     def _route(self, method, path, query, headers):
         tenant = self.tenant(headers)
@@ -122,7 +147,12 @@ def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
             self._reply(code, body)
 
         def do_POST(self):  # noqa: N802
-            self.do_GET()
+            u = urlparse(self.path)
+            query = {k: v[0] for k, v in parse_qs(u.query).items()}
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            code, out = api.handle("POST", u.path, query, self.headers, body)
+            self._reply(code, out)
 
         def _reply(self, code, body):
             if isinstance(body, (dict, list)):

@@ -1,0 +1,71 @@
+"""Additional ingest receivers.
+
+Role-equivalent to the reference's modules/distributor/receiver shim
+(embedding otel-collector receiver factories for otlp/jaeger/zipkin/
+opencensus/kafka/pubsub-lite — shim.go:75-138). Implemented natively:
+
+  - OTLP gRPC: api/grpc_service.py (wire-compatible Trace, zero shim)
+  - OTLP HTTP: POST /v1/traces, protobuf body (this module)
+  - Zipkin v2 JSON: POST /api/v2/spans (this module)
+  - Jaeger / Kafka / OpenCensus / pubsub-lite: carrier protocols that
+    need their client libs; the translate-and-push pattern below is the
+    extension point (gated in this zero-egress environment).
+"""
+
+from __future__ import annotations
+
+import json
+
+from tempo_tpu import tempopb
+from tempo_tpu.utils.ids import pad_trace_id
+
+_ZIPKIN_KIND = {
+    "CLIENT": tempopb.Span.SPAN_KIND_CLIENT,
+    "SERVER": tempopb.Span.SPAN_KIND_SERVER,
+    "PRODUCER": tempopb.Span.SPAN_KIND_PRODUCER,
+    "CONSUMER": tempopb.Span.SPAN_KIND_CONSUMER,
+}
+
+
+def zipkin_json_to_batches(body: bytes) -> list:
+    """Zipkin v2 JSON span array → list[ResourceSpans], one batch per
+    local service name."""
+    spans = json.loads(body)
+    if not isinstance(spans, list):
+        raise ValueError("zipkin v2 body must be a JSON array of spans")
+    by_service: dict[str, tempopb.ResourceSpans] = {}
+    for z in spans:
+        svc = ((z.get("localEndpoint") or {}).get("serviceName")) or "unknown"
+        rs = by_service.get(svc)
+        if rs is None:
+            rs = by_service[svc] = tempopb.ResourceSpans()
+            kv = rs.resource.attributes.add()
+            kv.key = "service.name"
+            kv.value.string_value = svc
+            rs.scope_spans.add().scope.name = "zipkin-receiver"
+        s = rs.scope_spans[0].spans.add()
+        s.trace_id = pad_trace_id(bytes.fromhex(z["traceId"]))
+        s.span_id = bytes.fromhex(z["id"])[:8].rjust(8, b"\x00")
+        if z.get("parentId"):
+            s.parent_span_id = bytes.fromhex(z["parentId"])[:8].rjust(8, b"\x00")
+        s.name = z.get("name", "")
+        s.kind = _ZIPKIN_KIND.get(z.get("kind", ""), tempopb.Span.SPAN_KIND_UNSPECIFIED)
+        ts_us = int(z.get("timestamp", 0))
+        dur_us = int(z.get("duration", 0))
+        s.start_time_unix_nano = ts_us * 1000
+        s.end_time_unix_nano = (ts_us + dur_us) * 1000
+        for k, v in (z.get("tags") or {}).items():
+            kv = s.attributes.add()
+            kv.key = k
+            kv.value.string_value = str(v)
+        if (z.get("tags") or {}).get("error"):
+            s.status.code = tempopb.Status.STATUS_CODE_ERROR
+    return list(by_service.values())
+
+
+def otlp_http_to_batches(body: bytes) -> list:
+    """OTLP/HTTP protobuf ExportTraceServiceRequest → batches (our Trace
+    is wire-compatible)."""
+    t = tempopb.Trace()
+    t.ParseFromString(body)
+    return list(t.batches)

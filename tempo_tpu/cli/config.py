@@ -17,6 +17,9 @@ Role-equivalent to the reference's cmd/tempo config load (main.go:117-175
     ingester:
       n_ingesters: 1
       replication_factor: 1
+      write_quorum: majority    # or "one" (RF=2 eventual consistency)
+    querier:
+      external_endpoints: []    # serverless search-worker URLs
     compactor: {window_s: 3600, max_inputs: 8}
     retention: {block_s: 1209600, compacted_s: 3600}
     overrides:
@@ -72,6 +75,8 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         wal_dir=storage.get("wal_dir", "./tempo-wal"),
         n_ingesters=ingester.get("n_ingesters", 1),
         replication_factor=ingester.get("replication_factor", 1),
+        write_quorum=ingester.get("write_quorum", "majority"),
+        external_endpoints=doc.get("querier", {}).get("external_endpoints", []),
         db=db,
         limits=Limits(**{
             k: v for k, v in overrides.get("defaults", {}).items()

@@ -37,6 +37,8 @@ class AppConfig:
     frontend: FrontendConfig = field(default_factory=FrontendConfig)
     limits: Limits = field(default_factory=Limits)
     per_tenant_overrides: dict = field(default_factory=dict)
+    write_quorum: str = "majority"  # or "one" (RF=2 eventual consistency)
+    external_endpoints: list = field(default_factory=list)  # serverless workers
     flush_tick_s: float = 10.0
     poll_tick_s: float = 30.0
     compaction_tick_s: float = 30.0
@@ -64,9 +66,11 @@ class App:
                                  self.cfg.db)
         self.generator = MetricsGenerator()
         self.distributor = Distributor(self.ring, self.ingesters, self.overrides,
-                                       forwarder=self.generator.push_spans)
+                                       forwarder=self.generator.push_spans,
+                                       write_quorum=self.cfg.write_quorum)
         self.queriers = [
-            Querier(self.reader_db, self.ring, self.ingesters, self.overrides)
+            Querier(self.reader_db, self.ring, self.ingesters, self.overrides,
+                    external_endpoints=self.cfg.external_endpoints)
             for _ in range(self.cfg.n_queriers)
         ]
         self.frontend = QueryFrontend(self.queriers, self.cfg.frontend)

@@ -44,7 +44,8 @@ class DistributorMetrics:
 
 class Distributor:
     def __init__(self, ring: Ring, pushers: dict, overrides: Overrides | None = None,
-                 forwarder=None, forward_queue_size: int = 1000):
+                 forwarder=None, forward_queue_size: int = 1000,
+                 write_quorum: str = "majority"):
         """pushers: instance id → object with push_bytes(tenant, PushBytesRequest)
         (in-process Ingester or a gRPC client stub). forwarder: optional
         fn(tenant, batches) feeding the metrics-generator off the hot path
@@ -55,6 +56,10 @@ class Distributor:
         self.overrides = overrides or Overrides()
         self.codec = segment_codec_for(CURRENT_ENCODING)
         self.metrics = DistributorMetrics()
+        # "majority" (default) or "one" — the reference's RF=2
+        # EventuallyConsistentStrategy writes with quorum 1
+        # (pkg/ring/ring.go:16-98)
+        self.write_quorum = write_quorum
         self.forwarder = forwarder
         self._forward_queue = None
         if forwarder is not None:
@@ -139,7 +144,8 @@ class Distributor:
             # is durable iff a majority of its replicas took the write
             for tid, replicas in trace_replicas.items():
                 ok = sum(1 for iid in replicas if iid not in errs)
-                if ok < len(replicas) // 2 + 1:
+                need = 1 if self.write_quorum == "one" else len(replicas) // 2 + 1
+                if ok < need:
                     self.metrics.push_failures += 1
                     obs.push_failures.inc(tenant=tenant, reason="quorum")
                     raise IngestError(

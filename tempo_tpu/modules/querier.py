@@ -26,13 +26,27 @@ QUERY_MODE_ALL = "all"
 
 class Querier:
     def __init__(self, db: TempoDB, ring: Ring, ingesters: dict,
-                 overrides: Overrides | None = None):
+                 overrides: Overrides | None = None,
+                 external_endpoints: list | None = None,
+                 prefer_self: int = 10,
+                 external_hedge_after_s: float = 4.0):
         """ingesters: instance id → object with find_trace_by_id/search/
-        instance() (in-process Ingester or gRPC stub)."""
+        instance() (in-process Ingester or gRPC stub).
+
+        external_endpoints: serverless search-worker URLs; SearchBlock jobs
+        overflow to them when more than `prefer_self` jobs run locally
+        (reference querier.go:397-452: hedged external search with a
+        prefer-self semaphore)."""
+        import threading
+
         self.db = db
         self.ring = ring
         self.ingesters = ingesters
         self.overrides = overrides or Overrides()
+        self.external_endpoints = list(external_endpoints or [])
+        self._prefer_self = threading.Semaphore(prefer_self)
+        self.external_hedge_after_s = external_hedge_after_s
+        self._rr = 0
 
     # ---- trace by id (reference querier.go:171-249) ----
 
@@ -82,7 +96,38 @@ class Querier:
         return results.response()
 
     def search_block(self, req: tempopb.SearchBlockRequest) -> tempopb.SearchResponse:
+        if self.external_endpoints:
+            if self._prefer_self.acquire(blocking=False):
+                try:
+                    return self.db.search_block(req).response()
+                finally:
+                    self._prefer_self.release()
+            return self._search_external(req)
         return self.db.search_block(req).response()
+
+    def _search_external(self, req: tempopb.SearchBlockRequest) -> tempopb.SearchResponse:
+        """Proxy one job to a serverless search worker, hedged (reference
+        searchExternalEndpoint: up to 2 extra hedges)."""
+        import urllib.request
+
+        from tempo_tpu.db.hedge import hedged_call
+
+        body = req.SerializeToString()
+        endpoint = self.external_endpoints[self._rr % len(self.external_endpoints)]
+        self._rr += 1
+
+        def call():
+            r = urllib.request.Request(
+                endpoint.rstrip("/") + "/search-block", data=body,
+                headers={"Content-Type": "application/protobuf"},
+            )
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                out = tempopb.SearchResponse()
+                out.ParseFromString(resp.read())
+                return out
+
+        return hedged_call(call, hedge_after_s=self.external_hedge_after_s,
+                           max_hedges=2)
 
     # ---- tags ----
 

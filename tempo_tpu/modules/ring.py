@@ -114,6 +114,38 @@ class Ring:
         got = self.get(token, rf=1)
         return bool(got) and got[0] == instance_id
 
+    def shuffle_shard(self, tenant: str, size: int) -> "Ring":
+        """Deterministic, scale-stable per-tenant sub-ring (reference dskit
+        ShuffleShard, used for generator placement and frontend querier
+        limits — SURVEY.md §2.5): instance k of the shard is the first
+        distinct owner clockwise of hash(tenant, k) on the token ring, so
+        a join/leave only remaps the tenants whose walk crosses the
+        changed tokens — not every tenant at once."""
+        import hashlib
+
+        sub = Ring(replication_factor=min(self.rf, max(1, size)))
+        with self._lock:
+            if size <= 0 or size >= len(self._instances) or not self._tokens:
+                return self
+            chosen: list[str] = []
+            k = 0
+            while len(chosen) < size and k < size * 8:
+                h = hashlib.sha256(f"{tenant}/{k}".encode()).digest()
+                token = int.from_bytes(h[:4], "big")
+                start = bisect.bisect_left(self._tokens, (token, ""))
+                n = len(self._tokens)
+                for j in range(n):
+                    _, iid = self._tokens[(start + j) % n]
+                    if iid not in chosen:
+                        chosen.append(iid)
+                        break
+                k += 1
+            for iid in chosen:
+                # shared instance objects: heartbeats flow through
+                sub._instances[iid] = self._instances[iid]
+            sub._rebuild()
+        return sub
+
     def healthy_count(self) -> int:
         now = time.monotonic()
         with self._lock:

@@ -1,0 +1,3 @@
+from .handler import SearchWorker, serve_worker
+
+__all__ = ["SearchWorker", "serve_worker"]

@@ -1,0 +1,57 @@
+"""Elastic search workers: one SearchBlockRequest in, one SearchResponse out.
+
+Role-equivalent to cmd/tempo-serverless (handler.go:50-112): a stateless
+process that executes exactly one frontend-sharded search job against
+object storage — the scale-out burst tier queriers proxy to (reference
+querier.searchExternalEndpoint with hedging + prefer-self). Here the
+worker owns a TPU-backed TempoDB reader over the shared backend; deploy N
+of them behind any HTTP balancer for elastic read capacity.
+
+Protocol: POST /search-block, body = serialized tempopb.SearchBlockRequest,
+response = serialized tempopb.SearchResponse (content-type
+application/protobuf).
+"""
+
+from __future__ import annotations
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tempo_tpu import tempopb
+from tempo_tpu.backend.raw import RawBackend
+from tempo_tpu.db import TempoDB, TempoDBConfig
+
+
+class SearchWorker:
+    def __init__(self, backend: RawBackend, cfg: TempoDBConfig | None = None,
+                 wal_dir: str = "/tmp/tempo-tpu-worker-wal"):
+        self.db = TempoDB(backend, wal_dir, cfg)
+
+    def handle(self, req: tempopb.SearchBlockRequest) -> tempopb.SearchResponse:
+        return self.db.search_block(req).response()
+
+
+def serve_worker(worker: SearchWorker, host: str = "0.0.0.0", port: int = 0):
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802 — stdlib API
+            if self.path != "/search-block":
+                self.send_error(404)
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            req = tempopb.SearchBlockRequest()
+            try:
+                req.ParseFromString(self.rfile.read(length))
+                resp = worker.handle(req)
+            except Exception as e:  # noqa: BLE001 — one job, one error
+                self.send_error(500, str(e))
+                return
+            body = resp.SerializeToString()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/protobuf")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    return ThreadingHTTPServer((host, port), Handler)

@@ -206,3 +206,160 @@ def test_vulture_consistency_cycle(tmp_path):
     app.poll_tick()
     v.read_pass()
     assert v.stats.missing == 0
+
+
+# ---- shuffle shard / quorum / hedging / serverless / receivers ----
+
+def test_shuffle_shard_deterministic_and_isolated():
+    from tempo_tpu.modules import Ring
+
+    ring = Ring(replication_factor=2)
+    for i in range(10):
+        ring.register(f"i{i}")
+    a1 = ring.shuffle_shard("tenant-a", 3)
+    a2 = ring.shuffle_shard("tenant-a", 3)
+    b = ring.shuffle_shard("tenant-b", 3)
+    assert a1.instance_ids() == a2.instance_ids()
+    assert len(a1.instance_ids()) == 3
+    assert a1.instance_ids() != b.instance_ids()  # overwhelmingly likely
+    # placement inside the sub-ring only uses its instances
+    got = a1.get(12345)
+    assert set(got) <= set(a1.instance_ids())
+
+
+def test_write_quorum_one_mode(tmp_path):
+    """RF=2 eventual-consistency: one replica down, quorum 'one' accepts
+    the write while 'majority' (2 of 2) rejects it."""
+    from tempo_tpu.modules import App, AppConfig
+    from tempo_tpu.modules.distributor import Distributor, IngestError
+
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal"), n_ingesters=2,
+                        replication_factor=2))
+
+    class Broken:
+        def push_bytes(self, *a):
+            raise OSError("down")
+
+    pushers = dict(app.ingesters)
+    pushers[next(iter(pushers))] = Broken()
+
+    tid = random_trace_id()
+    tr = make_trace(tid, seed=1)
+    strict = Distributor(app.ring, pushers, app.overrides)
+    with pytest.raises(IngestError):
+        strict.push_batches("t1", list(tr.batches))
+    eventual = Distributor(app.ring, pushers, app.overrides,
+                           write_quorum="one")
+    eventual.push_batches("t1", list(tr.batches))  # succeeds
+
+
+def test_hedged_call_returns_fast_result():
+    from tempo_tpu.db.hedge import hedged_call
+
+    calls = []
+
+    def slow_then_fast():
+        calls.append(1)
+        if len(calls) == 1:
+            time.sleep(2.0)
+            return "slow"
+        return "fast"
+
+    out = hedged_call(slow_then_fast, hedge_after_s=0.05, max_hedges=2)
+    assert out == "fast"
+
+
+def test_hedged_backend_passthrough():
+    from tempo_tpu.db.hedge import HedgedBackend
+
+    inner = MockBackend()
+    hb = HedgedBackend(inner, hedge_after_s=5)
+    hb.write("t", "b", "data", b"abc")  # __getattr__ passthrough
+    assert hb.read("t", "b", "data") == b"abc"
+    assert hb.read_range("t", "b", "data", 1, 1) == b"b"
+
+
+def test_serverless_worker_and_external_querier(tmp_path):
+    import threading
+
+    from tempo_tpu.modules import App, AppConfig
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.serverless import SearchWorker, serve_worker
+
+    app = App(AppConfig(
+        backend={"backend": "local", "local": {"path": str(tmp_path / "be")}},
+        wal_dir=str(tmp_path / "wal"),
+    ))
+    traces = {}
+    for i in range(10):
+        tid = random_trace_id()
+        app.push("t1", list(make_trace(tid, seed=i).batches))
+        traces[tid] = 1
+    app.flush_tick(force=True)
+    app.poll_tick()
+    meta = app.reader_db.blocklist.metas("t1")[0]
+
+    worker = SearchWorker(app.backend, wal_dir=str(tmp_path / "worker-wal"))
+    server = serve_worker(worker, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        req = tempopb.SearchBlockRequest()
+        req.tenant_id = "t1"
+        req.block_id = meta.block_id
+        req.search_req.limit = 100
+
+        # querier with prefer_self=0 → every job goes external
+        q = Querier(app.reader_db, app.ring, app.ingesters,
+                    external_endpoints=[f"http://127.0.0.1:{port}"],
+                    prefer_self=0, external_hedge_after_s=5.0)
+        resp = q.search_block(req)
+        assert len(resp.traces) == 10
+    finally:
+        server.shutdown()
+
+
+def test_zipkin_receiver(tmp_path):
+    from tempo_tpu.api import HTTPApi
+    from tempo_tpu.modules import App, AppConfig
+
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal")))
+    api = HTTPApi(app)
+    tid = "0102030405060708090a0b0c0d0e0f10"
+    spans = [
+        {"traceId": tid, "id": "1112131415161718", "name": "get /",
+         "kind": "SERVER", "timestamp": 1_600_000_000_000_000,
+         "duration": 250_000,
+         "localEndpoint": {"serviceName": "shop"},
+         "tags": {"http.method": "GET"}},
+        {"traceId": tid, "id": "2122232425262728",
+         "parentId": "1112131415161718", "name": "q",
+         "kind": "CLIENT", "timestamp": 1_600_000_000_050_000,
+         "duration": 100_000,
+         "localEndpoint": {"serviceName": "db"}},
+    ]
+    code, body = api.handle("POST", "/api/v2/spans", {},
+                            {"X-Scope-OrgID": "t1"},
+                            json.dumps(spans).encode())
+    assert code == 200 and body["accepted_batches"] == 2
+
+    resp = app.find_trace("t1", bytes.fromhex(tid))
+    assert len(resp.trace.batches) == 2
+    names = {s.name for b in resp.trace.batches
+             for ss in b.scope_spans for s in ss.spans}
+    assert names == {"get /", "q"}
+
+
+def test_otlp_http_receiver(tmp_path):
+    from tempo_tpu.api import HTTPApi
+    from tempo_tpu.modules import App, AppConfig
+
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal")))
+    api = HTTPApi(app)
+    tid = random_trace_id()
+    tr = make_trace(tid, seed=3)
+    code, body = api.handle("POST", "/v1/traces", {},
+                            {"X-Scope-OrgID": "t1"}, tr.SerializeToString())
+    assert code == 200
+    resp = app.find_trace("t1", tid)
+    assert len(resp.trace.batches) == len(tr.batches)

@@ -1,0 +1,87 @@
+"""Load/e2e smoke: the k6 smoke_test analog (reference integration/bench).
+
+Sustained concurrent write + read + search against the single binary over
+real HTTP, asserting error-free operation and result consistency — the
+write-path/read-path/health scenario matrix of smoke_test.js, sized to
+stay fast in CI.
+"""
+
+import json
+import threading
+import urllib.request
+
+from tempo_tpu.modules import App, AppConfig
+from tempo_tpu.api import HTTPApi, serve_http
+from tempo_tpu.utils.ids import random_trace_id, trace_id_to_hex
+from tempo_tpu.utils.test_data import make_trace
+
+
+def test_concurrent_write_read_smoke(tmp_path):
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal"), n_ingesters=2,
+                        replication_factor=2))
+    server = serve_http(HTTPApi(app), host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    errors = []
+    written = {}
+    lock = threading.Lock()
+
+    def writer(wid):
+        try:
+            for i in range(15):
+                tid = random_trace_id()
+                tr = make_trace(tid, seed=wid * 100 + i)
+                app.push("smoke", list(tr.batches))
+                with lock:
+                    written[tid] = tr
+        except Exception as e:  # noqa: BLE001
+            errors.append(("write", e))
+
+    def reader():
+        try:
+            for _ in range(20):
+                with lock:
+                    if not written:
+                        continue
+                    tid = next(iter(written))
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api/traces/{trace_id_to_hex(tid)}",
+                    headers={"X-Scope-OrgID": "smoke"},
+                )
+                with urllib.request.urlopen(req) as r:
+                    assert r.status in (200, 404)
+        except Exception as e:  # noqa: BLE001
+            errors.append(("read", e))
+
+    def health():
+        try:
+            for _ in range(10):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ready"
+                ) as r:
+                    assert r.status == 200
+        except Exception as e:  # noqa: BLE001
+            errors.append(("health", e))
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    threads.append(threading.Thread(target=health))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.shutdown()
+
+    assert not errors, errors[:3]
+    assert len(written) == 60
+
+    # everything written under concurrency is findable
+    missing = [t for t in written if not app.find_trace("smoke", t).trace.batches]
+    assert not missing
+
+    # ...and still findable after flush + poll through the block path
+    app.flush_tick(force=True)
+    app.poll_tick()
+    missing = [t for t in written if not app.find_trace("smoke", t).trace.batches]
+    assert not missing
