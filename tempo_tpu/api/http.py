@@ -70,7 +70,8 @@ class HTTPApi:
                 batches = otlp_http_to_batches(body)
             else:
                 batches = zipkin_json_to_batches(body)
-        except (DecodeError, KeyError, TypeError, _json.JSONDecodeError) as e:
+        except (DecodeError, KeyError, TypeError, AttributeError,
+                _json.JSONDecodeError) as e:
             return 400, {"error": f"malformed payload: {type(e).__name__}: {e}"}
         if batches:
             self.app.push(tenant, batches)
@@ -149,8 +150,20 @@ def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
         def do_POST(self):  # noqa: N802
             u = urlparse(self.path)
             query = {k: v[0] for k, v in parse_qs(u.query).items()}
-            length = int(self.headers.get("Content-Length", 0))
-            body = self.rfile.read(length) if length else b""
+            if self.headers.get("Transfer-Encoding", "").lower() == "chunked":
+                chunks = []
+                while True:
+                    size_line = self.rfile.readline().split(b";")[0].strip()
+                    size = int(size_line, 16)
+                    if size == 0:
+                        self.rfile.readline()  # trailing CRLF
+                        break
+                    chunks.append(self.rfile.read(size))
+                    self.rfile.readline()  # chunk CRLF
+                body = b"".join(chunks)
+            else:
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
             code, out = api.handle("POST", u.path, query, self.headers, body)
             self._reply(code, out)
 

@@ -1,0 +1,138 @@
+"""Instrumented HTTP transport shared by the cloud object-store backends.
+
+Role-equivalent to the reference's instrumented backend transports
+(tempodb/backend/instrumentation/backend_transports.go:13-50): every
+request is timed and counted per (operation, status); retries with
+exponential backoff cover transient 5xx and connection resets. Hedging
+stays one layer up (db/hedge.HedgedBackend) exactly as the reference
+composes hedgedhttp around the instrumented transport.
+
+Pure stdlib (http.client): no egress-dependent SDKs in this image, and an
+object-store client needs nothing an HTTP/1.1 connection pool can't give.
+"""
+
+from __future__ import annotations
+
+import http.client
+import socket
+import ssl
+import threading
+import time
+import urllib.parse
+
+from tempo_tpu.observability import Counter, Histogram
+
+_request_duration = Histogram(
+    "tempodb_backend_request_duration_seconds",
+    "object-store request latency by operation/status",
+)
+_request_errors = Counter(
+    "tempodb_backend_request_errors_total",
+    "object-store transport errors (after retries)",
+)
+
+_RETRYABLE_STATUS = {429, 500, 502, 503, 504}
+
+
+class TransportError(Exception):
+    def __init__(self, msg: str, status: int = 0, body: bytes = b""):
+        super().__init__(msg)
+        self.status = status
+        self.body = body
+
+
+class HTTPTransport:
+    """Connection-pooled HTTP client for one endpoint.
+
+    One persistent connection per calling thread (the backends are driven
+    by worker pools, so this is a natural pool bounded by pool size).
+    """
+
+    def __init__(self, endpoint: str, timeout_s: float = 30.0,
+                 retries: int = 3, backoff_s: float = 0.25, name: str = ""):
+        u = urllib.parse.urlparse(endpoint)
+        if u.scheme not in ("http", "https"):
+            raise ValueError(f"endpoint must be http(s), got {endpoint!r}")
+        self.scheme = u.scheme
+        self.host = u.hostname or "localhost"
+        self.port = u.port or (443 if u.scheme == "https" else 80)
+        self.base_path = u.path.rstrip("/")
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.name = name or self.host
+        self._local = threading.local()
+
+    # host:port as a client would send it in Host: (omit default ports)
+    @property
+    def host_header(self) -> str:
+        default = 443 if self.scheme == "https" else 80
+        return self.host if self.port == default else f"{self.host}:{self.port}"
+
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            if self.scheme == "https":
+                conn = http.client.HTTPSConnection(
+                    self.host, self.port, timeout=self.timeout_s,
+                    context=ssl.create_default_context())
+            else:
+                conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout_s)
+            self._local.conn = conn
+        return conn
+
+    def _reset(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._local.conn = None
+
+    def request(self, method: str, path: str, *, query: dict | None = None,
+                headers: dict | None = None, body: bytes = b"",
+                operation: str = "", ok: tuple = (200, 201, 204, 206),
+                ) -> tuple[int, dict, bytes]:
+        """One logical request with retries. Returns (status, headers, body).
+
+        Raises TransportError when the final attempt is not in `ok` (the
+        status is preserved so callers can map 404 → DoesNotExist).
+        """
+        target = self.base_path + path
+        if query:
+            # quote (not quote_plus): matches SigV4/SharedKey canonical encoding
+            target += "?" + urllib.parse.urlencode(
+                sorted(query.items()), quote_via=urllib.parse.quote)
+        op = operation or method
+        last_exc: Exception | None = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                time.sleep(self.backoff_s * (2 ** (attempt - 1)))
+            t0 = time.monotonic()
+            try:
+                conn = self._conn()
+                conn.request(method, target, body=body or None,
+                             headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()
+                status = resp.status
+            except (OSError, http.client.HTTPException, socket.timeout) as e:
+                self._reset()
+                last_exc = e
+                _request_duration.observe(
+                    time.monotonic() - t0, operation=op, status="error")
+                continue
+            _request_duration.observe(
+                time.monotonic() - t0, operation=op, status=str(status))
+            if status in ok:
+                return status, dict(resp.getheaders()), data
+            if status in _RETRYABLE_STATUS and attempt < self.retries:
+                continue
+            _request_errors.inc(operation=op)
+            raise TransportError(
+                f"{self.name}: {method} {path} -> {status}",
+                status=status, body=data)
+        _request_errors.inc(operation=op)
+        raise TransportError(f"{self.name}: {method} {path} failed: {last_exc}")

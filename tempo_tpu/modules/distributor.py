@@ -59,6 +59,9 @@ class Distributor:
         # "majority" (default) or "one" — the reference's RF=2
         # EventuallyConsistentStrategy writes with quorum 1
         # (pkg/ring/ring.go:16-98)
+        if write_quorum not in ("majority", "one"):
+            raise ValueError(
+                f"write_quorum must be 'majority' or 'one', got {write_quorum!r}")
         self.write_quorum = write_quorum
         self.forwarder = forwarder
         self._forward_queue = None

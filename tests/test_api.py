@@ -195,3 +195,48 @@ def test_metrics_registry():
     out = reg.expose()
     assert 'test_total{tenant="a"} 3' in out
     assert "test_seconds_bucket" in out and "test_seconds_count 1" in out
+
+
+def test_http_ingest_edge_cases(app):
+    """Chunked-transfer ingest must not be silently dropped; malformed
+    Zipkin arrays must map to 400 (client error), not 500."""
+    import http.client
+
+    api = HTTPApi(app)
+    server = serve_http(api, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        # chunked OTLP/HTTP push
+        tid = random_trace_id()
+        payload = make_trace(tid, seed=9).SerializeToString()
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        conn.putrequest("POST", "/v1/traces")
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.putheader("X-Scope-OrgID", "t1")
+        conn.endheaders()
+        for i in range(0, len(payload), 100):
+            chunk = payload[i:i + 100]
+            conn.send(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+        conn.send(b"0\r\n\r\n")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200 and body["accepted_batches"] > 0
+
+        # zipkin array of non-objects → 400, not 500
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v2/spans", data=b'["oops", 1]',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+    finally:
+        server.shutdown()
+
+
+def test_distributor_rejects_bad_quorum_mode():
+    from tempo_tpu.modules.distributor import Distributor
+    from tempo_tpu.modules.ring import Ring
+
+    with pytest.raises(ValueError):
+        Distributor(Ring(["i0"]), {}, write_quorum="One")

@@ -1,0 +1,149 @@
+"""Cloud backend conformance: S3 / GCS / Azure clients against in-process
+mock object stores that verify auth on every request (the reference tests
+the same surface against minio / fake-gcs-server / azurite —
+integration/e2e/backend/)."""
+
+import pytest
+
+from tempo_tpu.backend import BlockMeta, BackendError, DoesNotExist
+from tempo_tpu.backend.s3 import S3Backend
+from tempo_tpu.backend.gcs import GCSBackend
+from tempo_tpu.backend.azure import AzureBackend
+from tempo_tpu.db import TempoDB, TempoDBConfig
+
+from tests.mock_object_stores import (
+    start, MockS3Handler, MockGCSHandler, MockAzureHandler,
+)
+from tests.test_db import _ingest
+
+AZ_KEY = "c2VjcmV0LWtleS1mb3ItdGVzdHM="  # base64("secret-key-for-tests")
+
+
+@pytest.fixture(scope="module")
+def s3_server():
+    srv, ep = start(MockS3Handler, access_key="AKIATEST", secret_key="s3cr3t")
+    yield srv, ep
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def gcs_server():
+    srv, ep = start(MockGCSHandler, token="tok-123")
+    yield srv, ep
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def azure_server():
+    srv, ep = start(MockAzureHandler, account="testacct", key=AZ_KEY)
+    yield srv, ep
+    srv.shutdown()
+
+
+@pytest.fixture(params=["s3", "gcs", "azure"])
+def cloud_backend(request, s3_server, gcs_server, azure_server):
+    if request.param == "s3":
+        srv, ep = s3_server
+        be = S3Backend(bucket="tempo", endpoint=ep, access_key="AKIATEST",
+                       secret_key="s3cr3t", prefix="traces", retries=1)
+    elif request.param == "gcs":
+        srv, ep = gcs_server
+        be = GCSBackend(bucket="tempo", endpoint=ep, token="tok-123",
+                        prefix="traces", retries=1)
+    else:
+        srv, ep = azure_server
+        be = AzureBackend(container="tempo", account="testacct", key=AZ_KEY,
+                          endpoint=ep, prefix="traces", retries=1)
+    srv.store.clear()
+    return be
+
+
+def test_roundtrip(cloud_backend):
+    be = cloud_backend
+    be.write("t1", "blk1", "data", b"hello world")
+    assert be.read("t1", "blk1", "data") == b"hello world"
+    assert be.read_range("t1", "blk1", "data", 6, 5) == b"world"
+
+
+def test_missing_raises(cloud_backend):
+    with pytest.raises(DoesNotExist):
+        cloud_backend.read("t1", "blk1", "nope")
+    with pytest.raises(DoesNotExist):
+        cloud_backend.read_range("t1", "blk1", "nope", 0, 1)
+
+
+def test_delete(cloud_backend):
+    be = cloud_backend
+    be.write("t1", "blk1", "data", b"x")
+    be.delete("t1", "blk1", "data")
+    with pytest.raises(DoesNotExist):
+        be.read("t1", "blk1", "data")
+
+
+def test_listing(cloud_backend):
+    be = cloud_backend
+    be.write("t1", "blk1", "data", b"a")
+    be.write("t1", "blk1", "index", b"b")
+    be.write("t1", "blk2", "data", b"c")
+    be.write("t2", "blk3", "data", b"d")
+    be.write("t1", None, "index.json.gz", b"idx")  # tenant-level object
+    assert be.list_tenants() == ["t1", "t2"]
+    assert be.list_blocks("t1") == ["blk1", "blk2"]
+    assert set(be._block_objects("t1", "blk1")) == {"data", "index"}
+
+
+def test_meta_and_compaction_cycle(cloud_backend):
+    be = cloud_backend
+    m = BlockMeta(tenant_id="t1", total_objects=7)
+    be.write_block_meta(m)
+    got = be.read_block_meta("t1", m.block_id)
+    assert got.total_objects == 7
+    be.write("t1", m.block_id, "data", b"payload")
+    be.mark_compacted(m)
+    with pytest.raises(DoesNotExist):
+        be.read_block_meta("t1", m.block_id)
+    assert be.read_compacted_meta("t1", m.block_id).meta.block_id == m.block_id
+    be.clear_block("t1", m.block_id)
+    with pytest.raises(DoesNotExist):
+        be.read("t1", m.block_id, "data")
+
+
+def test_s3_bad_credentials_rejected(s3_server):
+    _, ep = s3_server
+    be = S3Backend(bucket="tempo", endpoint=ep, access_key="AKIATEST",
+                   secret_key="WRONG", retries=0)
+    with pytest.raises(BackendError):
+        be.write("t1", "b", "data", b"x")
+
+
+def test_azure_bad_key_rejected(azure_server):
+    _, ep = azure_server
+    be = AzureBackend(container="tempo", account="testacct",
+                      key="d3Jvbmcta2V5", endpoint=ep, retries=0)
+    with pytest.raises(BackendError):
+        be.write("t1", "b", "data", b"x")
+
+
+def test_gcs_bad_token_rejected(gcs_server):
+    _, ep = gcs_server
+    be = GCSBackend(bucket="tempo", endpoint=ep, token="nope", retries=0)
+    with pytest.raises(BackendError):
+        be.write("t1", "b", "data", b"x")
+
+
+def test_tempodb_end_to_end_on_s3(tmp_path, s3_server):
+    """Full write→complete→find→search cycle with S3 as the only durable
+    store — the reference's integration/e2e backend matrix, in-process."""
+    srv, ep = s3_server
+    srv.store.clear()
+    be = S3Backend(bucket="tempo", endpoint=ep, access_key="AKIATEST",
+                   secret_key="s3cr3t", prefix="single-tenant")
+    db = TempoDB(be, str(tmp_path / "wal"), TempoDBConfig())
+    meta, traces = _ingest(db, "t1", 40)
+    db.poll()
+    tid = sorted(traces)[0]
+    obj, failed = db.find_trace_by_id("t1", tid)
+    assert obj is not None and failed == 0
+    # the mock store now holds the whole block: data+index+meta+blooms+search
+    assert any(k.endswith("meta.json") for k in srv.store)
+    assert any(k.endswith("/search") for k in srv.store)
