@@ -150,20 +150,27 @@ def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
         def do_POST(self):  # noqa: N802
             u = urlparse(self.path)
             query = {k: v[0] for k, v in parse_qs(u.query).items()}
+            MAX_BODY = 64 << 20  # cap hostile/streaming bodies
             if self.headers.get("Transfer-Encoding", "").lower() == "chunked":
-                chunks = []
-                while True:
-                    size_line = self.rfile.readline().split(b";")[0].strip()
-                    size = int(size_line, 16)
-                    if size == 0:
-                        self.rfile.readline()  # trailing CRLF
-                        break
-                    chunks.append(self.rfile.read(size))
-                    self.rfile.readline()  # chunk CRLF
+                chunks, total = [], 0
+                try:
+                    while True:
+                        size_line = self.rfile.readline().split(b";")[0].strip()
+                        size = int(size_line, 16)
+                        if size == 0:
+                            self.rfile.readline()  # trailing CRLF
+                            break
+                        total += size
+                        if total > MAX_BODY:
+                            raise ValueError("body too large")
+                        chunks.append(self.rfile.read(size))
+                        self.rfile.readline()  # chunk CRLF
+                except ValueError as e:
+                    return self._reply(400, {"error": f"bad chunked body: {e}"})
                 body = b"".join(chunks)
             else:
                 length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length) if length else b""
+                body = self.rfile.read(min(length, MAX_BODY)) if length else b""
             code, out = api.handle("POST", u.path, query, self.headers, body)
             self._reply(code, out)
 

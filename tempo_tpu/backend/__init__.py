@@ -12,6 +12,8 @@ from .types import (
 from .raw import RawBackend, BackendError, DoesNotExist
 from .local import LocalBackend
 from .mock import MockBackend
+from .cache import CachedBackend, LRUCache
+from .netcache import MemcachedCache, RedisCache, BackgroundCache, open_cache
 
 __all__ = [
     "BlockMeta", "CompactedBlockMeta", "TenantIndex",

@@ -22,6 +22,9 @@ from .transport import HTTPTransport, TransportError
 
 
 class _TokenSource:
+    # refresh this long before expiry so in-flight requests never race it
+    _EXPIRY_SLACK_S = 120
+
     def __init__(self, cfg: dict):
         self.static = cfg.get("token", "")
         self.token_file = cfg.get("token_file", "")
@@ -29,6 +32,12 @@ class _TokenSource:
         self.metadata_endpoint = cfg.get(
             "metadata_endpoint", "http://169.254.169.254")
         self._cached = ""
+        self._expires_at = 0.0
+
+    def invalidate(self) -> None:
+        """Drop the cached token (called on 401 so the next request
+        refetches instead of failing until restart)."""
+        self._expires_at = 0.0
 
     def get(self) -> str:
         if self.static:
@@ -37,14 +46,19 @@ class _TokenSource:
             with open(self.token_file) as f:
                 return f.read().strip()
         if self.use_metadata:
-            if not self._cached:
+            import time
+            if time.monotonic() >= self._expires_at:
                 t = HTTPTransport(self.metadata_endpoint, timeout_s=5,
                                   retries=2, name="gce-metadata")
                 _, _, body = t.request(
                     "GET",
                     "/computeMetadata/v1/instance/service-accounts/default/token",
                     headers={"Metadata-Flavor": "Google"}, operation="TOKEN")
-                self._cached = json.loads(body)["access_token"]
+                doc = json.loads(body)
+                self._cached = doc["access_token"]
+                self._expires_at = (time.monotonic()
+                                    + float(doc.get("expires_in", 3600))
+                                    - self._EXPIRY_SLACK_S)
             return self._cached
         return ""
 
@@ -75,14 +89,19 @@ class GCSBackend(RawBackend):
 
     def _request(self, method: str, path: str, *, query=None, headers=None,
                  body=b"", operation="", ok=(200, 204, 206)):
-        try:
-            return self.t.request(method, path, query=query,
-                                  headers=self._headers(headers), body=body,
-                                  operation=operation, ok=ok)
-        except TransportError as e:
-            if e.status == 404:
-                raise DoesNotExist(path) from None
-            raise BackendError(str(e)) from e
+        for attempt in (0, 1):
+            try:
+                return self.t.request(method, path, query=query,
+                                      headers=self._headers(headers), body=body,
+                                      operation=operation, ok=ok)
+            except TransportError as e:
+                if e.status == 404:
+                    raise DoesNotExist(path) from None
+                if e.status == 401 and attempt == 0:
+                    # expired/revoked token: refetch once, then retry
+                    self.tokens.invalidate()
+                    continue
+                raise BackendError(str(e)) from e
 
     # ---- RawBackend ----
 

@@ -1,0 +1,298 @@
+"""Network cache clients: memcached and Redis, plus write-behind.
+
+Role-equivalent to the reference's pkg/cache (memcached*.go with the
+jump-hash server selector, redis*.go, background.go write-behind). Both
+clients implement the same Cache interface as backend.cache.LRUCache
+{store, fetch, stop} so they slot behind CachedBackend unchanged.
+
+Protocol clients are stdlib sockets speaking the wire protocols directly
+(memcached text protocol, RESP2) — no client library in this image, and
+the protocols are a few dozen lines each. Cache errors NEVER propagate:
+a down cache node degrades to a miss (store drops, fetch returns None),
+exactly the reference's failure stance.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+
+from tempo_tpu.observability import Counter
+from tempo_tpu.utils.hashing import fnv1a_64
+
+_cache_errors = Counter("tempo_cache_errors_total",
+                        "network cache operation failures (degraded to miss)")
+_cache_dropped = Counter("tempo_cache_background_dropped_total",
+                         "write-behind stores dropped on queue overflow")
+
+
+def jump_hash(key: int, num_buckets: int) -> int:
+    """Lamping-Veach jump consistent hash — the reference's memcached
+    client selector (pkg/cache jump-hash selector): minimal key movement
+    when the server list grows/shrinks."""
+    if num_buckets <= 1:
+        return 0
+    b, j = -1, 0
+    while j < num_buckets:
+        b = j
+        key = (key * 2862933555777941757 + 1) & 0xFFFFFFFFFFFFFFFF
+        j = int(float(b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
+    return b
+
+
+class _ConnPool:
+    """One persistent socket per (thread, server)."""
+
+    def __init__(self, servers: list[tuple[str, int]], timeout_s: float):
+        self.servers = servers
+        self.timeout_s = timeout_s
+        self._local = threading.local()
+
+    def sock(self, idx: int) -> socket.socket:
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        s = conns.get(idx)
+        if s is None:
+            s = socket.create_connection(self.servers[idx],
+                                         timeout=self.timeout_s)
+            conns[idx] = s
+        return s
+
+    def drop(self, idx: int) -> None:
+        conns = getattr(self._local, "conns", None)
+        if conns and idx in conns:
+            try:
+                conns[idx].close()
+            except OSError:
+                pass
+            del conns[idx]
+
+    def close_all(self) -> None:
+        conns = getattr(self._local, "conns", None) or {}
+        for s in conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        conns.clear()
+
+
+def _parse_servers(servers: str | list) -> list[tuple[str, int]]:
+    if isinstance(servers, str):
+        servers = [s.strip() for s in servers.split(",") if s.strip()]
+    out = []
+    for s in servers:
+        if isinstance(s, (tuple, list)):
+            out.append((s[0], int(s[1])))
+        else:
+            host, _, port = s.rpartition(":")
+            out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+class _NetCache:
+    """Shared skeleton: jump-hash selection + error-to-miss degradation."""
+
+    def __init__(self, servers, timeout_s: float = 0.5, ttl_s: int = 0):
+        self.pool = _ConnPool(_parse_servers(servers), timeout_s)
+        self.ttl_s = ttl_s
+
+    def _select(self, key: str) -> int:
+        return jump_hash(fnv1a_64(key.encode()), len(self.pool.servers))
+
+    # any wire trouble — IO errors AND malformed replies (ValueError/
+    # IndexError from parsing) — degrades to a miss; the socket is dropped
+    # because a desynced connection would corrupt every later op on it
+    _WIRE_ERRORS = (OSError, ValueError, IndexError)
+
+    def store(self, key: str, val: bytes) -> None:
+        idx = self._select(key)
+        try:
+            self._store(self.pool.sock(idx), key, val)
+        except self._WIRE_ERRORS:
+            _cache_errors.inc(op="store")
+            self.pool.drop(idx)
+
+    def fetch(self, key: str) -> bytes | None:
+        idx = self._select(key)
+        try:
+            return self._fetch(self.pool.sock(idx), key)
+        except self._WIRE_ERRORS:
+            _cache_errors.inc(op="fetch")
+            self.pool.drop(idx)
+            return None
+
+    def stop(self) -> None:
+        self.pool.close_all()
+
+    # subclass protocol ops raise OSError on any wire trouble
+    def _store(self, s: socket.socket, key: str, val: bytes) -> None:
+        raise NotImplementedError
+
+    def _fetch(self, s: socket.socket, key: str) -> bytes | None:
+        raise NotImplementedError
+
+
+def _read_line(s: socket.socket, buf: bytearray) -> bytes:
+    while b"\r\n" not in buf:
+        chunk = s.recv(65536)
+        if not chunk:
+            raise OSError("connection closed")
+        buf += chunk
+    line, _, rest = bytes(buf).partition(b"\r\n")
+    buf[:] = rest
+    return line
+
+
+def _read_n(s: socket.socket, buf: bytearray, n: int) -> bytes:
+    while len(buf) < n:
+        chunk = s.recv(65536)
+        if not chunk:
+            raise OSError("connection closed")
+        buf += chunk
+    out = bytes(buf[:n])
+    buf[:] = buf[n:]
+    return out
+
+
+class MemcachedCache(_NetCache):
+    """Memcached text protocol over a jump-hash-selected server list."""
+
+    def _store(self, s, key, val):
+        s.sendall(f"set {key} 0 {self.ttl_s} {len(val)}\r\n".encode()
+                  + val + b"\r\n")
+        buf = bytearray()
+        resp = _read_line(s, buf)
+        if resp not in (b"STORED", b"NOT_STORED"):
+            raise OSError(f"memcached: unexpected {resp[:40]!r}")
+
+    def _fetch(self, s, key):
+        s.sendall(f"get {key}\r\n".encode())
+        buf = bytearray()
+        line = _read_line(s, buf)
+        if line == b"END":
+            return None
+        if not line.startswith(b"VALUE "):
+            raise OSError(f"memcached: unexpected {line[:40]!r}")
+        nbytes = int(line.split()[3])
+        val = _read_n(s, buf, nbytes)
+        _read_n(s, buf, 2)          # \r\n after data
+        end = _read_line(s, buf)
+        if end != b"END":
+            raise OSError(f"memcached: missing END, got {end[:40]!r}")
+        return val
+
+
+class RedisCache(_NetCache):
+    """RESP2 client (SET [EX ttl] / GET), single server or jump-hash list."""
+
+    @staticmethod
+    def _cmd(*args: bytes) -> bytes:
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+        return b"".join(out)
+
+    def _store(self, s, key, val):
+        args = [b"SET", key.encode(), val]
+        if self.ttl_s:
+            args += [b"EX", str(self.ttl_s).encode()]
+        s.sendall(self._cmd(*args))
+        buf = bytearray()
+        resp = _read_line(s, buf)
+        if not resp.startswith(b"+OK"):
+            raise OSError(f"redis: unexpected {resp[:40]!r}")
+
+    def _fetch(self, s, key):
+        s.sendall(self._cmd(b"GET", key.encode()))
+        buf = bytearray()
+        line = _read_line(s, buf)
+        if not line.startswith(b"$"):
+            raise OSError(f"redis: unexpected {line[:40]!r}")
+        n = int(line[1:])
+        if n == -1:
+            return None
+        val = _read_n(s, buf, n)
+        _read_n(s, buf, 2)
+        return val
+
+
+class BackgroundCache:
+    """Write-behind wrapper (reference pkg/cache/background.go): stores are
+    queued and written by worker threads so the read path never blocks on
+    cache writes; overflow drops the store (it's a cache)."""
+
+    def __init__(self, inner, workers: int = 2, queue_size: int = 1024):
+        self.inner = inner
+        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True)
+            for _ in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                key, val = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self.inner.store(key, val)
+            self._q.task_done()
+
+    def store(self, key: str, val: bytes) -> None:
+        try:
+            self._q.put_nowait((key, val))
+        except queue.Full:
+            _cache_dropped.inc()
+
+    def fetch(self, key: str) -> bytes | None:
+        return self.inner.fetch(key)
+
+    def flush(self, timeout_s: float = 5.0) -> None:
+        """Drain pending stores (tests / shutdown). unfinished_tasks (not
+        empty()) is the drain condition: a dequeued item still mid-store
+        counts until its task_done."""
+        import time
+        deadline = time.monotonic() + timeout_s
+        while self._q.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=1)
+        self.inner.stop()
+
+
+def open_cache(cfg: dict):
+    """Cache factory from config (reference tempodb cache config):
+    {"cache": "memcached"|"redis"|"lru"|"none", ...}."""
+    from .cache import LRUCache
+
+    kind = cfg.get("cache", "lru")
+    if kind in ("none", ""):
+        return None
+    if kind == "lru":
+        return LRUCache(cfg.get("lru", {}).get("max_bytes", 256 << 20))
+    if kind == "memcached":
+        c = cfg.get("memcached", {})
+        inner = MemcachedCache(c.get("servers", "127.0.0.1:11211"),
+                               timeout_s=c.get("timeout_s", 0.5),
+                               ttl_s=c.get("ttl_s", 0))
+    elif kind == "redis":
+        c = cfg.get("redis", {})
+        inner = RedisCache(c.get("servers", "127.0.0.1:6379"),
+                           timeout_s=c.get("timeout_s", 0.5),
+                           ttl_s=c.get("ttl_s", 0))
+    else:
+        raise ValueError(f"unknown cache {kind!r}")
+    bg = c.get("background", {})
+    if bg.get("enabled", True):
+        return BackgroundCache(inner, workers=bg.get("workers", 2),
+                               queue_size=bg.get("queue_size", 1024))
+    return inner

@@ -112,8 +112,15 @@ class S3Backend(RawBackend):
         parts = [p for p in (self.prefix, tenant, block_id, name) if p]
         return "/".join(parts)
 
-    def _path(self, key: str) -> str:
+    def _sign_path(self, key: str) -> str:
+        """Unencoded absolute path; sign_v4 URI-encodes it once, per spec."""
         return f"/{self.bucket}/{key}" if key else f"/{self.bucket}"
+
+    def _wire_path(self, key: str) -> str:
+        """Request-line path: the same single URI encoding the signer uses
+        (segments encoded, slashes kept) so signature and wire agree for
+        keys with spaces/%/# — tenant IDs are arbitrary header strings."""
+        return _uri_encode(self._sign_path(key), encode_slash=False)
 
     # ---- signed request ----
 
@@ -122,17 +129,18 @@ class S3Backend(RawBackend):
                  operation: str = "", ok=(200, 204, 206)):
         query = query or {}
         headers = dict(headers or {})
-        path = self._path(key)
         payload_hash = hashlib.sha256(body).hexdigest() if body else _EMPTY_SHA256
         headers.update(sign_v4(
-            method=method, host=self.t.host_header, path=path, query=query,
-            headers=headers, payload_sha256=payload_hash, region=self.region,
-            access_key=self.access_key, secret_key=self.secret_key))
+            method=method, host=self.t.host_header, path=self._sign_path(key),
+            query=query, headers=headers, payload_sha256=payload_hash,
+            region=self.region, access_key=self.access_key,
+            secret_key=self.secret_key))
         if body:
             headers["Content-Length"] = str(len(body))
         try:
-            return self.t.request(method, path, query=query, headers=headers,
-                                  body=body, operation=operation, ok=ok)
+            return self.t.request(method, self._wire_path(key), query=query,
+                                  headers=headers, body=body,
+                                  operation=operation, ok=ok)
         except TransportError as e:
             if e.status == 404:
                 raise DoesNotExist(key) from None
@@ -163,49 +171,44 @@ class S3Backend(RawBackend):
         self._request("DELETE", self._key(tenant, block_id, name),
                       operation="DELETE", ok=(200, 204))
 
-    def _list_prefixes(self, prefix: str) -> list[str]:
-        """ListObjectsV2 with delimiter=/ → immediate child 'directories'."""
-        out, token = [], None
-        while True:
-            q = {"list-type": "2", "prefix": prefix, "delimiter": "/"}
-            if token:
-                q["continuation-token"] = token
-            _, _, body = self._request("GET", "", query=q, operation="LIST")
-            ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
-            root = ET.fromstring(body)
-            # tolerate both namespaced and bare responses (minio vs mock)
-            for cp in root.findall("s3:CommonPrefixes/s3:Prefix", ns) or \
-                    root.findall("CommonPrefixes/Prefix"):
-                out.append(cp.text[len(prefix):].rstrip("/"))
-            token_el = (root.find("s3:NextContinuationToken", ns)
-                        if root.find("s3:NextContinuationToken", ns) is not None
-                        else root.find("NextContinuationToken"))
-            trunc = (root.findtext("s3:IsTruncated", default="false", namespaces=ns)
-                     or root.findtext("IsTruncated", default="false"))
-            if trunc != "true" or token_el is None or not token_el.text:
-                return sorted(set(out))
-            token = token_el.text
+    @staticmethod
+    def _xml_texts(root: ET.Element, path: str) -> list[str]:
+        """findall tolerating namespaced and bare tags (minio vs AWS vs mock):
+        matches on local tag names."""
+        parts = path.split("/")
+        nodes = [root]
+        for part in parts:
+            nodes = [c for n in nodes for c in n
+                     if c.tag.rpartition("}")[2] == part]
+        return [n.text or "" for n in nodes]
 
-    def _list_keys(self, prefix: str) -> list[str]:
-        out, token = [], None
+    def _list(self, prefix: str, delimiter: str | None):
+        """ListObjectsV2 pagination → (keys, common-prefixes), both relative
+        to `prefix`."""
+        keys, prefixes, token = [], [], None
         while True:
             q = {"list-type": "2", "prefix": prefix}
+            if delimiter:
+                q["delimiter"] = delimiter
             if token:
                 q["continuation-token"] = token
             _, _, body = self._request("GET", "", query=q, operation="LIST")
-            ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
             root = ET.fromstring(body)
-            for c in root.findall("s3:Contents/s3:Key", ns) or \
-                    root.findall("Contents/Key"):
-                out.append(c.text[len(prefix):])
-            token_el = (root.find("s3:NextContinuationToken", ns)
-                        if root.find("s3:NextContinuationToken", ns) is not None
-                        else root.find("NextContinuationToken"))
-            trunc = (root.findtext("s3:IsTruncated", default="false", namespaces=ns)
-                     or root.findtext("IsTruncated", default="false"))
-            if trunc != "true" or token_el is None or not token_el.text:
-                return sorted(out)
-            token = token_el.text
+            keys += [k[len(prefix):]
+                     for k in self._xml_texts(root, "Contents/Key")]
+            prefixes += [p[len(prefix):].rstrip("/")
+                         for p in self._xml_texts(root, "CommonPrefixes/Prefix")]
+            trunc = next(iter(self._xml_texts(root, "IsTruncated")), "false")
+            tokens = self._xml_texts(root, "NextContinuationToken")
+            token = tokens[0] if tokens else None
+            if trunc != "true" or not token:
+                return sorted(set(keys)), sorted(set(prefixes))
+
+    def _list_prefixes(self, prefix: str) -> list[str]:
+        return self._list(prefix, "/")[1]
+
+    def _list_keys(self, prefix: str) -> list[str]:
+        return self._list(prefix, None)[0]
 
     def list_tenants(self) -> list[str]:
         base = f"{self.prefix}/" if self.prefix else ""

@@ -71,7 +71,11 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         backend={
             "backend": storage.get("backend", "local"),
             "local": storage.get("local", {"path": "./tempo-blocks"}),
+            "s3": storage.get("s3", {}),
+            "gcs": storage.get("gcs", {}),
+            "azure": storage.get("azure", {}),
         },
+        cache=storage.get("cache", {}),
         wal_dir=storage.get("wal_dir", "./tempo-wal"),
         n_ingesters=ingester.get("n_ingesters", 1),
         replication_factor=ingester.get("replication_factor", 1),

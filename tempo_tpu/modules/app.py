@@ -29,6 +29,7 @@ from .ring import Ring
 @dataclass
 class AppConfig:
     backend: dict = field(default_factory=lambda: {"backend": "memory"})
+    cache: dict = field(default_factory=dict)  # {"cache": "lru|memcached|redis|none", ...}
     wal_dir: str = "./wal"
     n_ingesters: int = 1
     n_queriers: int = 1
@@ -48,6 +49,12 @@ class App:
     def __init__(self, cfg: AppConfig | None = None):
         self.cfg = cfg or AppConfig()
         self.backend = open_backend(self.cfg.backend)
+        if self.cfg.cache:
+            from tempo_tpu.backend.cache import CachedBackend
+            from tempo_tpu.backend.netcache import open_cache
+            cache = open_cache(self.cfg.cache)
+            if cache is not None:
+                self.backend = CachedBackend(self.backend, cache=cache)
         self.overrides = Overrides(self.cfg.limits,
                                    self.cfg.per_tenant_overrides)
         self.ring = Ring(replication_factor=self.cfg.replication_factor)

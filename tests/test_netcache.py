@@ -1,0 +1,187 @@
+"""Memcached/Redis cache clients against in-process fake servers speaking
+the real wire protocols (reference pkg/cache memcached/redis + background)."""
+
+import socketserver
+import threading
+
+import pytest
+
+from tempo_tpu.backend import MockBackend
+from tempo_tpu.backend.cache import CachedBackend
+from tempo_tpu.backend.netcache import (
+    BackgroundCache, MemcachedCache, RedisCache, jump_hash, open_cache,
+)
+
+
+class _FakeMemcached(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _MemcachedHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            parts = line.strip().split()
+            if not parts:
+                continue
+            if parts[0] == b"set":
+                n = int(parts[4])
+                data = self.rfile.read(n)
+                self.rfile.read(2)
+                self.server.data[parts[1].decode()] = data
+                self.wfile.write(b"STORED\r\n")
+            elif parts[0] == b"get":
+                key = parts[1].decode()
+                val = self.server.data.get(key)
+                if val is not None:
+                    self.wfile.write(
+                        b"VALUE %s 0 %d\r\n%s\r\n" % (key.encode(), len(val), val))
+                self.wfile.write(b"END\r\n")
+            else:
+                self.wfile.write(b"ERROR\r\n")
+
+
+class _RedisHandler(socketserver.StreamRequestHandler):
+    def _read_cmd(self):
+        line = self.rfile.readline()
+        if not line or not line.startswith(b"*"):
+            return None
+        n = int(line[1:].strip())
+        args = []
+        for _ in range(n):
+            ln = int(self.rfile.readline()[1:].strip())
+            args.append(self.rfile.read(ln))
+            self.rfile.read(2)
+        return args
+
+    def handle(self):
+        while True:
+            args = self._read_cmd()
+            if args is None:
+                return
+            cmd = args[0].upper()
+            if cmd == b"SET":
+                self.server.data[args[1].decode()] = args[2]
+                self.wfile.write(b"+OK\r\n")
+            elif cmd == b"GET":
+                val = self.server.data.get(args[1].decode())
+                if val is None:
+                    self.wfile.write(b"$-1\r\n")
+                else:
+                    self.wfile.write(b"$%d\r\n%s\r\n" % (len(val), val))
+            else:
+                self.wfile.write(b"-ERR unknown\r\n")
+
+
+def _start(handler):
+    srv = _FakeMemcached(("127.0.0.1", 0), handler)
+    srv.data = {}
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+@pytest.fixture
+def memcached():
+    srv, port = _start(_MemcachedHandler)
+    yield srv, port
+    srv.shutdown()
+
+
+@pytest.fixture
+def redis():
+    srv, port = _start(_RedisHandler)
+    yield srv, port
+    srv.shutdown()
+
+
+def test_memcached_roundtrip(memcached):
+    srv, port = memcached
+    c = MemcachedCache(f"127.0.0.1:{port}")
+    c.store("k1", b"v1")
+    assert c.fetch("k1") == b"v1"
+    assert c.fetch("missing") is None
+    c.store("k1", b"v2" * 1000)
+    assert c.fetch("k1") == b"v2" * 1000
+    c.stop()
+
+
+def test_redis_roundtrip(redis):
+    srv, port = redis
+    c = RedisCache(f"127.0.0.1:{port}", ttl_s=60)
+    c.store("k1", b"\x00binary\xff")
+    assert c.fetch("k1") == b"\x00binary\xff"
+    assert c.fetch("missing") is None
+    c.stop()
+
+
+def test_jump_hash_distribution_and_stability():
+    # keys spread over buckets, and adding a bucket moves only ~1/n of them
+    before = {k: jump_hash(k * 2654435761, 4) for k in range(2000)}
+    assert len(set(before.values())) == 4
+    after = {k: jump_hash(k * 2654435761, 5) for k in range(2000)}
+    moved = sum(1 for k in before if before[k] != after[k])
+    assert 0 < moved < 2000 * 0.35  # ≈1/5 expected
+    assert all(after[k] == 4 for k in before if before[k] != after[k])
+
+
+def test_sharding_across_two_servers(memcached):
+    srv1, port1 = memcached
+    srv2, port2 = _start(_MemcachedHandler)
+    try:
+        c = MemcachedCache([f"127.0.0.1:{port1}", f"127.0.0.1:{port2}"])
+        for i in range(50):
+            c.store(f"key-{i}", b"x")
+        assert srv1.data and srv2.data  # both shards took writes
+        assert len(srv1.data) + len(srv2.data) == 50
+        for i in range(50):
+            assert c.fetch(f"key-{i}") == b"x"
+        c.stop()
+    finally:
+        srv2.shutdown()
+
+
+def test_down_server_degrades_to_miss():
+    c = MemcachedCache("127.0.0.1:1")  # nothing listens
+    c.store("k", b"v")                 # no raise
+    assert c.fetch("k") is None
+    c.stop()
+
+
+def test_background_write_behind(memcached):
+    srv, port = memcached
+    bg = BackgroundCache(MemcachedCache(f"127.0.0.1:{port}"), workers=1)
+    for i in range(20):
+        bg.store(f"k{i}", b"v")
+    bg.flush()
+    assert bg.fetch("k0") == b"v"
+    assert len(srv.data) == 20
+    bg.stop()
+
+
+def test_cached_backend_over_memcached(memcached):
+    srv, port = memcached
+    be = MockBackend()
+    cached = CachedBackend(be, cache=MemcachedCache(f"127.0.0.1:{port}"))
+    cached.write("t1", "b1", "index", b"index-bytes")
+    assert srv.data  # write-through populated the network cache
+    # delete from the inner store: a cached read still serves
+    be.delete("t1", "b1", "index")
+    assert cached.read("t1", "b1", "index") == b"index-bytes"
+
+
+def test_open_cache_factory(memcached):
+    _, port = memcached
+    c = open_cache({"cache": "memcached",
+                    "memcached": {"servers": f"127.0.0.1:{port}",
+                                  "background": {"enabled": True}}})
+    c.store("k", b"v")
+    c.flush()
+    assert c.fetch("k") == b"v"
+    c.stop()
+    assert open_cache({"cache": "none"}) is None
+    lru = open_cache({"cache": "lru"})
+    lru.store("a", b"b")
+    assert lru.fetch("a") == b"b"
