@@ -21,6 +21,7 @@ from tempo_tpu import tempopb
 
 SERVICE_PUSHER = "tempopb.Pusher"
 SERVICE_QUERIER = "tempopb.Querier"
+SERVICE_INGESTER_QUERIER = "tempopb.IngesterQuerier"
 OTLP_SERVICE = "opentelemetry.proto.collector.trace.v1.TraceService"
 OTLP_EXPORT_METHOD = f"/{OTLP_SERVICE}/Export"
 
@@ -29,52 +30,96 @@ OTLP_EXPORT_METHOD = f"/{OTLP_SERVICE}/Export"
 # server
 
 
-def make_grpc_server(app, address: str = "0.0.0.0:9095",
-                     max_workers: int = 16) -> grpc.Server:
+def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
+                            querier=None, otlp_push=None,
+                            max_workers: int = 16) -> grpc.Server:
+    """gRPC server exposing only the services this process's modules back:
+
+      pusher    — Ingester (Pusher service: distributor → ingester)
+      ingester  — Ingester (IngesterQuerier service: querier replica reads)
+      querier   — Querier (Querier service: frontend job dispatch)
+      otlp_push — fn(tenant, batches) (OTLP receiver, distributor role)
+    """
     from concurrent import futures
 
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    handlers = []
 
-    def push_bytes(request: tempopb.PushBytesRequest, context) -> tempopb.PushResponse:
-        tenant = _tenant_from(context)
-        for ing in app.ingesters.values():
-            ing.push_bytes(tenant, request)
-            break  # addressed ingester: the server IS one ingester process
-        return tempopb.PushResponse()
+    if pusher is not None:
+        def push_bytes(request, context):
+            pusher.push_bytes(_tenant_from(context), request)
+            return tempopb.PushResponse()
 
-    def find_trace(request: tempopb.TraceByIDRequest, context) -> tempopb.TraceByIDResponse:
-        return app.queriers[0].find_trace_by_id(
-            _tenant_from(context), request.trace_id,
-            block_start=request.block_start, block_end=request.block_end,
-            mode=request.query_mode or "all",
-        )
-
-    def search_recent(request: tempopb.SearchRequest, context) -> tempopb.SearchResponse:
-        return app.queriers[0].search_recent(_tenant_from(context), request)
-
-    def search_block(request: tempopb.SearchBlockRequest, context) -> tempopb.SearchResponse:
-        return app.queriers[0].search_block(request)
-
-    def search_tags(request, context) -> tempopb.SearchTagsResponse:
-        return app.queriers[0].search_tags(_tenant_from(context))
-
-    def search_tag_values(request, context) -> tempopb.SearchTagValuesResponse:
-        return app.queriers[0].search_tag_values(
-            _tenant_from(context), request.tag_name
-        )
-
-    def otlp_export(request: tempopb.Trace, context) -> tempopb.Trace:
-        # request is wire-compatible ExportTraceServiceRequest; the empty
-        # response reuses Trace (wire-compatible: zero fields set)
-        app.push(_tenant_from(context), list(request.batches))
-        return tempopb.Trace()
-
-    server.add_generic_rpc_handlers((
-        grpc.method_handlers_generic_handler(SERVICE_PUSHER, {
+        handlers.append(grpc.method_handlers_generic_handler(SERVICE_PUSHER, {
             "PushBytes": _unary(push_bytes, tempopb.PushBytesRequest,
                                 tempopb.PushResponse),
-        }),
-        grpc.method_handlers_generic_handler(SERVICE_QUERIER, {
+        }))
+
+    if ingester is not None:
+        def find_partials(request, context):
+            resp = tempopb.PartialsResponse()
+            resp.objects.extend(
+                ingester.find_trace_by_id(_tenant_from(context),
+                                          request.trace_id))
+            return resp
+
+        def ing_search(request, context):
+            from tempo_tpu.search import SearchResults
+            results = SearchResults(limit=request.limit or 20)
+            ingester.search(_tenant_from(context), request, results)
+            return results.response()
+
+        def ing_tags(request, context):
+            resp = tempopb.SearchTagsResponse()
+            resp.tag_names.extend(sorted(
+                ingester.search_tags(_tenant_from(context))))
+            return resp
+
+        def ing_tag_values(request, context):
+            tenant = _tenant_from(context)
+            # per-tenant byte cap from this ingester's overrides (the
+            # client stub drops its max_bytes arg on purpose)
+            lim = ingester.overrides.limits(tenant).max_bytes_per_tag_values
+            resp = tempopb.SearchTagValuesResponse()
+            resp.tag_values.extend(sorted(
+                ingester.search_tag_values(tenant, request.tag_name, lim)))
+            return resp
+
+        handlers.append(grpc.method_handlers_generic_handler(
+            SERVICE_INGESTER_QUERIER, {
+                "FindPartials": _unary(find_partials, tempopb.TraceByIDRequest,
+                                       tempopb.PartialsResponse),
+                "Search": _unary(ing_search, tempopb.SearchRequest,
+                                 tempopb.SearchResponse),
+                "SearchTags": _unary(ing_tags, tempopb.SearchTagsRequest,
+                                     tempopb.SearchTagsResponse),
+                "SearchTagValues": _unary(ing_tag_values,
+                                          tempopb.SearchTagValuesRequest,
+                                          tempopb.SearchTagValuesResponse),
+            }))
+
+    if querier is not None:
+        def find_trace(request, context):
+            return querier.find_trace_by_id(
+                _tenant_from(context), request.trace_id,
+                block_start=request.block_start, block_end=request.block_end,
+                mode=request.query_mode or "all",
+            )
+
+        def search_recent(request, context):
+            return querier.search_recent(_tenant_from(context), request)
+
+        def search_block(request, context):
+            return querier.search_block(request)
+
+        def search_tags(request, context):
+            return querier.search_tags(_tenant_from(context))
+
+        def search_tag_values(request, context):
+            return querier.search_tag_values(_tenant_from(context),
+                                             request.tag_name)
+
+        handlers.append(grpc.method_handlers_generic_handler(SERVICE_QUERIER, {
             "FindTraceByID": _unary(find_trace, tempopb.TraceByIDRequest,
                                     tempopb.TraceByIDResponse),
             "SearchRecent": _unary(search_recent, tempopb.SearchRequest,
@@ -86,13 +131,36 @@ def make_grpc_server(app, address: str = "0.0.0.0:9095",
             "SearchTagValues": _unary(search_tag_values,
                                       tempopb.SearchTagValuesRequest,
                                       tempopb.SearchTagValuesResponse),
-        }),
-        grpc.method_handlers_generic_handler(OTLP_SERVICE, {
+        }))
+
+    if otlp_push is not None:
+        def otlp_export(request, context):
+            # request is wire-compatible ExportTraceServiceRequest; the empty
+            # response reuses Trace (wire-compatible: zero fields set)
+            otlp_push(_tenant_from(context), list(request.batches))
+            return tempopb.Trace()
+
+        handlers.append(grpc.method_handlers_generic_handler(OTLP_SERVICE, {
             "Export": _unary(otlp_export, tempopb.Trace, tempopb.Trace),
-        }),
-    ))
+        }))
+
+    server.add_generic_rpc_handlers(tuple(handlers))
     server.add_insecure_port(address)
     return server
+
+
+def make_grpc_server(app, address: str = "0.0.0.0:9095",
+                     max_workers: int = 16) -> grpc.Server:
+    """Single-binary server: all services, backed by the in-process App."""
+    first_ingester = next(iter(app.ingesters.values()))
+    return make_module_grpc_server(
+        address,
+        pusher=first_ingester,        # the server IS one ingester process
+        ingester=first_ingester,
+        querier=app.queriers[0],
+        otlp_push=app.push,
+        max_workers=max_workers,
+    )
 
 
 def _unary(fn, req_cls, resp_cls):
@@ -140,6 +208,44 @@ class PusherClient(_Base):
     def push_bytes(self, tenant: str, req: tempopb.PushBytesRequest) -> None:
         self._call(SERVICE_PUSHER, "PushBytes", req, tempopb.PushResponse,
                    tenant=tenant)
+
+
+class IngesterClient(_Base):
+    """Querier-side replica-read stub, duck-typed like modules.Ingester:
+    find returns raw partial objects, search merges into the caller's
+    SearchResults funnel — so Querier's combine/merge logic is identical
+    for in-process and remote replicas."""
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes) -> list[bytes]:
+        req = tempopb.TraceByIDRequest(trace_id=trace_id)
+        resp = self._call(SERVICE_INGESTER_QUERIER, "FindPartials", req,
+                          tempopb.PartialsResponse, tenant=tenant)
+        return list(resp.objects)
+
+    def search(self, tenant: str, req, results) -> None:
+        resp = self._call(SERVICE_INGESTER_QUERIER, "Search", req,
+                          tempopb.SearchResponse, tenant=tenant)
+        for t in resp.traces:
+            results.add(t)
+        m = results.metrics
+        m.inspected_traces += resp.metrics.inspected_traces
+        m.inspected_bytes += resp.metrics.inspected_bytes
+        m.inspected_blocks += resp.metrics.inspected_blocks
+        m.skipped_blocks += resp.metrics.skipped_blocks
+
+    def search_tags(self, tenant: str) -> set:
+        resp = self._call(SERVICE_INGESTER_QUERIER, "SearchTags",
+                          tempopb.SearchTagsRequest(),
+                          tempopb.SearchTagsResponse, tenant=tenant)
+        return set(resp.tag_names)
+
+    def search_tag_values(self, tenant: str, tag: str,
+                          max_bytes: int = 1 << 20) -> set:
+        # byte cap is enforced server-side from the ingester's overrides
+        resp = self._call(SERVICE_INGESTER_QUERIER, "SearchTagValues",
+                          tempopb.SearchTagValuesRequest(tag_name=tag),
+                          tempopb.SearchTagValuesResponse, tenant=tenant)
+        return set(resp.tag_values)
 
 
 class QuerierClient(_Base):

@@ -121,19 +121,20 @@ class HTTPApi:
 
     def _status(self, path) -> dict:
         app = self.app
-        return {
+        out = {
             "ready": app.ready(),
             "ring": {
                 "instances": app.ring.instance_ids(),
                 "healthy": app.ring.healthy_count(),
                 "replication_factor": app.ring.rf,
             },
-            "tenants": app.reader_db.blocklist.tenants(),
-            "blocks": {
-                t: len(app.reader_db.blocklist.metas(t))
-                for t in app.reader_db.blocklist.tenants()
-            },
         }
+        db = getattr(app, "reader_db", None)
+        if db is not None:  # targets without a storage reader (distributor)
+            out["tenants"] = db.blocklist.tenants()
+            out["blocks"] = {t: len(db.blocklist.metas(t))
+                             for t in db.blocklist.tenants()}
+        return out
 
 
 def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
@@ -157,6 +158,8 @@ def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
                     while True:
                         size_line = self.rfile.readline().split(b";")[0].strip()
                         size = int(size_line, 16)
+                        if size < 0:
+                            raise ValueError("negative chunk size")
                         if size == 0:
                             self.rfile.readline()  # trailing CRLF
                             break
@@ -170,7 +173,11 @@ def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
                 body = b"".join(chunks)
             else:
                 length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(min(length, MAX_BODY)) if length else b""
+                if length > MAX_BODY:
+                    # reject, never truncate: a parseable prefix would be
+                    # silently accepted while the tail spans are dropped
+                    return self._reply(413, {"error": "body too large"})
+                body = self.rfile.read(length) if length else b""
             code, out = api.handle("POST", u.path, query, self.headers, body)
             self._reply(code, out)
 

@@ -157,10 +157,26 @@ def _read_n(s: socket.socket, buf: bytearray, n: int) -> bytes:
     return out
 
 
+_KEY_UNSAFE = set(range(0x21)) | {0x7F}  # control chars + space
+
+
+def safe_cache_key(key: str, max_len: int = 250) -> str:
+    """Memcached-safe key. Keys embed tenant IDs taken verbatim from the
+    X-Scope-OrgID header; whitespace/CR-LF would desync the text protocol
+    (command injection → cross-tenant cache poisoning), and memcached caps
+    keys at 250 bytes — any such key is replaced by its hash."""
+    raw = key.encode()
+    if len(raw) <= max_len and not any(b in _KEY_UNSAFE for b in raw):
+        return key
+    import hashlib
+    return "h:" + hashlib.sha256(raw).hexdigest()
+
+
 class MemcachedCache(_NetCache):
     """Memcached text protocol over a jump-hash-selected server list."""
 
     def _store(self, s, key, val):
+        key = safe_cache_key(key)
         s.sendall(f"set {key} 0 {self.ttl_s} {len(val)}\r\n".encode()
                   + val + b"\r\n")
         buf = bytearray()
@@ -169,6 +185,7 @@ class MemcachedCache(_NetCache):
             raise OSError(f"memcached: unexpected {resp[:40]!r}")
 
     def _fetch(self, s, key):
+        key = safe_cache_key(key)
         s.sendall(f"get {key}\r\n".encode())
         buf = bytearray()
         line = _read_line(s, buf)

@@ -81,6 +81,9 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         replication_factor=ingester.get("replication_factor", 1),
         write_quorum=ingester.get("write_quorum", "majority"),
         external_endpoints=doc.get("querier", {}).get("external_endpoints", []),
+        flush_tick_s=ingester.get("flush_tick_s", 10.0),
+        poll_tick_s=storage.get("poll_tick_s", 30.0),
+        compaction_tick_s=compactor.get("tick_s", 30.0),
         db=db,
         limits=Limits(**{
             k: v for k, v in overrides.get("defaults", {}).items()
@@ -93,6 +96,10 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         "http_port": server.get("http_port", 3200),
         "grpc_port": server.get("grpc_port", 9095),
         "multitenancy": doc.get("multitenancy_enabled", True),
+        # memberlist: {bind: "host:port", join: [addr, ...], advertise_host,
+        # gossip_interval_s, suspect_timeout_s} — multi-process gossip
+        "memberlist": doc.get("memberlist", {}),
+        "instance_id": doc.get("instance_id", ""),
         "warnings": check_config(cfg, doc),
     }
     return cfg, runtime

@@ -1,10 +1,18 @@
 """The server binary: ``python -m tempo_tpu.cli.main -config.file=...``.
 
 Role-equivalent to the reference's cmd/tempo main (config load, logger,
-module startup, signal-driven graceful shutdown). One process runs the
-whole pipeline (the reference's ``-target=all`` / scalable-single-binary);
-gRPC exposes the module boundaries so additional processes can join as
-pushers/queriers.
+module startup, signal-driven graceful shutdown) with `-target` module
+selection (cmd/tempo/app/modules.go:35-50):
+
+  -target=all            single process, whole pipeline (default)
+  -target=distributor    OTLP receivers → ring writes over gRPC
+  -target=ingester       Pusher/IngesterQuerier gRPC + WAL/flush loops
+  -target=querier        Querier gRPC job execution
+  -target=query-frontend external HTTP API, job sharding over queriers
+  -target=compactor      ownership-gated compaction + retention
+
+Microservice targets discover each other via gossip membership
+(`memberlist:` config section — bind/join addresses).
 """
 
 from __future__ import annotations
@@ -12,6 +20,7 @@ from __future__ import annotations
 import argparse
 import signal
 import threading
+import uuid
 
 from tempo_tpu.api import HTTPApi, make_grpc_server, serve_http
 from tempo_tpu.modules import App
@@ -20,12 +29,14 @@ from .config import load_config
 
 
 def main(argv=None) -> int:
+    from tempo_tpu.modules.microservices import TARGETS, ModuleProcess
+
     p = argparse.ArgumentParser("tempo-tpu")
     p.add_argument("-config.file", dest="config_file", default=None)
-    p.add_argument("-target", dest="target", default="all",
-                   choices=["all"], help="module target (single-binary)")
+    p.add_argument("-target", dest="target", default="all", choices=TARGETS)
     p.add_argument("-http-port", type=int, default=None)
     p.add_argument("-grpc-port", type=int, default=None)
+    p.add_argument("-instance-id", dest="instance_id", default=None)
     args = p.parse_args(argv)
 
     log = get_logger()
@@ -33,20 +44,8 @@ def main(argv=None) -> int:
     for w in runtime["warnings"]:
         log.warning("config: %s", w)
 
-    app = App(cfg)
-    app.run_maintenance()
-
     http_port = args.http_port or runtime["http_port"]
     grpc_port = args.grpc_port or runtime["grpc_port"]
-
-    api = HTTPApi(app, multitenancy=runtime["multitenancy"])
-    http_server = serve_http(api, port=http_port)
-    threading.Thread(target=http_server.serve_forever, daemon=True).start()
-
-    grpc_server = make_grpc_server(app, f"0.0.0.0:{grpc_port}")
-    grpc_server.start()
-    log.info("tempo-tpu up: http=:%d grpc=:%d ingesters=%d rf=%d",
-             http_port, grpc_port, cfg.n_ingesters, cfg.replication_factor)
 
     stop = threading.Event()
 
@@ -56,11 +55,44 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
-    stop.wait()
 
-    grpc_server.stop(grace=5)
+    if args.target == "all":
+        app = App(cfg)
+        app.run_maintenance()
+        api = HTTPApi(app, multitenancy=runtime["multitenancy"])
+        http_server = serve_http(api, port=http_port)
+        threading.Thread(target=http_server.serve_forever, daemon=True).start()
+        grpc_server = make_grpc_server(app, f"0.0.0.0:{grpc_port}")
+        grpc_server.start()
+        log.info("tempo-tpu up: http=:%d grpc=:%d ingesters=%d rf=%d",
+                 http_port, grpc_port, cfg.n_ingesters,
+                 cfg.replication_factor)
+        stop.wait()
+        grpc_server.stop(grace=5)
+        http_server.shutdown()
+        app.shutdown()  # flush everything (reference /shutdown drain)
+        log.info("shutdown complete")
+        return 0
+
+    # microservice target
+    instance_id = (args.instance_id or runtime["instance_id"]
+                   or f"{args.target}-{uuid.uuid4().hex[:6]}")
+    proc = ModuleProcess(
+        cfg, args.target, instance_id=instance_id,
+        grpc_port=grpc_port if args.target in
+        ("ingester", "querier", "distributor") else 0,
+        http_port=http_port,
+        memberlist_cfg=runtime["memberlist"],
+    )
+    api = HTTPApi(proc, multitenancy=runtime["multitenancy"])
+    http_server = serve_http(api, port=http_port)
+    threading.Thread(target=http_server.serve_forever, daemon=True).start()
+    log.info("tempo-tpu %s up: id=%s http=:%d grpc=%s gossip=%s",
+             args.target, instance_id, http_port, proc.grpc_addr or "-",
+             proc.ml.gossip_addr)
+    stop.wait()
     http_server.shutdown()
-    app.shutdown()  # flush everything (reference /shutdown drain)
+    proc.shutdown()
     log.info("shutdown complete")
     return 0
 

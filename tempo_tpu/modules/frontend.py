@@ -46,10 +46,17 @@ def create_block_boundaries(shards: int) -> list[str]:
 
 
 class QueryFrontend:
-    def __init__(self, queriers: list, cfg: FrontendConfig | None = None):
-        """queriers: round-robin pool of Querier-interface objects."""
+    def __init__(self, queriers: list, cfg: FrontendConfig | None = None,
+                 db=None):
+        """queriers: round-robin pool of Querier-interface objects
+        (in-process Queriers or gRPC QuerierClients). db: the reader
+        TempoDB supplying block metas for search job sharding — the
+        frontend reads the blocklist itself (reference: frontend depends
+        on tempodb Reader for BlockMetas, SURVEY.md §2.2). Defaults to
+        queriers[0].db for in-process single-binary wiring."""
         self.queriers = queriers
         self.cfg = cfg or FrontendConfig()
+        self.db = db if db is not None else getattr(queriers[0], "db", None)
         self._rr = 0
 
     def _querier(self):
@@ -99,7 +106,7 @@ class QueryFrontend:
     # ---- search (reference searchsharding.go:163-306) ----
 
     def search(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
-        db = self.queriers[0].db  # block metas come from the shared reader
+        db = self.db  # block metas come from the frontend's own reader
         metas = [
             m for m in db.blocklist.metas(tenant)
             if not (req.start and m.end_time and m.end_time < req.start)

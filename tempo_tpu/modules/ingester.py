@@ -278,6 +278,17 @@ class Ingester:
         if inst:
             inst.search(req, results)
 
+    def search_tags(self, tenant: str) -> set:
+        with self._lock:
+            inst = self._instances.get(tenant)
+        return inst.search_tags() if inst else set()
+
+    def search_tag_values(self, tenant: str, tag: str,
+                          max_bytes: int = 1 << 20) -> set:
+        with self._lock:
+            inst = self._instances.get(tenant)
+        return inst.search_tag_values(tag, max_bytes) if inst else set()
+
     # ---- flush machinery (reference ingester.loop flush.go:144-218) ----
 
     def sweep(self, max_idle_s: float = 10.0, force: bool = False,

@@ -1,0 +1,273 @@
+"""Gossip membership: ring state replication for multi-process deployments.
+
+Role-equivalent to the reference's memberlist gossip KV (SURVEY.md §2.6:
+ring state replicated by gossip; cmd/tempo/app/app.go:99-111) — a
+memberlist-lite push-pull protocol over TCP:
+
+  - each member owns its record {id, role, addresses, heartbeat counter,
+    state} and increments the counter every gossip tick;
+  - every tick it exchanges full state with a few random peers (push-pull
+    anti-entropy): send my map, receive theirs, both merge;
+  - merge keeps the record with the higher heartbeat counter; LEFT beats
+    ACTIVE at the same-or-higher counter (deregistration wins);
+  - receive time is stamped locally, so each node judges liveness from its
+    own clock — no cross-host clock sync needed (the same reason
+    memberlist gossips counters, not timestamps).
+
+Per-role consistent-hash `Ring`s are derived views of the member map:
+ingester writes, compactor job ownership, querier discovery all read the
+same gossip state, like the reference's single memberlist KV shared by
+all rings. Token sets are deterministic from the instance id (Ring.
+register seeds its RNG with the id), so tokens never travel the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import socketserver
+import threading
+import time
+from dataclasses import dataclass, asdict, field
+
+from tempo_tpu.observability import Counter, get_logger
+from .ring import Ring
+
+STATE_ACTIVE = "ACTIVE"
+STATE_LEFT = "LEFT"
+
+_gossip_rounds = Counter("tempo_memberlist_gossip_rounds_total",
+                         "push-pull exchanges initiated")
+_gossip_errors = Counter("tempo_memberlist_gossip_errors_total",
+                         "failed exchanges (peer treated as suspect)")
+
+
+@dataclass
+class Member:
+    id: str
+    role: str            # ingester | distributor | querier | query-frontend | compactor | ...
+    gossip_addr: str     # host:port of the member's gossip listener
+    grpc_addr: str = ""  # host:port of its gRPC server ("" if none)
+    http_addr: str = ""
+    heartbeat: int = 0   # owner-incremented incarnation counter
+    state: str = STATE_ACTIVE
+    # local-only: when this node last saw the counter advance (monotonic)
+    local_seen: float = field(default=0.0, compare=False)
+
+    def wire(self) -> dict:
+        d = asdict(self)
+        d.pop("local_seen")
+        return d
+
+
+class Memberlist:
+    """One gossip node. Thread-safe; all background threads are daemons."""
+
+    def __init__(self, instance_id: str, role: str, *,
+                 bind: str = "127.0.0.1:0", advertise_host: str = "",
+                 join: list[str] | None = None,
+                 grpc_addr: str = "", http_addr: str = "",
+                 gossip_interval_s: float = 1.0, fanout: int = 3,
+                 suspect_timeout_s: float = 15.0,
+                 replication_factor: int = 3):
+        self.id = instance_id
+        self.role = role
+        self.join_addrs = list(join or [])
+        self.gossip_interval_s = gossip_interval_s
+        self.fanout = fanout
+        self.suspect_timeout_s = suspect_timeout_s
+        self.rf = replication_factor
+        self.log = get_logger()
+
+        self._lock = threading.Lock()
+        self._rings: dict[str, Ring] = {}
+        self._stop = threading.Event()
+
+        host, _, port = bind.rpartition(":")
+        self._server = socketserver.ThreadingTCPServer(
+            (host or "127.0.0.1", int(port or 0)), _Handler)
+        self._server.daemon_threads = True
+        self._server.allow_reuse_address = True
+        self._server.memberlist = self
+        bound = self._server.server_address
+        self.gossip_addr = f"{advertise_host or bound[0]}:{bound[1]}"
+
+        me = Member(id=self.id, role=role, gossip_addr=self.gossip_addr,
+                    grpc_addr=grpc_addr, http_addr=http_addr,
+                    heartbeat=1, state=STATE_ACTIVE,
+                    local_seen=time.monotonic())
+        self._members: dict[str, Member] = {self.id: me}
+        self._ring_for(role).register(self.id)
+
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ---- views ----
+
+    def ring(self, role: str) -> Ring:
+        with self._lock:
+            return self._ring_for(role)
+
+    def _ring_for(self, role: str) -> Ring:
+        ring = self._rings.get(role)
+        if ring is None:
+            ring = self._rings[role] = Ring(replication_factor=self.rf)
+        return ring
+
+    def members(self, role: str | None = None,
+                alive_only: bool = True) -> list[Member]:
+        now = time.monotonic()
+        with self._lock:
+            out = []
+            for m in self._members.values():
+                if role is not None and m.role != role:
+                    continue
+                if alive_only and not self._alive(m, now):
+                    continue
+                out.append(m)
+            return sorted(out, key=lambda m: m.id)
+
+    def _alive(self, m: Member, now: float) -> bool:
+        if m.state != STATE_ACTIVE:
+            return False
+        if m.id == self.id:
+            return True
+        return now - m.local_seen < self.suspect_timeout_s
+
+    # ---- state exchange ----
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            return {"from": self.id,
+                    "members": {m.id: m.wire() for m in self._members.values()}}
+
+    def merge(self, remote: dict) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for mid, rec in remote.get("members", {}).items():
+                if mid == self.id:
+                    # someone else's view of me: only LEFT at a higher
+                    # counter matters (refute by outliving it — we bump our
+                    # own counter every tick)
+                    continue
+                known = self._members.get(mid)
+                rm = Member(**{k: v for k, v in rec.items()
+                               if k in Member.__dataclass_fields__})
+                if known is None:
+                    rm.local_seen = now
+                    self._members[mid] = rm
+                    if rm.state == STATE_ACTIVE:
+                        ring = self._ring_for(rm.role)
+                        ring.register(mid)
+                        ring.heartbeat(mid)
+                    continue
+                if rm.heartbeat > known.heartbeat or (
+                        rm.state == STATE_LEFT
+                        and rm.heartbeat >= known.heartbeat
+                        and known.state != STATE_LEFT):
+                    was = known.state
+                    known.heartbeat = rm.heartbeat
+                    known.state = rm.state
+                    known.grpc_addr = rm.grpc_addr
+                    known.http_addr = rm.http_addr
+                    known.gossip_addr = rm.gossip_addr
+                    known.local_seen = now
+                    ring = self._ring_for(known.role)
+                    if known.state == STATE_LEFT and was == STATE_ACTIVE:
+                        ring.leave(mid)
+                    elif known.state == STATE_ACTIVE:
+                        # re-register revived members too: tick()'s suspect
+                        # expiry removes them from the ring while their
+                        # gossip state stays ACTIVE, so `was` alone can't
+                        # tell a revival from a steady heartbeat
+                        if was != STATE_ACTIVE or mid not in ring:
+                            ring.register(mid)
+                        ring.heartbeat(mid)
+
+    def _exchange(self, addr: str) -> None:
+        host, _, port = addr.rpartition(":")
+        payload = (json.dumps(self._snapshot()) + "\n").encode()
+        with socket.create_connection((host, int(port)), timeout=3) as s:
+            s.sendall(payload)
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(1 << 20)
+                if not chunk:
+                    break
+                buf += chunk
+        if buf:
+            self.merge(json.loads(buf))
+
+    # ---- loops ----
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.gossip_interval_s):
+            self.tick()
+
+    def tick(self) -> None:
+        """One gossip round (public for deterministic tests)."""
+        with self._lock:
+            me = self._members[self.id]
+            me.heartbeat += 1
+            me.local_seen = time.monotonic()
+            self._ring_for(self.role).heartbeat(self.id)
+            # expire suspects from the rings (they stay in the member map
+            # so a revived node re-merges cleanly)
+            now = time.monotonic()
+            for m in self._members.values():
+                if m.id != self.id and m.state == STATE_ACTIVE \
+                        and now - m.local_seen >= self.suspect_timeout_s:
+                    self._ring_for(m.role).leave(m.id)
+            peers = [m.gossip_addr for m in self._members.values()
+                     if m.id != self.id and m.state == STATE_ACTIVE]
+        targets = random.sample(peers, min(self.fanout, len(peers)))
+        # seeds we haven't absorbed yet (bootstrap)
+        with self._lock:
+            known_addrs = {m.gossip_addr for m in self._members.values()}
+        targets += [a for a in self.join_addrs
+                    if a not in known_addrs and a != self.gossip_addr][:2]
+        for addr in targets:
+            _gossip_rounds.inc()
+            try:
+                self._exchange(addr)
+            except (OSError, json.JSONDecodeError):
+                _gossip_errors.inc()
+
+    # ---- lifecycle ----
+
+    def leave(self) -> None:
+        """Graceful deregistration: mark LEFT and gossip it out."""
+        with self._lock:
+            me = self._members[self.id]
+            me.state = STATE_LEFT
+            me.heartbeat += 1
+            self._ring_for(self.role).leave(self.id)
+            peers = [m.gossip_addr for m in self._members.values()
+                     if m.id != self.id and m.state == STATE_ACTIVE]
+        for addr in peers[:self.fanout]:
+            try:
+                self._exchange(addr)
+            except (OSError, json.JSONDecodeError):
+                pass
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        try:
+            line = self.rfile.readline(16 << 20)
+            if not line:
+                return
+            remote = json.loads(line)
+            ml: Memberlist = self.server.memberlist
+            ml.merge(remote)
+            self.wfile.write((json.dumps(ml._snapshot()) + "\n").encode())
+        except (OSError, json.JSONDecodeError, ValueError):
+            pass

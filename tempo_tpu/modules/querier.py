@@ -90,7 +90,11 @@ class Querier:
     def search_recent(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
         results = SearchResults(limit=req.limit or 20)
         for ing in self.ingesters.values():
-            ing.search(tenant, req, results)
+            try:
+                ing.search(tenant, req, results)
+            except Exception:  # noqa: BLE001 — replica failure → partial
+                results.metrics.skipped_blocks += 1
+                continue
             if results.complete:
                 break
         return results.response()
@@ -134,9 +138,10 @@ class Querier:
     def search_tags(self, tenant: str) -> tempopb.SearchTagsResponse:
         tags: set[str] = set()
         for ing in self.ingesters.values():
-            inst = ing._instances.get(tenant)  # noqa: SLF001 — in-process fast path
-            if inst:
-                tags.update(inst.search_tags())
+            try:
+                tags.update(ing.search_tags(tenant))
+            except Exception:  # noqa: BLE001 — replica failure → partial tags
+                continue
         for m in self.db.blocklist.metas(tenant):
             try:
                 sp = self.db._search_block_for(m).staged()  # noqa: SLF001
@@ -152,9 +157,11 @@ class Querier:
         vals: set[str] = set()
         size = 0
         for ing in self.ingesters.values():
-            inst = ing._instances.get(tenant)  # noqa: SLF001
-            if inst:
-                vals.update(inst.search_tag_values(tag, lim.max_bytes_per_tag_values))
+            try:
+                vals.update(ing.search_tag_values(
+                    tenant, tag, lim.max_bytes_per_tag_values))
+            except Exception:  # noqa: BLE001 — replica failure → partial values
+                continue
         for m in self.db.blocklist.metas(tenant):
             try:
                 sp = self.db._search_block_for(m).staged()  # noqa: SLF001

@@ -56,6 +56,10 @@ class Ring:
             self._rebuild()
         return inst
 
+    def __contains__(self, instance_id: str) -> bool:
+        with self._lock:
+            return instance_id in self._instances
+
     def heartbeat(self, instance_id: str) -> None:
         with self._lock:
             if instance_id in self._instances:

@@ -23,6 +23,7 @@ SearchTagsRequest = tempo_pb2.SearchTagsRequest
 SearchTagsResponse = tempo_pb2.SearchTagsResponse
 SearchTagValuesRequest = tempo_pb2.SearchTagValuesRequest
 SearchTagValuesResponse = tempo_pb2.SearchTagValuesResponse
+PartialsResponse = tempo_pb2.PartialsResponse
 
 ResourceSpans = trace_pb2.ResourceSpans
 ScopeSpans = trace_pb2.ScopeSpans
@@ -37,7 +38,7 @@ __all__ = [
     "TraceByIDResponse", "TraceByIDMetrics", "SearchRequest",
     "SearchBlockRequest", "SearchResponse", "TraceSearchMetadata",
     "SearchMetrics", "SearchTagsRequest", "SearchTagsResponse",
-    "SearchTagValuesRequest", "SearchTagValuesResponse",
+    "SearchTagValuesRequest", "SearchTagValuesResponse", "PartialsResponse",
     "ResourceSpans", "ScopeSpans", "Span", "Status", "Resource",
     "KeyValue", "AnyValue", "trace_pb2", "tempo_pb2",
 ]

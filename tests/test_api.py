@@ -240,3 +240,33 @@ def test_distributor_rejects_bad_quorum_mode():
 
     with pytest.raises(ValueError):
         Distributor(Ring(["i0"]), {}, write_quorum="One")
+
+
+def test_http_body_limits(app):
+    """Oversize Content-Length → 413 (never truncate-and-accept); negative
+    chunk size → 400."""
+    import http.client
+
+    api = HTTPApi(app)
+    server = serve_http(api, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        conn.putrequest("POST", "/v1/traces")
+        conn.putheader("Content-Length", str(100 << 20))
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 413
+        conn.close()
+
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        conn.putrequest("POST", "/v1/traces")
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        conn.send(b"-1\r\n")
+        resp = conn.getresponse()
+        assert resp.status == 400
+        conn.close()
+    finally:
+        server.shutdown()

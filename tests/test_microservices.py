@@ -1,0 +1,343 @@
+"""Multi-process deployment: gossip membership, per-target module wiring
+over real gRPC on localhost, and a subprocess e2e through the CLI — the
+reference's integration/e2e microservices topology
+(config-microservices.tmpl.yaml: distributor / ingester×N / querier /
+query-frontend) without Docker."""
+
+import json
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.db import TempoDBConfig
+from tempo_tpu.modules import AppConfig
+from tempo_tpu.modules.membership import Memberlist
+from tempo_tpu.modules.microservices import ModuleProcess
+from tempo_tpu.utils.ids import random_trace_id, trace_id_to_hex
+from tempo_tpu.utils.test_data import make_trace
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_for(pred, timeout_s=15.0, interval_s=0.05, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval_s)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# membership
+
+
+def _ml(iid, role, join=(), **kw):
+    kw.setdefault("gossip_interval_s", 0.1)
+    kw.setdefault("suspect_timeout_s", 1.5)
+    return Memberlist(iid, role, join=list(join), **kw)
+
+
+def test_membership_convergence_and_ring():
+    a = _ml("ing-a", "ingester", grpc_addr="127.0.0.1:1111")
+    b = _ml("ing-b", "ingester", join=[a.gossip_addr],
+            grpc_addr="127.0.0.1:2222")
+    c = _ml("dist-c", "distributor", join=[a.gossip_addr])
+    try:
+        wait_for(lambda: len(c.members("ingester")) == 2,
+                 what="distributor sees both ingesters")
+        wait_for(lambda: len(a.members("distributor")) == 1,
+                 what="ingester learns distributor transitively")
+        # ring view: both ingesters healthy, addresses travelled
+        assert c.ring("ingester").healthy_count() == 2
+        addrs = {m.grpc_addr for m in c.members("ingester")}
+        assert addrs == {"127.0.0.1:1111", "127.0.0.1:2222"}
+        # deterministic tokens: same replica set computed on any node
+        tok = 12345
+        assert (a.ring("ingester").get(tok, rf=2)
+                == c.ring("ingester").get(tok, rf=2))
+    finally:
+        for m in (a, b, c):
+            m.shutdown()
+
+
+def test_membership_graceful_leave():
+    a = _ml("a", "ingester")
+    b = _ml("b", "ingester", join=[a.gossip_addr])
+    try:
+        wait_for(lambda: len(a.members("ingester")) == 2, what="join")
+        b.leave()
+        wait_for(lambda: len(a.members("ingester")) == 1, what="leave gossip")
+        assert a.ring("ingester").healthy_count() == 1
+    finally:
+        a.shutdown()
+
+
+def test_membership_suspect_on_silent_death():
+    a = _ml("a", "ingester")
+    b = _ml("b", "ingester", join=[a.gossip_addr])
+    try:
+        wait_for(lambda: len(a.members("ingester")) == 2, what="join")
+        b.shutdown()  # no leave: simulates a crash
+        wait_for(lambda: len(a.members("ingester")) == 1, timeout_s=10,
+                 what="suspect timeout")
+        # the ring catches up on the next gossip tick
+        wait_for(lambda: a.ring("ingester").healthy_count() == 1,
+                 timeout_s=5, what="ring expiry")
+    finally:
+        a.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# in-process microservice topology (real gRPC between modules)
+
+
+@pytest.fixture
+def topology(tmp_path):
+    cfg = AppConfig(
+        backend={"backend": "local", "local": {"path": str(tmp_path / "blk")}},
+        wal_dir=str(tmp_path / "wal"),
+        replication_factor=2,
+        db=TempoDBConfig(blocklist_poll_s=1),
+    )
+    procs = []
+
+    def mk(target, iid, join=()):
+        p = ModuleProcess(
+            cfg, target, instance_id=iid,
+            grpc_port=free_port() if target in
+            ("ingester", "querier", "distributor") else 0,
+            memberlist_cfg={"join": list(join), "gossip_interval_s": 0.1,
+                            "suspect_timeout_s": 5.0},
+        )
+        procs.append(p)
+        return p
+
+    yield cfg, mk, procs
+    for p in procs:
+        try:
+            p.shutdown()
+        except Exception:
+            pass
+
+
+def test_microservice_topology_end_to_end(topology):
+    cfg, mk, procs = topology
+    ing1 = mk("ingester", "ing-1")
+    seed = [ing1.ml.gossip_addr]
+    ing2 = mk("ingester", "ing-2", join=seed)
+    dist = mk("distributor", "dist-1", join=seed)
+    quer = mk("querier", "quer-1", join=seed)
+    front = mk("query-frontend", "front-1", join=seed)
+
+    wait_for(lambda: dist.ready() and front.ready()
+             and len(quer.ml.members("ingester")) == 2,
+             what="topology convergence")
+
+    # push through the distributor: RF=2 replication over gRPC Pusher
+    tids = []
+    for i in range(12):
+        tid = random_trace_id()
+        tids.append(tid)
+        dist.push("acme", list(make_trace(tid, seed=100 + i).batches))
+
+    # live read path: frontend → querier → gRPC IngesterQuerier replicas
+    resp = front.find_trace(tenant="acme", trace_id=tids[0])
+    assert resp.trace.batches, "live trace not found via replica reads"
+
+    # flush both ingesters to the shared backend, poll the readers
+    ing1.flush_tick(force=True)
+    ing2.flush_tick(force=True)
+    quer.db.poll()
+    front.db.poll()
+
+    # block read path
+    resp = front.find_trace(tenant="acme", trace_id=tids[1])
+    assert resp.trace.batches, "trace not found in backend blocks"
+
+    # search across processes (recent + block jobs over gRPC)
+    req = tempopb.SearchRequest()
+    req.tags["service.name"] = "frontend"
+    req.limit = 50
+    sresp = front.search("acme", req)
+    assert sresp.metrics.inspected_blocks >= 1
+
+    # tag surface through the remote path
+    tags = front.queriers[0].search_tags("acme")
+    assert "service.name" in tags.tag_names
+
+
+def test_microservice_ingester_crash_tolerated(topology):
+    """RF=2: killing one ingester replica must not lose reads (reference
+    write-extension + replica fan-out semantics)."""
+    cfg, mk, procs = topology
+    ing1 = mk("ingester", "ing-1")
+    seed = [ing1.ml.gossip_addr]
+    ing2 = mk("ingester", "ing-2", join=seed)
+    dist = mk("distributor", "dist-1", join=seed)
+    quer = mk("querier", "quer-1", join=seed)
+
+    wait_for(lambda: dist.ready() and len(quer.ml.members("ingester")) == 2,
+             what="convergence")
+
+    tid = random_trace_id()
+    dist.push("t1", list(make_trace(tid, seed=7).batches))
+
+    # hard-kill one replica (no graceful leave, no flush)
+    victim = ing2
+    victim.ml.shutdown()
+    victim.grpc_server.stop(0)
+
+    resp = quer.querier.find_trace_by_id("t1", tid)
+    assert resp.trace.batches, "read lost with one replica down"
+    assert resp.metrics.failed_blocks >= 1  # the dead replica was counted
+
+
+# ---------------------------------------------------------------------------
+# subprocess e2e through the CLI (the real deployment shape)
+
+
+@pytest.mark.slow
+def test_cli_microservices_subprocess(tmp_path):
+    gossip_seed = f"127.0.0.1:{free_port()}"
+    ing_grpc = free_port()
+    dist_grpc = free_port()
+    quer_grpc = free_port()
+    dist_http, ing_http, quer_http, front_http = (free_port() for _ in range(4))
+
+    base = f"""
+storage:
+  backend: local
+  local: {{path: {tmp_path}/blocks}}
+  wal_dir: {tmp_path}/wal
+  poll_tick_s: 1
+ingester:
+  replication_factor: 1
+  flush_tick_s: 1
+memberlist:
+  join: ["{gossip_seed}"]
+  gossip_interval_s: 0.2
+"""
+    (tmp_path / "ing.yaml").write_text(base.replace(
+        'join: ["%s"]' % gossip_seed, 'bind: "%s"' % gossip_seed))
+    (tmp_path / "common.yaml").write_text(base)
+
+    import os
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+
+    def spawn(target, cfg, http, grpc, iid):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tempo_tpu.cli.main",
+             f"-config.file={cfg}", f"-target={target}",
+             f"-http-port={http}", f"-grpc-port={grpc}",
+             f"-instance-id={iid}"],
+            cwd="/root/repo", env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        procs.append(p)
+        return p
+
+    try:
+        spawn("ingester", tmp_path / "ing.yaml", ing_http, ing_grpc, "ing-0")
+        spawn("distributor", tmp_path / "common.yaml", dist_http, dist_grpc,
+              "dist-0")
+        spawn("querier", tmp_path / "common.yaml", quer_http, quer_grpc,
+              "quer-0")
+        spawn("query-frontend", tmp_path / "common.yaml", front_http, 0,
+              "front-0")
+
+        def ready(port):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/ready", timeout=1) as r:
+                    return r.status == 200
+            except Exception:
+                return False
+
+        wait_for(lambda: all(ready(p) for p in
+                             (dist_http, ing_http, quer_http, front_http)),
+                 timeout_s=90, interval_s=0.5, what="processes ready")
+
+        # OTLP/HTTP push to the distributor
+        tid = random_trace_id()
+        payload = make_trace(tid, seed=3).SerializeToString()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{dist_http}/v1/traces", data=payload,
+            headers={"Content-Type": "application/x-protobuf",
+                     "X-Scope-OrgID": "sub"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+
+        # live read via the frontend
+        def found():
+            try:
+                q = urllib.request.Request(
+                    f"http://127.0.0.1:{front_http}/api/traces/"
+                    f"{trace_id_to_hex(tid)}",
+                    headers={"X-Scope-OrgID": "sub"})
+                with urllib.request.urlopen(q, timeout=5) as r:
+                    return bool(json.loads(r.read()).get("batches"))
+            except Exception:
+                return False
+
+        wait_for(found, timeout_s=30, interval_s=0.5,
+                 what="trace via frontend")
+
+        # flush + backend search
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{ing_http}/flush", timeout=10)
+
+        def searched():
+            try:
+                q = urllib.request.Request(
+                    f"http://127.0.0.1:{front_http}/api/search?limit=20",
+                    headers={"X-Scope-OrgID": "sub"})
+                with urllib.request.urlopen(q, timeout=10) as r:
+                    doc = json.loads(r.read())
+                    return bool(doc.get("traces"))
+            except Exception:
+                return False
+
+        wait_for(searched, timeout_s=30, interval_s=0.5,
+                 what="backend search via frontend")
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_membership_revival_rejoins_ring():
+    """A member that goes silent past the suspect timeout and then revives
+    must be re-registered in peers' rings, not just re-marked alive."""
+    a = _ml("a", "ingester")
+    b = _ml("b", "ingester", join=[a.gossip_addr], suspect_timeout_s=0.6)
+    a.suspect_timeout_s = 0.6
+    try:
+        wait_for(lambda: a.ring("ingester").healthy_count() == 2, what="join")
+        # silence b: stop its gossip loop but keep its server up so it can
+        # still answer a's exchanges with STALE state (paused process)
+        b._stop.set()
+        wait_for(lambda: a.ring("ingester").healthy_count() == 1,
+                 timeout_s=10, what="suspicion")
+        # revive: restart b's gossip loop (counter resumes advancing)
+        import threading
+        b._stop.clear()
+        threading.Thread(target=b._loop, daemon=True).start()
+        wait_for(lambda: a.ring("ingester").healthy_count() == 2,
+                 timeout_s=10, what="revival re-registration")
+    finally:
+        a.shutdown()
+        b.shutdown()

@@ -185,3 +185,20 @@ def test_open_cache_factory(memcached):
     lru = open_cache({"cache": "lru"})
     lru.store("a", b"b")
     assert lru.fetch("a") == b"b"
+
+
+def test_unsafe_keys_are_hashed(memcached):
+    from tempo_tpu.backend.netcache import safe_cache_key
+
+    srv, port = memcached
+    c = MemcachedCache(f"127.0.0.1:{port}")
+    # tenant IDs come verbatim from headers: injection/whitespace/overlong
+    evil = "t 0 0 5\r\nset victim/blk/index 0 0 4\r\nevil/blk/index"
+    c.store(evil, b"payload")
+    assert c.fetch(evil) == b"payload"
+    assert "victim/blk/index" not in srv.data  # no injected command ran
+    long_key = "t/" + "x" * 300
+    c.store(long_key, b"v")
+    assert c.fetch(long_key) == b"v"
+    assert safe_cache_key("plain/key") == "plain/key"  # safe keys untouched
+    c.stop()
