@@ -164,8 +164,17 @@ def make_grpc_server(app, address: str = "0.0.0.0:9095",
 
 
 def _unary(fn, req_cls, resp_cls):
+    from tempo_tpu.observability import tracing
+
+    def traced(request, context):
+        md = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
+        parent = tracing.extract_traceparent(md)
+        with tracing.start_span(f"grpc {fn.__name__}",
+                                kind=tracing.KIND_SERVER, parent=parent):
+            return fn(request, context)
+
     return grpc.unary_unary_rpc_method_handler(
-        fn,
+        traced,
         request_deserializer=req_cls.FromString,
         response_serializer=lambda m: m.SerializeToString(),
     )
@@ -190,8 +199,13 @@ class _Base:
         self.tenant = tenant
 
     def _md(self, tenant: str | None):
+        from tempo_tpu.observability import tracing
+
         t = tenant or self.tenant
-        return (("x-scope-orgid", t),) if t else ()
+        md = tracing.inject_traceparent({})
+        if t:
+            md["x-scope-orgid"] = t
+        return tuple(md.items())
 
     def _call(self, service, method, req, resp_cls, tenant=None):
         rpc = self.channel.unary_unary(

@@ -31,6 +31,18 @@ from .params import (
 )
 
 
+def _route_template(path: str) -> str:
+    """Collapse variable path segments so span names stay low-cardinality
+    (OTel convention: name by route, real path in http.target)."""
+    parts = path.split("/")
+    if len(parts) >= 4 and parts[1] == "api" and parts[2] == "traces":
+        parts[3] = "{id}"
+    elif (len(parts) >= 5 and parts[1] == "api" and parts[2] == "search"
+          and parts[3] == "tag"):
+        parts[4] = "{tag}"
+    return "/".join(parts)
+
+
 class HTTPApi:
     """Routes HTTP requests onto an App (modules/app.py)."""
 
@@ -45,14 +57,27 @@ class HTTPApi:
 
     def handle(self, method: str, path: str, query: dict, headers,
                body: bytes = b"") -> tuple[int, dict | str]:
-        try:
-            if method == "POST" and path in ("/v1/traces", "/api/v2/spans"):
-                return self._ingest(path, body, headers)
-            return self._route(method, path, query, headers)
-        except ValueError as e:
-            return 400, {"error": str(e)}
-        except Exception as e:  # noqa: BLE001 — surface as 500
-            return 500, {"error": f"{type(e).__name__}: {e}"}
+        from tempo_tpu.observability import tracing
+
+        parent = tracing.extract_traceparent(headers)
+        with tracing.start_span(f"HTTP {method} {_route_template(path)}",
+                                kind=tracing.KIND_SERVER,
+                                parent=parent) as span:
+            span.set_attribute("http.target", path)
+            try:
+                if method == "POST" and path in ("/v1/traces", "/api/v2/spans"):
+                    code, resp = self._ingest(path, body, headers)
+                else:
+                    code, resp = self._route(method, path, query, headers)
+            except ValueError as e:
+                code, resp = 400, {"error": str(e)}
+            except Exception as e:  # noqa: BLE001 — surface as 500
+                span.record_exception(e)
+                code, resp = 500, {"error": f"{type(e).__name__}: {e}"}
+            span.set_attribute("http.status_code", code)
+            if code >= 500:
+                span.set_status(tracing.STATUS_ERROR)
+            return code, resp
 
     def _ingest(self, path: str, body: bytes, headers):
         """HTTP ingest receivers: OTLP/HTTP protobuf and Zipkin v2 JSON

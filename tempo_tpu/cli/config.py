@@ -90,6 +90,7 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             if k in Limits.__dataclass_fields__
         }),
         per_tenant_overrides=overrides.get("per_tenant", {}),
+        self_tracing=doc.get("self_tracing", {}),
     )
     server = doc.get("server", {})
     runtime = {

@@ -8,6 +8,7 @@ the first hit (trace-by-ID needs only one block to answer).
 from __future__ import annotations
 
 import concurrent.futures
+import contextvars
 import threading
 
 
@@ -15,13 +16,20 @@ def run_jobs(jobs, fn, workers: int = 50, stop_on_first: bool = False,
              collect_errors: bool = True):
     """Run fn(job) for each job. Returns (results, errors) where results
     excludes None. With stop_on_first, pending jobs are skipped after the
-    first non-None result."""
+    first non-None result.
+
+    Jobs run under a copy of the caller's contextvars context, so the
+    active tracing span parents the per-block spans across the pool."""
     results = []
     errors = []
     if not jobs:
         return results, errors
     stop = threading.Event()
     lock = threading.Lock()
+    caller_ctx = contextvars.copy_context()
+
+    def _run_in_ctx(job):
+        caller_ctx.copy().run(_run, job)
 
     def _run(job):
         if stop.is_set():
@@ -41,5 +49,5 @@ def run_jobs(jobs, fn, workers: int = 50, stop_on_first: bool = False,
 
     workers = max(1, min(workers, len(jobs)))
     with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
-        list(ex.map(_run, jobs))
+        list(ex.map(_run_in_ctx, jobs))
     return results, errors

@@ -23,6 +23,7 @@ from tempo_tpu.search.backend_search_block import BackendSearchBlock
 from tempo_tpu.search.columnar import PageGeometry
 from tempo_tpu.search.engine import ScanEngine
 from tempo_tpu.observability import metrics as obs
+from tempo_tpu.observability import tracing
 from tempo_tpu.utils.ids import pad_trace_id
 from tempo_tpu.wal import WAL, AppendBlock
 
@@ -148,11 +149,17 @@ class TempoDB:
         def job(m: BlockMeta):
             return BackendBlock(self.backend, m).find_by_id(key)
 
-        found, errors = run_jobs(metas, job, workers=self.cfg.pool_workers)
-        if not found:
-            return None, len(errors)
-        codec = codec_for(metas[0].data_encoding if metas else "v2")
-        return (found[0] if len(found) == 1 else codec.combine(*found)), len(errors)
+        # reference: store.Find span w/ inspected-block tags tempodb.go:291
+        with tracing.start_span("tempodb.Find", tenant=tenant) as span:
+            found, errors = run_jobs(metas, job, workers=self.cfg.pool_workers)
+            span.set_attributes(candidate_blocks=len(metas),
+                                failed_blocks=len(errors),
+                                partials=len(found))
+            if not found:
+                return None, len(errors)
+            codec = codec_for(metas[0].data_encoding if metas else "v2")
+            return (found[0] if len(found) == 1
+                    else codec.combine(*found)), len(errors)
 
     def _search_block_for(self, meta: BlockMeta) -> BackendSearchBlock:
         with self._search_lock:
@@ -170,7 +177,8 @@ class TempoDB:
         """Search all (time-pruned) blocks of a tenant through the device
         engine, early-stopping at the result limit."""
         results = results or SearchResults(limit=req.limit or 20)
-        with obs.query_seconds.time(op="search"):
+        with obs.query_seconds.time(op="search"), \
+                tracing.start_span("tempodb.Search", tenant=tenant) as span:
             for m in self.blocklist.metas(tenant):
                 if not self._include_block(m, "", "", req.start, req.end):
                     results.metrics.skipped_blocks += 1
@@ -178,6 +186,10 @@ class TempoDB:
                 self._search_block_for(m).search(req, results, engine=self.engine)
                 if results.complete:
                     break
+            span.set_attributes(
+                inspected_traces=results.metrics.inspected_traces,
+                inspected_blocks=results.metrics.inspected_blocks,
+                skipped_blocks=results.metrics.skipped_blocks)
         obs.search_inspected.inc(results.metrics.inspected_traces, tenant=tenant)
         return results
 

@@ -43,6 +43,9 @@ class AppConfig:
     flush_tick_s: float = 10.0
     poll_tick_s: float = 30.0
     compaction_tick_s: float = 30.0
+    # self_tracing: {enabled, exporter: self|otlp, endpoint, tenant,
+    # sample_ratio} — the framework traces itself (observability/tracing)
+    self_tracing: dict = field(default_factory=dict)
 
 
 class App:
@@ -83,6 +86,11 @@ class App:
         self.frontend = QueryFrontend(self.queriers, self.cfg.frontend)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # self-tracing ("tempo traces tempo"): export into our own
+        # distributor by default, or OTLP/HTTP out to a collector
+        from tempo_tpu.observability import tracing
+        self.tracer = tracing.init_tracing(self.cfg.self_tracing,
+                                           push=self.push)
 
     # ---- public API surface (what api/http.py routes onto) ----
 
@@ -138,6 +146,11 @@ class App:
     def shutdown(self) -> None:
         """Graceful: flush everything, stop loops (reference /shutdown)."""
         self._stop.set()
+        if self.tracer is not None:
+            from tempo_tpu.observability import tracing
+            self.tracer.shutdown()
+            if tracing.get_tracer() is self.tracer:
+                tracing.set_tracer(None)
         for ing in self.ingesters.values():
             ing.flush_all()
         self.poll_tick()

@@ -22,6 +22,7 @@ from tempo_tpu import tempopb
 from tempo_tpu.db.pool import run_jobs
 from tempo_tpu.model.codec import codec_for, CURRENT_ENCODING
 from tempo_tpu.model.combine import combine_trace_protos
+from tempo_tpu.observability import tracing
 from tempo_tpu.search import SearchResults
 
 
@@ -76,6 +77,14 @@ class QueryFrontend:
     # ---- trace by id (reference frontend.go:91-176) ----
 
     def find_trace_by_id(self, tenant: str, trace_id: bytes) -> tempopb.TraceByIDResponse:
+        with tracing.start_span("frontend.TraceByID", kind=tracing.KIND_SERVER,
+                                tenant=tenant) as span:
+            resp = self._find_trace_by_id(tenant, trace_id)
+            span.set_attributes(failed_blocks=resp.metrics.failed_blocks,
+                                found=bool(len(resp.trace.batches)))
+            return resp
+
+    def _find_trace_by_id(self, tenant: str, trace_id: bytes) -> tempopb.TraceByIDResponse:
         bounds = create_block_boundaries(self.cfg.query_shards - 1)
         jobs = [("ingesters", "", "")] + [
             ("blocks", bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)
@@ -106,6 +115,16 @@ class QueryFrontend:
     # ---- search (reference searchsharding.go:163-306) ----
 
     def search(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
+        with tracing.start_span("frontend.Search", kind=tracing.KIND_SERVER,
+                                tenant=tenant) as span:
+            resp = self._search(tenant, req)
+            span.set_attributes(
+                inspected_blocks=resp.metrics.inspected_blocks,
+                inspected_traces=resp.metrics.inspected_traces,
+                results=len(resp.traces))
+            return resp
+
+    def _search(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
         db = self.db  # block metas come from the frontend's own reader
         metas = [
             m for m in db.blocklist.metas(tenant)

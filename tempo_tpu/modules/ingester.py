@@ -136,12 +136,18 @@ class TenantInstance:
             if not self.completing:
                 return None
             blk, search = self.completing.pop(0)
-        try:
-            meta = self.db.complete_block(blk, search.entries())
-        except Exception:
-            with self.lock:
-                self.completing.insert(0, (blk, search))
-            raise
+        from tempo_tpu.observability import tracing
+        with tracing.start_span("ingester.CompleteBlock",
+                                tenant=self.tenant) as span:
+            try:
+                meta = self.db.complete_block(blk, search.entries())
+                span.set_attributes(block_id=meta.block_id,
+                                    objects=meta.total_objects)
+            except Exception:
+                # span.__exit__ records the propagating exception
+                with self.lock:
+                    self.completing.insert(0, (blk, search))
+                raise
         blk.clear()
         search.clear()
         with self.lock:
