@@ -40,6 +40,10 @@ def _route_template(path: str) -> str:
     elif (len(parts) >= 5 and parts[1] == "api" and parts[2] == "search"
           and parts[3] == "tag"):
         parts[4] = "{tag}"
+    elif len(parts) >= 5 and parts[1] == "jaeger" and parts[3] == "traces":
+        parts[4] = "{id}"
+    elif len(parts) >= 5 and parts[1] == "jaeger" and parts[3] == "services":
+        parts[4] = "{service}"
     return "/".join(parts)
 
 
@@ -65,7 +69,8 @@ class HTTPApi:
                                 parent=parent) as span:
             span.set_attribute("http.target", path)
             try:
-                if method == "POST" and path in ("/v1/traces", "/api/v2/spans"):
+                if method == "POST" and path in ("/v1/traces", "/api/v2/spans",
+                                                 "/api/traces"):
                     code, resp = self._ingest(path, body, headers)
                 else:
                     code, resp = self._route(method, path, query, headers)
@@ -87,16 +92,21 @@ class HTTPApi:
 
         from google.protobuf.message import DecodeError
 
+        from .jaeger import jaeger_thrift_http_to_batches
         from .receivers import otlp_http_to_batches, zipkin_json_to_batches
+        from .thriftproto import ThriftError
 
         tenant = self.tenant(headers)
         try:
             if path == "/v1/traces":
                 batches = otlp_http_to_batches(body)
+            elif path == "/api/traces":
+                # jaeger collector contract: thrift-binary Batch body
+                batches = jaeger_thrift_http_to_batches(body)
             else:
                 batches = zipkin_json_to_batches(body)
         except (DecodeError, KeyError, TypeError, AttributeError,
-                _json.JSONDecodeError) as e:
+                ThriftError, _json.JSONDecodeError) as e:
             return 400, {"error": f"malformed payload: {type(e).__name__}: {e}"}
         if batches:
             self.app.push(tenant, batches)
@@ -142,7 +152,31 @@ class HTTPApi:
                 tag = rest[: -len("/values")]
                 resp = self.app.queriers[0].search_tag_values(tenant, tag)
                 return 200, json_format.MessageToDict(resp)
+        if path.startswith("/jaeger/api/"):
+            return self._jaeger_query(tenant, path[len("/jaeger/api"):], query)
         return 404, {"error": f"no route {path}"}
+
+    def _jaeger_query(self, tenant, sub, query):
+        """Jaeger query-service JSON API (cmd/tempo-query role)."""
+        from .jaeger_query import JaegerQueryBridge
+
+        bridge = JaegerQueryBridge(self.app)
+        if sub == "/services":
+            return 200, bridge.services(tenant)
+        if sub.startswith("/services/") and sub.endswith("/operations"):
+            svc = sub[len("/services/"): -len("/operations")]
+            return 200, bridge.operations(tenant, svc)
+        if sub == "/operations":
+            return 200, bridge.operations(tenant, query.get("service", ""))
+        if sub == "/traces":
+            return 200, bridge.search(tenant, query)
+        if sub.startswith("/traces/"):
+            data = bridge.trace_by_id(tenant,
+                                      hex_to_trace_id(sub[len("/traces/"):]))
+            if data is None:
+                return 404, {"errors": [{"msg": "trace not found"}]}
+            return 200, data
+        return 404, {"error": f"no jaeger route {sub}"}
 
     def _status(self, path) -> dict:
         app = self.app

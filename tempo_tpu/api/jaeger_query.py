@@ -1,0 +1,178 @@
+"""Jaeger-UI query bridge: the cmd/tempo-query role.
+
+The reference ships cmd/tempo-query, a Jaeger gRPC storage plugin that
+lets the Jaeger UI (and Grafana 7.4's Jaeger datasource) read traces out
+of Tempo (cmd/tempo-query/main.go:24-60, tempo/plugin.go). Here the
+bridge is served in-process over HTTP instead: the Jaeger query-service
+JSON API (`/jaeger/api/...`) translated onto the framework's native
+trace/search model — no sidecar process needed.
+
+Endpoints (Jaeger query-service contract):
+  GET /jaeger/api/services                       → {"data": [service, ...]}
+  GET /jaeger/api/services/{svc}/operations      → {"data": [op, ...]}
+  GET /jaeger/api/traces/{trace_id}              → {"data": [jaeger trace]}
+  GET /jaeger/api/traces?service=&operation=&limit=&start=&end=
+                                                 → {"data": [jaeger trace, ...]}
+"""
+
+from __future__ import annotations
+
+from tempo_tpu import tempopb
+from tempo_tpu.db.pool import run_jobs
+
+from .params import _duration_ms
+
+_KIND_NAME = {
+    tempopb.Span.SPAN_KIND_CLIENT: "client",
+    tempopb.Span.SPAN_KIND_SERVER: "server",
+    tempopb.Span.SPAN_KIND_PRODUCER: "producer",
+    tempopb.Span.SPAN_KIND_CONSUMER: "consumer",
+    tempopb.Span.SPAN_KIND_INTERNAL: "internal",
+}
+
+
+def _any_to_jaeger(v: "tempopb.AnyValue") -> tuple[str, object]:
+    which = v.WhichOneof("value")
+    if which == "bool_value":
+        return "bool", v.bool_value
+    if which == "int_value":
+        return "int64", v.int_value
+    if which == "double_value":
+        return "float64", v.double_value
+    if which == "bytes_value":
+        return "binary", v.bytes_value.hex()
+    return "string", v.string_value
+
+
+def _tags(attributes) -> list:
+    out = []
+    for kv in attributes:
+        typ, val = _any_to_jaeger(kv.value)
+        out.append({"key": kv.key, "type": typ, "value": val})
+    return out
+
+
+def trace_to_jaeger(trace: "tempopb.Trace") -> dict:
+    """OTLP-shaped tempopb.Trace → one Jaeger-UI JSON trace."""
+    processes: dict[str, dict] = {}
+    proc_ids: dict[str, str] = {}  # service name → pid
+    spans = []
+    trace_id_hex = ""
+    for rs in trace.batches:
+        svc = "unknown"
+        proc_tags = []
+        for kv in rs.resource.attributes:
+            if kv.key == "service.name":
+                svc = kv.value.string_value or svc
+            else:
+                typ, val = _any_to_jaeger(kv.value)
+                proc_tags.append({"key": kv.key, "type": typ, "value": val})
+        pid = proc_ids.get(svc)
+        if pid is None:
+            pid = proc_ids[svc] = f"p{len(proc_ids) + 1}"
+            processes[pid] = {"serviceName": svc, "tags": proc_tags}
+        for ss in rs.scope_spans:
+            for s in ss.spans:
+                trace_id_hex = trace_id_hex or s.trace_id.hex()
+                js = {
+                    "traceID": s.trace_id.hex(),
+                    "spanID": s.span_id.hex(),
+                    "operationName": s.name,
+                    "startTime": s.start_time_unix_nano // 1000,
+                    "duration": max(0, (s.end_time_unix_nano
+                                        - s.start_time_unix_nano)) // 1000,
+                    "processID": pid,
+                    "references": [],
+                    "tags": _tags(s.attributes),
+                    "logs": [
+                        {"timestamp": ev.time_unix_nano // 1000,
+                         "fields": ([{"key": "event", "type": "string",
+                                      "value": ev.name}]
+                                    + _tags(ev.attributes))}
+                        for ev in s.events
+                    ],
+                }
+                if s.kind in _KIND_NAME:
+                    js["tags"].append({"key": "span.kind", "type": "string",
+                                       "value": _KIND_NAME[s.kind]})
+                if s.status.code == 2:
+                    js["tags"].append({"key": "error", "type": "bool",
+                                       "value": True})
+                if s.parent_span_id:
+                    js["references"].append({
+                        "refType": "CHILD_OF",
+                        "traceID": s.trace_id.hex(),
+                        "spanID": s.parent_span_id.hex(),
+                    })
+                for link in s.links:
+                    js["references"].append({
+                        "refType": "FOLLOWS_FROM",
+                        "traceID": link.trace_id.hex(),
+                        "spanID": link.span_id.hex(),
+                    })
+                spans.append(js)
+    return {"traceID": trace_id_hex, "spans": spans, "processes": processes}
+
+
+class JaegerQueryBridge:
+    """Serves the Jaeger query-service API from an App."""
+
+    def __init__(self, app):
+        self.app = app
+
+    def services(self, tenant: str) -> dict:
+        resp = self.app.queriers[0].search_tag_values(tenant, "service.name")
+        return {"data": sorted(resp.tag_values)}
+
+    OPERATIONS_SCAN_LIMIT = 200
+
+    def operations(self, tenant: str, service: str) -> dict:
+        """Operation names for one service. Service-filtered via a search
+        over that service's traces (root operation names; bounded scan) —
+        the unfiltered "name" tag-values index spans all services and
+        would pollute the UI dropdown with other services' operations."""
+        if not service:
+            resp = self.app.queriers[0].search_tag_values(tenant, "name")
+            return {"data": sorted(resp.tag_values)}
+        req = tempopb.SearchRequest()
+        req.tags["service.name"] = service
+        req.limit = self.OPERATIONS_SCAN_LIMIT
+        sresp = self.app.search(tenant, req)
+        ops = {m.root_trace_name for m in sresp.traces
+               if m.root_trace_name and m.root_service_name == service}
+        return {"data": sorted(ops)}
+
+    def trace_by_id(self, tenant: str, trace_id: bytes):
+        resp = self.app.find_trace(tenant, trace_id)
+        if not resp.trace.batches:
+            return None
+        return {"data": [trace_to_jaeger(resp.trace)]}
+
+    def search(self, tenant: str, query: dict) -> dict:
+        req = tempopb.SearchRequest()
+        if query.get("service"):
+            req.tags["service.name"] = query["service"]
+        if query.get("operation"):
+            req.tags["name"] = query["operation"]
+        # jaeger sends start/end in µs epoch
+        if query.get("start"):
+            req.start = int(int(query["start"]) // 1_000_000)
+        if query.get("end"):
+            req.end = int(int(query["end"]) // 1_000_000) + 1
+        if query.get("minDuration"):
+            req.min_duration_ms = _duration_ms(query["minDuration"])
+        if query.get("maxDuration"):
+            req.max_duration_ms = _duration_ms(query["maxDuration"])
+        req.limit = int(query.get("limit", 20))
+        sresp = self.app.search(tenant, req)
+
+        def fetch(meta):
+            full = self.app.find_trace(tenant, bytes.fromhex(meta.trace_id))
+            return trace_to_jaeger(full.trace) if full.trace.batches else None
+
+        hydrated, _ = run_jobs(list(sresp.traces), fetch, workers=10)
+        # run_jobs completion order is nondeterministic; restore the
+        # search's newest-first ordering
+        order = {m.trace_id: i for i, m in enumerate(sresp.traces)}
+        hydrated.sort(key=lambda j: order.get(j["traceID"], 1 << 30))
+        return {"data": hydrated}

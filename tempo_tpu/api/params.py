@@ -68,11 +68,15 @@ def build_search_request(req: tempopb.SearchRequest) -> str:
 
 
 def _duration_ms(s: str) -> int:
+    """'100ms', '1.5s', '250us', '2m', '0.5h'; bare numbers are ms.
+    (Also the Jaeger-bridge duration syntax — keep the suffix table in
+    one place.)"""
     s = s.strip()
-    for suffix, mult in (("ms", 1), ("s", 1000), ("m", 60_000), ("h", 3_600_000)):
+    for suffix, mult in (("ms", 1), ("us", 0.001), ("µs", 0.001),
+                         ("s", 1000), ("m", 60_000), ("h", 3_600_000)):
         if s.endswith(suffix) and s[: -len(suffix)].replace(".", "").isdigit():
-            return int(float(s[: -len(suffix)]) * mult)
-    return int(float(s))
+            return max(0, int(float(s[: -len(suffix)]) * mult))
+    return max(0, int(float(s)))
 
 
 def parse_trace_by_id_params(query: dict[str, str]) -> tuple[str, str, str]:

@@ -1,0 +1,377 @@
+"""Minimal Apache-Thrift wire codecs (binary + compact protocols).
+
+The reference ingests Jaeger spans through otel-collector's jaeger
+receiver (modules/distributor/receiver/shim.go:75-138), which speaks
+thrift on the wire: TBinaryProtocol for the collector HTTP endpoint and
+TCompactProtocol for the UDP agent. No thrift library is vendored here;
+these are self-contained codecs for the subset thrift IDL uses
+(struct/list/string/i16/i32/i64/double/bool/binary), decoding to a
+generic ``{field_id: value}`` tree — schema interpretation lives with the
+caller (api/jaeger.py).
+
+Both directions are implemented so tests can fabricate exactly what a
+Jaeger client emits.
+"""
+
+from __future__ import annotations
+
+import struct
+
+# thrift type ids (TType)
+T_STOP = 0
+T_BOOL = 2
+T_BYTE = 3
+T_DOUBLE = 4
+T_I16 = 6
+T_I32 = 8
+T_I64 = 10
+T_STRING = 11
+T_STRUCT = 12
+T_MAP = 13
+T_SET = 14
+T_LIST = 15
+
+# message types
+MSG_CALL = 1
+MSG_REPLY = 2
+MSG_ONEWAY = 4
+
+
+class ThriftError(ValueError):
+    pass
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if n < 0 or self.pos + n > len(self.data):
+            raise ThriftError("truncated thrift payload")
+        b = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+
+# --------------------------------------------------------------- binary
+
+
+class BinaryProtocol:
+    """TBinaryProtocol (strict): big-endian fixed-width ints,
+    i32-length-prefixed strings, typed field headers."""
+
+    VERSION_1 = 0x80010000
+
+    # -- decode --
+
+    def read_struct(self, r: _Reader) -> dict:
+        out = {}
+        while True:
+            ftype = r.u8()
+            if ftype == T_STOP:
+                return out
+            (fid,) = struct.unpack(">h", r.take(2))
+            out[fid] = self.read_value(r, ftype)
+
+    def read_value(self, r: _Reader, ftype: int):
+        if ftype == T_BOOL:
+            return r.u8() != 0
+        if ftype == T_BYTE:
+            return struct.unpack(">b", r.take(1))[0]
+        if ftype == T_DOUBLE:
+            return struct.unpack(">d", r.take(8))[0]
+        if ftype == T_I16:
+            return struct.unpack(">h", r.take(2))[0]
+        if ftype == T_I32:
+            return struct.unpack(">i", r.take(4))[0]
+        if ftype == T_I64:
+            return struct.unpack(">q", r.take(8))[0]
+        if ftype == T_STRING:
+            (n,) = struct.unpack(">i", r.take(4))
+            if n < 0:
+                raise ThriftError("negative string length")
+            return r.take(n)
+        if ftype == T_STRUCT:
+            return self.read_struct(r)
+        if ftype in (T_LIST, T_SET):
+            etype = r.u8()
+            (n,) = struct.unpack(">i", r.take(4))
+            if n < 0:
+                raise ThriftError("negative list size")
+            return [self.read_value(r, etype) for _ in range(n)]
+        if ftype == T_MAP:
+            ktype, vtype = r.u8(), r.u8()
+            (n,) = struct.unpack(">i", r.take(4))
+            return {self.read_value(r, ktype): self.read_value(r, vtype)
+                    for _ in range(n)}
+        raise ThriftError(f"unsupported thrift type {ftype}")
+
+    def read_message(self, r: _Reader) -> tuple[str, int, int]:
+        """Returns (name, msg_type, seqid); caller then reads args struct."""
+        (version,) = struct.unpack(">I", r.take(4))
+        if version & 0xFFFF0000 != self.VERSION_1:
+            raise ThriftError("bad binary-protocol version")
+        msg_type = version & 0xFF
+        (n,) = struct.unpack(">i", r.take(4))
+        name = r.take(n).decode()
+        (seqid,) = struct.unpack(">i", r.take(4))
+        return name, msg_type, seqid
+
+    # -- encode (tests / clients) --
+
+    def write_value(self, out: bytearray, ftype: int, v) -> None:
+        if ftype == T_BOOL:
+            out.append(1 if v else 0)
+        elif ftype == T_BYTE:
+            out += struct.pack(">b", v)
+        elif ftype == T_DOUBLE:
+            out += struct.pack(">d", v)
+        elif ftype == T_I16:
+            out += struct.pack(">h", v)
+        elif ftype == T_I32:
+            out += struct.pack(">i", v)
+        elif ftype == T_I64:
+            out += struct.pack(">q", v)
+        elif ftype == T_STRING:
+            b = v.encode() if isinstance(v, str) else bytes(v)
+            out += struct.pack(">i", len(b)) + b
+        elif ftype == T_STRUCT:
+            out += self.encode_struct(v)
+        elif ftype in (T_LIST, T_SET):
+            etype, items = v
+            out.append(etype)
+            out += struct.pack(">i", len(items))
+            for it in items:
+                self.write_value(out, etype, it)
+        else:
+            raise ThriftError(f"unsupported thrift type {ftype}")
+
+    def encode_struct(self, fields: list) -> bytes:
+        """fields: [(fid, ftype, value), ...]"""
+        out = bytearray()
+        for fid, ftype, v in fields:
+            out.append(ftype)
+            out += struct.pack(">h", fid)
+            self.write_value(out, ftype, v)
+        out.append(T_STOP)
+        return bytes(out)
+
+    def encode_message(self, name: str, msg_type: int, seqid: int,
+                       args: list) -> bytes:
+        out = bytearray()
+        out += struct.pack(">I", self.VERSION_1 | msg_type)
+        nb = name.encode()
+        out += struct.pack(">i", len(nb)) + nb
+        out += struct.pack(">i", seqid)
+        out += self.encode_struct(args)
+        return bytes(out)
+
+
+# -------------------------------------------------------------- compact
+
+# compact field types (distinct numbering from TType)
+CT_BOOL_TRUE = 1
+CT_BOOL_FALSE = 2
+CT_BYTE = 3
+CT_I16 = 4
+CT_I32 = 5
+CT_I64 = 6
+CT_DOUBLE = 7
+CT_BINARY = 8
+CT_LIST = 9
+CT_SET = 10
+CT_MAP = 11
+CT_STRUCT = 12
+
+_TTYPE_TO_CT = {T_BOOL: CT_BOOL_TRUE, T_BYTE: CT_BYTE, T_I16: CT_I16,
+                T_I32: CT_I32, T_I64: CT_I64, T_DOUBLE: CT_DOUBLE,
+                T_STRING: CT_BINARY, T_LIST: CT_LIST, T_SET: CT_SET,
+                T_MAP: CT_MAP, T_STRUCT: CT_STRUCT}
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def _write_varint(out: bytearray, n: int) -> None:
+    while True:
+        if n & ~0x7F == 0:
+            out.append(n)
+            return
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+
+
+def _read_varint(r: _Reader) -> int:
+    shift = 0
+    result = 0
+    while True:
+        b = r.u8()
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result
+        shift += 7
+        if shift > 70:
+            raise ThriftError("varint too long")
+
+
+class CompactProtocol:
+    """TCompactProtocol: zigzag varints, delta-encoded field ids, bools
+    folded into the field header, little-endian doubles (the Apache
+    implementations' de-facto spec)."""
+
+    PROTOCOL_ID = 0x82
+    VERSION = 1
+
+    # -- decode --
+
+    def read_struct(self, r: _Reader) -> dict:
+        out = {}
+        last_fid = 0
+        while True:
+            head = r.u8()
+            if head == T_STOP:
+                return out
+            delta = (head >> 4) & 0x0F
+            ctype = head & 0x0F
+            if delta:
+                fid = last_fid + delta
+            else:
+                fid = _unzigzag(_read_varint(r))
+            last_fid = fid
+            out[fid] = self.read_value(r, ctype)
+
+    def read_value(self, r: _Reader, ctype: int):
+        if ctype == CT_BOOL_TRUE:
+            return True
+        if ctype == CT_BOOL_FALSE:
+            return False
+        if ctype == CT_BYTE:
+            return struct.unpack(">b", r.take(1))[0]
+        if ctype in (CT_I16, CT_I32, CT_I64):
+            return _unzigzag(_read_varint(r))
+        if ctype == CT_DOUBLE:
+            return struct.unpack("<d", r.take(8))[0]
+        if ctype == CT_BINARY:
+            return r.take(_read_varint(r))
+        if ctype == CT_STRUCT:
+            return self.read_struct(r)
+        if ctype in (CT_LIST, CT_SET):
+            head = r.u8()
+            size = (head >> 4) & 0x0F
+            etype = head & 0x0F
+            if size == 15:
+                size = _read_varint(r)
+            if etype in (CT_BOOL_TRUE, CT_BOOL_FALSE):
+                return [r.u8() == CT_BOOL_TRUE for _ in range(size)]
+            return [self.read_value(r, etype) for _ in range(size)]
+        if ctype == CT_MAP:
+            size = _read_varint(r)
+            if size == 0:
+                return {}
+            kv = r.u8()
+            ktype, vtype = (kv >> 4) & 0x0F, kv & 0x0F
+            return {self.read_value(r, ktype): self.read_value(r, vtype)
+                    for _ in range(size)}
+        raise ThriftError(f"unsupported compact type {ctype}")
+
+    def read_message(self, r: _Reader) -> tuple[str, int, int]:
+        if r.u8() != self.PROTOCOL_ID:
+            raise ThriftError("not a compact-protocol message")
+        b = r.u8()
+        if b & 0x1F != self.VERSION:
+            raise ThriftError("bad compact-protocol version")
+        msg_type = (b >> 5) & 0x07
+        seqid = _read_varint(r)
+        name = r.take(_read_varint(r)).decode()
+        return name, msg_type, seqid
+
+    # -- encode --
+
+    def write_value(self, out: bytearray, ttype: int, v) -> None:
+        if ttype == T_BOOL:  # only inside lists; field bools use header
+            out.append(CT_BOOL_TRUE if v else CT_BOOL_FALSE)
+        elif ttype == T_BYTE:
+            out += struct.pack(">b", v)
+        elif ttype in (T_I16, T_I32, T_I64):
+            _write_varint(out, _zigzag(v))
+        elif ttype == T_DOUBLE:
+            out += struct.pack("<d", v)
+        elif ttype == T_STRING:
+            b = v.encode() if isinstance(v, str) else bytes(v)
+            _write_varint(out, len(b))
+            out += b
+        elif ttype == T_STRUCT:
+            out += self.encode_struct(v)
+        elif ttype in (T_LIST, T_SET):
+            etype, items = v
+            ct = _TTYPE_TO_CT[etype]
+            if len(items) < 15:
+                out.append((len(items) << 4) | ct)
+            else:
+                out.append(0xF0 | ct)
+                _write_varint(out, len(items))
+            for it in items:
+                self.write_value(out, etype, it)
+        else:
+            raise ThriftError(f"unsupported thrift type {ttype}")
+
+    def encode_struct(self, fields: list) -> bytes:
+        out = bytearray()
+        last_fid = 0
+        for fid, ftype, v in fields:
+            if ftype == T_BOOL:
+                ct = CT_BOOL_TRUE if v else CT_BOOL_FALSE
+            else:
+                ct = _TTYPE_TO_CT[ftype]
+            delta = fid - last_fid
+            if 0 < delta <= 15:
+                out.append((delta << 4) | ct)
+            else:
+                out.append(ct)
+                _write_varint(out, _zigzag(fid))
+            last_fid = fid
+            if ftype != T_BOOL:
+                self.write_value(out, ftype, v)
+        out.append(T_STOP)
+        return bytes(out)
+
+    def encode_message(self, name: str, msg_type: int, seqid: int,
+                       args: list) -> bytes:
+        out = bytearray([self.PROTOCOL_ID,
+                         ((msg_type & 0x07) << 5) | self.VERSION])
+        _write_varint(out, seqid)
+        nb = name.encode()
+        _write_varint(out, len(nb))
+        out += nb
+        out += self.encode_struct(args)
+        return bytes(out)
+
+
+def decode_struct(data: bytes, protocol: str = "binary") -> dict:
+    proto = BinaryProtocol() if protocol == "binary" else CompactProtocol()
+    return proto.read_struct(_Reader(data))
+
+
+def decode_message(data: bytes):
+    """Sniff the protocol from the first byte and decode a full message.
+    Returns (name, msg_type, seqid, args_struct)."""
+    if not data:
+        raise ThriftError("empty message")
+    r = _Reader(data)
+    if data[0] == CompactProtocol.PROTOCOL_ID:
+        proto = CompactProtocol()
+    elif data[0] == 0x80:
+        proto = BinaryProtocol()
+    else:
+        raise ThriftError(f"unknown thrift protocol byte {data[0]:#x}")
+    name, msg_type, seqid = proto.read_message(r)
+    return name, msg_type, seqid, proto.read_struct(r)

@@ -96,6 +96,9 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
     runtime = {
         "http_port": server.get("http_port", 3200),
         "grpc_port": server.get("grpc_port", 9095),
+        # jaeger agent UDP ingest (compact/binary thrift emitBatch);
+        # 0/absent = disabled, 6831 is the jaeger default
+        "jaeger_agent_port": server.get("jaeger_agent_port", 0),
         "multitenancy": doc.get("multitenancy_enabled", True),
         # memberlist: {bind: "host:port", join: [addr, ...], advertise_host,
         # gossip_interval_s, suspect_timeout_s} — multi-process gossip

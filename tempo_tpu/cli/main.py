@@ -64,12 +64,19 @@ def main(argv=None) -> int:
         threading.Thread(target=http_server.serve_forever, daemon=True).start()
         grpc_server = make_grpc_server(app, f"0.0.0.0:{grpc_port}")
         grpc_server.start()
+        jaeger_agent = None
+        if runtime.get("jaeger_agent_port"):
+            from tempo_tpu.api.jaeger import JaegerAgentUDP
+            jaeger_agent = JaegerAgentUDP(app.push,
+                                          port=runtime["jaeger_agent_port"])
         log.info("tempo-tpu up: http=:%d grpc=:%d ingesters=%d rf=%d",
                  http_port, grpc_port, cfg.n_ingesters,
                  cfg.replication_factor)
         stop.wait()
         grpc_server.stop(grace=5)
         http_server.shutdown()
+        if jaeger_agent is not None:
+            jaeger_agent.close()
         app.shutdown()  # flush everything (reference /shutdown drain)
         log.info("shutdown complete")
         return 0
@@ -87,11 +94,22 @@ def main(argv=None) -> int:
     api = HTTPApi(proc, multitenancy=runtime["multitenancy"])
     http_server = serve_http(api, port=http_port)
     threading.Thread(target=http_server.serve_forever, daemon=True).start()
+    jaeger_agent = None
+    if runtime.get("jaeger_agent_port"):
+        if args.target == "distributor":
+            from tempo_tpu.api.jaeger import JaegerAgentUDP
+            jaeger_agent = JaegerAgentUDP(proc.push,
+                                          port=runtime["jaeger_agent_port"])
+        else:
+            log.warning("jaeger_agent_port is only served by the "
+                        "distributor target (ignored for %s)", args.target)
     log.info("tempo-tpu %s up: id=%s http=:%d grpc=%s gossip=%s",
              args.target, instance_id, http_port, proc.grpc_addr or "-",
              proc.ml.gossip_addr)
     stop.wait()
     http_server.shutdown()
+    if jaeger_agent is not None:
+        jaeger_agent.close()
     proc.shutdown()
     log.info("shutdown complete")
     return 0

@@ -203,6 +203,25 @@ class ModuleProcess:
             )
             self.grpc_server.start()
 
+        # self-tracing: in-process self-ingest only works where a
+        # distributor lives; other targets must export OTLP to a
+        # collector (usually the distributor's /v1/traces)
+        from tempo_tpu.observability import tracing
+        tr_cfg = dict(cfg.self_tracing or {})
+        tr_push = self.push if self.distributor is not None else None
+        wants_self = (tr_cfg.get("exporter",
+                                 "self" if tr_push else "otlp") == "self")
+        if tr_cfg.get("enabled") and wants_self and tr_push is None:
+            if tr_cfg.get("endpoint"):
+                tr_cfg["exporter"] = "otlp"
+            else:
+                self.log.warning(
+                    "self_tracing: target %s has no in-process push; set "
+                    "exporter: otlp and an endpoint — tracing disabled",
+                    target)
+                tr_cfg = {}
+        self.tracer = tracing.init_tracing(tr_cfg, push=tr_push)
+
         self._threads: list[threading.Thread] = []
         self._start_loops()
 
@@ -251,6 +270,11 @@ class ModuleProcess:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.tracer is not None:
+            from tempo_tpu.observability import tracing
+            self.tracer.shutdown()
+            if tracing.get_tracer() is self.tracer:
+                tracing.set_tracer(None)
         if self.ingester is not None:
             self.ingester.flush_all()
         self.ml.leave()

@@ -551,7 +551,8 @@ def extract_traceparent(headers) -> SpanContext | None:
     if not v:
         return None
     parts = v.strip().split("-")
-    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+    if (len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16
+            or len(parts[3]) != 2):
         return None
     try:
         trace_id = bytes.fromhex(parts[1])
@@ -559,7 +560,8 @@ def extract_traceparent(headers) -> SpanContext | None:
         sampled = bool(int(parts[3], 16) & 1)
     except ValueError:
         return None
-    if trace_id == b"\x00" * 16:
+    # W3C: all-zero trace-id or parent-id is invalid
+    if trace_id == b"\x00" * 16 or span_id == b"\x00" * 8:
         return None
     return SpanContext(trace_id, span_id, sampled)
 

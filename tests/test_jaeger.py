@@ -1,0 +1,316 @@
+"""Jaeger ingest (thrift UDP agent + collector HTTP) and the Jaeger-UI
+query bridge (cmd/tempo-query role).
+
+Fixtures are fabricated with the same thrift codecs' encoders — i.e. the
+bytes a real jaeger client library would emit (TBinaryProtocol collector
+bodies, TCompactProtocol emitBatch datagrams).
+"""
+
+import socket
+import time
+
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.api import thriftproto as tp
+from tempo_tpu.api.jaeger import (
+    JaegerAgentUDP, batch_to_resource_spans, decode_agent_datagram,
+    jaeger_thrift_http_to_batches,
+)
+from tempo_tpu.api.jaeger_query import JaegerQueryBridge, trace_to_jaeger
+from tempo_tpu.api.params import _duration_ms
+from tempo_tpu.api.http import HTTPApi
+from tempo_tpu.modules import App, AppConfig
+
+
+# ---------------------------------------------------------- thrift codec
+
+STRUCT_CASES = [
+    [(1, tp.T_I64, -42), (2, tp.T_I32, 7), (3, tp.T_STRING, "héllo")],
+    [(1, tp.T_BOOL, True), (2, tp.T_BOOL, False), (3, tp.T_DOUBLE, 2.5)],
+    [(1, tp.T_LIST, (tp.T_I64, [1, -2, 3]))],
+    [(5, tp.T_STRUCT, [(1, tp.T_STRING, b"\x00\xff bin")]),
+     (200, tp.T_I16, -300)],  # forces full-id encoding in compact
+    [(1, tp.T_LIST, (tp.T_STRUCT, [[(1, tp.T_STRING, f"s{i}")]
+                                   for i in range(20)]))],  # long list
+]
+
+
+@pytest.mark.parametrize("proto_name", ["binary", "compact"])
+@pytest.mark.parametrize("fields", STRUCT_CASES)
+def test_thrift_struct_roundtrip(proto_name, fields):
+    proto = (tp.BinaryProtocol() if proto_name == "binary"
+             else tp.CompactProtocol())
+    data = proto.encode_struct(fields)
+    got = tp.decode_struct(data, proto_name)
+
+    def norm(ftype, v):
+        if ftype == tp.T_STRING:
+            return v.encode() if isinstance(v, str) else bytes(v)
+        if ftype == tp.T_STRUCT:
+            return {fid: norm(ft, vv) for fid, ft, vv in v}
+        if ftype == tp.T_LIST:
+            et, items = v
+            return [norm(et, it) for it in items]
+        return v
+
+    for fid, ftype, v in fields:
+        assert got[fid] == norm(ftype, v), (proto_name, fid)
+
+
+@pytest.mark.parametrize("proto_name", ["binary", "compact"])
+def test_thrift_message_roundtrip(proto_name):
+    proto = (tp.BinaryProtocol() if proto_name == "binary"
+             else tp.CompactProtocol())
+    msg = proto.encode_message("emitBatch", tp.MSG_ONEWAY, 9,
+                               [(1, tp.T_STRING, "payload")])
+    name, mtype, seqid, args = tp.decode_message(msg)
+    assert (name, mtype, seqid) == ("emitBatch", tp.MSG_ONEWAY, 9)
+    assert args[1] == b"payload"
+
+
+def test_thrift_truncated_and_garbage():
+    proto = tp.BinaryProtocol()
+    data = proto.encode_struct([(1, tp.T_STRING, "x" * 100)])
+    with pytest.raises(tp.ThriftError):
+        tp.decode_struct(data[:10], "binary")
+    with pytest.raises(tp.ThriftError):
+        tp.decode_message(b"\x55\x55\x55")
+    with pytest.raises(tp.ThriftError):
+        tp.decode_message(b"")
+
+
+# ----------------------------------------------------- jaeger model
+
+
+def make_jaeger_batch(proto, service="shop", n_spans=2,
+                      trace_low=0x1234, trace_high=0x5678):
+    """Encode a jaeger Batch struct with the given protocol's encoder."""
+    spans = []
+    for i in range(n_spans):
+        tags = [
+            [(1, tp.T_STRING, "http.status_code"), (2, tp.T_I32, 8),
+             (6, tp.T_I64, 200 + i)],
+            [(1, tp.T_STRING, "span.kind"), (2, tp.T_I32, 0),
+             (3, tp.T_STRING, "server")],
+            [(1, tp.T_STRING, "error"), (2, tp.T_I32, 2),
+             (5, tp.T_BOOL, i == 1)],
+        ]
+        logs = [[(1, tp.T_I64, 1_700_000_001_000_000),
+                 (2, tp.T_LIST, (tp.T_STRUCT, [
+                     [(1, tp.T_STRING, "event"), (3, tp.T_STRING, "retry")],
+                     [(1, tp.T_STRING, "attempt"), (6, tp.T_I64, 3)],
+                 ]))]]
+        refs = [[(1, tp.T_I32, 0), (2, tp.T_I64, trace_low),
+                 (3, tp.T_I64, trace_high), (4, tp.T_I64, 99)]]
+        spans.append([
+            (1, tp.T_I64, trace_low), (2, tp.T_I64, trace_high),
+            (3, tp.T_I64, 1000 + i), (4, tp.T_I64, 0),
+            (5, tp.T_STRING, f"op-{i}"), (6, tp.T_LIST, (tp.T_STRUCT, refs)),
+            (7, tp.T_I32, 1), (8, tp.T_I64, 1_700_000_000_000_000),
+            (9, tp.T_I64, 250_000), (10, tp.T_LIST, (tp.T_STRUCT, tags)),
+            (11, tp.T_LIST, (tp.T_STRUCT, logs)),
+        ])
+    batch = [
+        (1, tp.T_STRUCT, [(1, tp.T_STRING, service),
+                          (2, tp.T_LIST, (tp.T_STRUCT, [
+                              [(1, tp.T_STRING, "hostname"),
+                               (3, tp.T_STRING, "pod-1")]]))]),
+        (2, tp.T_LIST, (tp.T_STRUCT, spans)),
+    ]
+    return batch
+
+
+def test_batch_translation_semantics():
+    proto = tp.BinaryProtocol()
+    body = proto.encode_struct(make_jaeger_batch(proto))
+    (rs,) = jaeger_thrift_http_to_batches(body)
+    res = {kv.key: kv.value for kv in rs.resource.attributes}
+    assert res["service.name"].string_value == "shop"
+    assert res["hostname"].string_value == "pod-1"
+    spans = rs.scope_spans[0].spans
+    assert len(spans) == 2
+    s0 = spans[0]
+    assert s0.trace_id == (0x5678).to_bytes(8, "big") + (0x1234).to_bytes(8, "big")
+    assert s0.span_id == (1000).to_bytes(8, "big")
+    assert s0.name == "op-0"
+    assert s0.kind == tempopb.Span.SPAN_KIND_SERVER
+    assert s0.start_time_unix_nano == 1_700_000_000_000_000_000
+    assert s0.end_time_unix_nano - s0.start_time_unix_nano == 250_000_000
+    # CHILD_OF ref became the parent
+    assert s0.parent_span_id == (99).to_bytes(8, "big")
+    attrs = {kv.key: kv.value for kv in s0.attributes}
+    assert attrs["http.status_code"].int_value == 200
+    assert "span.kind" not in attrs  # consumed into Span.kind
+    # error tag → status on span 1 only
+    assert spans[1].status.code == 2 and s0.status.code != 2
+    # logs → events
+    assert s0.events[0].name == "retry"
+    ev_attrs = {kv.key: kv.value.int_value for kv in s0.events[0].attributes}
+    assert ev_attrs["attempt"] == 3
+
+
+@pytest.mark.parametrize("proto_name", ["binary", "compact"])
+def test_agent_datagram_decode(proto_name):
+    proto = (tp.BinaryProtocol() if proto_name == "binary"
+             else tp.CompactProtocol())
+    dgram = proto.encode_message(
+        "emitBatch", tp.MSG_ONEWAY, 1,
+        [(1, tp.T_STRUCT, make_jaeger_batch(proto, service="udp-svc"))])
+    (rs,) = decode_agent_datagram(dgram)
+    assert rs.resource.attributes[0].value.string_value == "udp-svc"
+    assert len(rs.scope_spans[0].spans) == 2
+
+
+def test_agent_rejects_wrong_rpc():
+    proto = tp.CompactProtocol()
+    dgram = proto.encode_message("somethingElse", tp.MSG_ONEWAY, 1,
+                                 [(1, tp.T_I32, 5)])
+    with pytest.raises(ValueError):
+        decode_agent_datagram(dgram)
+
+
+# ------------------------------------------------ end-to-end through App
+
+
+@pytest.fixture
+def app(tmp_path):
+    a = App(AppConfig(wal_dir=str(tmp_path / "wal")))
+    yield a
+
+
+def test_collector_http_ingest_to_query(app):
+    api = HTTPApi(app)
+    proto = tp.BinaryProtocol()
+    body = proto.encode_struct(make_jaeger_batch(proto))
+    code, resp = api.handle("POST", "/api/traces", {},
+                            {"X-Scope-OrgID": "t1"}, body)
+    assert code == 200, resp
+    assert resp["accepted_batches"] == 1
+    # readable back through trace-by-id
+    tid = ((0x5678).to_bytes(8, "big") + (0x1234).to_bytes(8, "big")).hex()
+    code, resp = api.handle("GET", f"/api/traces/{tid}", {},
+                            {"X-Scope-OrgID": "t1"})
+    assert code == 200
+
+    # malformed body → 400, not 500
+    code, _ = api.handle("POST", "/api/traces", {},
+                         {"X-Scope-OrgID": "t1"}, b"\x99garbage")
+    assert code == 400
+
+
+def test_udp_agent_end_to_end(app):
+    agent = JaegerAgentUDP(app.push, host="127.0.0.1", port=0, tenant="t1")
+    try:
+        proto = tp.CompactProtocol()
+        dgram = proto.encode_message(
+            "emitBatch", tp.MSG_ONEWAY, 1,
+            [(1, tp.T_STRUCT, make_jaeger_batch(proto))])
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.sendto(dgram, ("127.0.0.1", agent.port))
+        sock.sendto(b"junk-datagram", ("127.0.0.1", agent.port))  # ignored
+        deadline = time.monotonic() + 5
+        while agent.accepted < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert agent.accepted == 1
+        deadline = time.monotonic() + 5
+        while agent.rejected < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert agent.rejected == 1
+        tid = (0x5678).to_bytes(8, "big") + (0x1234).to_bytes(8, "big")
+        resp = app.find_trace("t1", tid)
+        assert len(resp.trace.batches) == 1
+    finally:
+        agent.close()
+
+
+# -------------------------------------------------------- query bridge
+
+
+def test_trace_to_jaeger_translation():
+    proto = tp.BinaryProtocol()
+    (rs,) = jaeger_thrift_http_to_batches(
+        proto.encode_struct(make_jaeger_batch(proto)))
+    t = tempopb.Trace()
+    t.batches.append(rs)
+    j = trace_to_jaeger(t)
+    assert j["traceID"] == ((0x5678).to_bytes(8, "big")
+                            + (0x1234).to_bytes(8, "big")).hex()
+    assert len(j["spans"]) == 2
+    (pid,) = {s["processID"] for s in j["spans"]}
+    assert j["processes"][pid]["serviceName"] == "shop"
+    s0 = next(s for s in j["spans"] if s["operationName"] == "op-0")
+    assert s0["duration"] == 250_000  # µs
+    assert {"key": "span.kind", "type": "string",
+            "value": "server"} in s0["tags"]
+    assert s0["references"][0]["refType"] == "CHILD_OF"
+    assert s0["logs"][0]["fields"][0]["value"] == "retry"
+
+
+def test_jaeger_query_api_end_to_end(app):
+    api = HTTPApi(app)
+    proto = tp.BinaryProtocol()
+    api.handle("POST", "/api/traces", {}, {"X-Scope-OrgID": "t1"},
+               proto.encode_struct(make_jaeger_batch(proto)))
+    app.flush_tick(force=True)
+    app.poll_tick()
+
+    code, resp = api.handle("GET", "/jaeger/api/services", {},
+                            {"X-Scope-OrgID": "t1"})
+    assert code == 200 and resp["data"] == ["shop"]
+
+    code, resp = api.handle("GET", "/jaeger/api/traces",
+                            {"service": "shop", "limit": "5"},
+                            {"X-Scope-OrgID": "t1"})
+    assert code == 200 and len(resp["data"]) == 1
+    assert resp["data"][0]["processes"]["p1"]["serviceName"] == "shop"
+
+    tid = ((0x5678).to_bytes(8, "big") + (0x1234).to_bytes(8, "big")).hex()
+    code, resp = api.handle("GET", f"/jaeger/api/traces/{tid}", {},
+                            {"X-Scope-OrgID": "t1"})
+    assert code == 200 and resp["data"][0]["traceID"] == tid
+
+    code, resp = api.handle("GET", "/jaeger/api/traces/deadbeef00000000", {},
+                            {"X-Scope-OrgID": "t1"})
+    assert code == 404
+
+
+@pytest.mark.parametrize("s,ms", [
+    ("100ms", 100), ("1.5s", 1500), ("250us", 0), ("2m", 120000),
+    ("0.5h", 1800000), ("42", 42),
+])
+def test_parse_duration(s, ms):
+    assert _duration_ms(s) == ms
+
+
+def test_thrift_negative_name_length_rejected():
+    """A crafted negative string length must fail cleanly, not rewind the
+    parser position."""
+    import struct
+
+    bp = tp.BinaryProtocol()
+    evil = struct.pack(">I", bp.VERSION_1 | tp.MSG_ONEWAY) + struct.pack(">i", -8)
+    with pytest.raises(tp.ThriftError):
+        tp.decode_message(evil + b"\x00" * 16)
+
+
+def test_operations_filtered_by_service(app):
+    api = HTTPApi(app)
+    proto = tp.BinaryProtocol()
+    api.handle("POST", "/api/traces", {}, {"X-Scope-OrgID": "t1"},
+               proto.encode_struct(make_jaeger_batch(proto, service="svc-a",
+                                                     trace_low=1)))
+    api.handle("POST", "/api/traces", {}, {"X-Scope-OrgID": "t1"},
+               proto.encode_struct(make_jaeger_batch(proto, service="svc-b",
+                                                     trace_low=2)))
+    app.flush_tick(force=True)
+    app.poll_tick()
+    code, resp = api.handle("GET", "/jaeger/api/services/svc-a/operations",
+                            {}, {"X-Scope-OrgID": "t1"})
+    assert code == 200
+    # svc-b's identically-named root op is NOT attributed to svc-a — the
+    # list comes only from svc-a's traces
+    code_b, resp_b = api.handle("GET", "/jaeger/api/services/zzz/operations",
+                                {}, {"X-Scope-OrgID": "t1"})
+    assert resp_b["data"] == []
+    assert resp["data"]

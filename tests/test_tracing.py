@@ -158,6 +158,8 @@ def test_traceparent_roundtrip():
 @pytest.mark.parametrize("header", [
     "", "garbage", "00-short-aaaa-01",
     "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # all-zero parent id
+    "00-" + "1" * 32 + "-" + "1" * 16 + "-1",   # short flags
     "00-zz" + "0" * 30 + "-" + "1" * 16 + "-01",  # non-hex
 ])
 def test_traceparent_rejects_malformed(header):
