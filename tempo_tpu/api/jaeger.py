@@ -46,7 +46,9 @@ REF_CHILD_OF = 0
 
 
 def _i64_bytes(v: int) -> bytes:
-    return struct.pack(">q", v or 0)
+    # varints can decode to values outside i64 range; 8-byte-truncate
+    # rather than let struct.error escape the receiver's decode guards
+    return struct.pack(">Q", (v or 0) & 0xFFFFFFFFFFFFFFFF)
 
 
 def _trace_id(low: int, high: int) -> bytes:
@@ -183,7 +185,9 @@ class JaegerAgentUDP:
                 return
             try:
                 batches = decode_agent_datagram(data)
-            except (ValueError, tp.ThriftError) as e:
+            except Exception as e:  # noqa: BLE001 — a bad datagram must
+                # never kill the receiver thread (decode guards cover the
+                # known shapes; anything else is still just one datagram)
                 self.rejected += 1
                 self._log.warning("jaeger agent: dropped datagram: %s", e)
                 continue

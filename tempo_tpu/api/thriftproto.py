@@ -41,10 +41,26 @@ class ThriftError(ValueError):
     pass
 
 
+# Bound on struct/container nesting: jaeger.thrift nests 4 deep; a
+# crafted payload of 1-byte struct headers must exhaust this cap (clean
+# ThriftError) rather than the Python recursion limit (RecursionError
+# escaping the malformed-payload handling).
+MAX_DEPTH = 64
+
+
 class _Reader:
     def __init__(self, data: bytes):
         self.data = data
         self.pos = 0
+        self.depth = 0
+
+    def descend(self) -> None:
+        self.depth += 1
+        if self.depth > MAX_DEPTH:
+            raise ThriftError("thrift nesting too deep")
+
+    def ascend(self) -> None:
+        self.depth -= 1
 
     def take(self, n: int) -> bytes:
         if n < 0 or self.pos + n > len(self.data):
@@ -69,10 +85,12 @@ class BinaryProtocol:
     # -- decode --
 
     def read_struct(self, r: _Reader) -> dict:
+        r.descend()
         out = {}
         while True:
             ftype = r.u8()
             if ftype == T_STOP:
+                r.ascend()
                 return out
             (fid,) = struct.unpack(">h", r.take(2))
             out[fid] = self.read_value(r, ftype)
@@ -98,16 +116,24 @@ class BinaryProtocol:
         if ftype == T_STRUCT:
             return self.read_struct(r)
         if ftype in (T_LIST, T_SET):
+            r.descend()
             etype = r.u8()
             (n,) = struct.unpack(">i", r.take(4))
             if n < 0:
                 raise ThriftError("negative list size")
-            return [self.read_value(r, etype) for _ in range(n)]
+            out = [self.read_value(r, etype) for _ in range(n)]
+            r.ascend()
+            return out
         if ftype == T_MAP:
+            r.descend()
             ktype, vtype = r.u8(), r.u8()
             (n,) = struct.unpack(">i", r.take(4))
-            return {self.read_value(r, ktype): self.read_value(r, vtype)
-                    for _ in range(n)}
+            if n < 0:
+                raise ThriftError("negative map size")
+            out = {self.read_value(r, ktype): self.read_value(r, vtype)
+                   for _ in range(n)}
+            r.ascend()
+            return out
         raise ThriftError(f"unsupported thrift type {ftype}")
 
     def read_message(self, r: _Reader) -> tuple[str, int, int]:
@@ -234,11 +260,13 @@ class CompactProtocol:
     # -- decode --
 
     def read_struct(self, r: _Reader) -> dict:
+        r.descend()
         out = {}
         last_fid = 0
         while True:
             head = r.u8()
             if head == T_STOP:
+                r.ascend()
                 return out
             delta = (head >> 4) & 0x0F
             ctype = head & 0x0F
@@ -265,22 +293,30 @@ class CompactProtocol:
         if ctype == CT_STRUCT:
             return self.read_struct(r)
         if ctype in (CT_LIST, CT_SET):
+            r.descend()
             head = r.u8()
             size = (head >> 4) & 0x0F
             etype = head & 0x0F
             if size == 15:
                 size = _read_varint(r)
             if etype in (CT_BOOL_TRUE, CT_BOOL_FALSE):
-                return [r.u8() == CT_BOOL_TRUE for _ in range(size)]
-            return [self.read_value(r, etype) for _ in range(size)]
+                out = [r.u8() == CT_BOOL_TRUE for _ in range(size)]
+            else:
+                out = [self.read_value(r, etype) for _ in range(size)]
+            r.ascend()
+            return out
         if ctype == CT_MAP:
+            r.descend()
             size = _read_varint(r)
             if size == 0:
+                r.ascend()
                 return {}
             kv = r.u8()
             ktype, vtype = (kv >> 4) & 0x0F, kv & 0x0F
-            return {self.read_value(r, ktype): self.read_value(r, vtype)
-                    for _ in range(size)}
+            out = {self.read_value(r, ktype): self.read_value(r, vtype)
+                   for _ in range(size)}
+            r.ascend()
+            return out
         raise ThriftError(f"unsupported compact type {ctype}")
 
     def read_message(self, r: _Reader) -> tuple[str, int, int]:

@@ -46,6 +46,9 @@ class AppConfig:
     # self_tracing: {enabled, exporter: self|otlp, endpoint, tenant,
     # sample_ratio} — the framework traces itself (observability/tracing)
     self_tracing: dict = field(default_factory=dict)
+    # metrics_generator: {remote_write: {url, headers, interval_s,
+    # external_labels}, spool_dir} — prometheus remote-write shipping
+    metrics_generator: dict = field(default_factory=dict)
 
 
 class App:
@@ -75,6 +78,19 @@ class App:
         self.reader_db = TempoDB(self.backend, f"{self.cfg.wal_dir}/querier",
                                  self.cfg.db)
         self.generator = MetricsGenerator()
+        self.remote_write = None
+        gen_cfg = self.cfg.metrics_generator or {}
+        rw = gen_cfg.get("remote_write") or {}
+        if rw.get("url"):
+            from .remote_write import RemoteWriteShipper
+            self.remote_write = RemoteWriteShipper(
+                self.generator, rw["url"],
+                spool_dir=gen_cfg.get("spool_dir",
+                                      f"{self.cfg.wal_dir}/remote-write"),
+                interval_s=float(rw.get("interval_s", 15.0)),
+                external_labels=rw.get("external_labels", {}),
+                headers=rw.get("headers", {}),
+            )
         self.distributor = Distributor(self.ring, self.ingesters, self.overrides,
                                        forwarder=self.generator.push_spans,
                                        write_quorum=self.cfg.write_quorum)
@@ -142,6 +158,8 @@ class App:
         loop(self.cfg.poll_tick_s, self.poll_tick)
         loop(self.cfg.compaction_tick_s, self.compaction_tick)
         loop(5.0, self.heartbeat_tick)
+        if self.remote_write is not None:
+            self.remote_write.start()
 
     def shutdown(self) -> None:
         """Graceful: flush everything, stop loops (reference /shutdown)."""
@@ -153,6 +171,8 @@ class App:
                 tracing.set_tracer(None)
         for ing in self.ingesters.values():
             ing.flush_all()
+        if self.remote_write is not None:
+            self.remote_write.stop(final_ship=True)
         self.poll_tick()
 
     def ready(self) -> bool:

@@ -179,6 +179,13 @@ class MetricsGenerator:
             for p in procs:
                 p.consume(batch)
 
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return list(self._tenants)
+
+    def registry(self, tenant: str):
+        return self._instance(tenant)[0]
+
     def collect(self, tenant: str) -> str:
         """Exposition-format samples for a tenant (the remote-write drain
         point)."""

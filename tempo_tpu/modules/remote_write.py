@@ -1,0 +1,233 @@
+"""Prometheus remote-write shipper for the metrics-generator.
+
+Role-equivalent to the reference's generator storage
+(modules/generator/storage/instance.go:22-70): the reference runs a
+Prometheus agent-mode TSDB whose WAL buffers samples until remote-write
+succeeds. Here the same durability contract is kept with a simpler
+mechanism suited to the collection-tick model: each tick snapshots the
+per-tenant registry into a WriteRequest (prompb wire format, snappy
+block compression via the native runtime), POSTs it, and on failure
+spools the encoded+compressed payload to disk; spooled payloads are
+re-shipped oldest-first with exponential backoff before new data, and
+survive process restarts (the WAL role).
+
+Wire contract (any Prometheus/Mimir/Thanos receiver):
+  POST <url>  Content-Encoding: snappy
+              Content-Type: application/x-protobuf
+              X-Prometheus-Remote-Write-Version: 0.1.0
+              X-Scope-OrgID: <tenant>   (multi-tenant receivers)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import urllib.request
+
+from tempo_tpu.observability.log import get_logger
+from tempo_tpu.tempopb import remote_write_pb2 as prompb
+
+
+def encode_write_request(samples: list, timestamp_ms: int,
+                         extra_labels: dict | None = None) -> bytes:
+    """[(name, ((label, value), ...), float)] → serialized WriteRequest.
+    Series are emitted sorted by (name, labels) — receivers require
+    stable label ordering inside a series, and prometheus requires
+    __name__ first."""
+    req = prompb.WriteRequest()
+    for name, labels, value in sorted(samples, key=lambda s: (s[0], s[1])):
+        ts = req.timeseries.add()
+        ts.labels.add(name="__name__", value=name)
+        merged = dict(labels)
+        merged.update(extra_labels or {})
+        for k, v in sorted(merged.items()):
+            ts.labels.add(name=k, value=str(v))
+        ts.samples.add(value=float(value), timestamp=timestamp_ms)
+    return req.SerializeToString()
+
+
+class RemoteWriteClient:
+    """One POST = one WriteRequest. Raises urllib errors on failure."""
+
+    def __init__(self, url: str, tenant: str | None = None,
+                 headers: dict | None = None, timeout_s: float = 10.0):
+        self.url = url
+        self.tenant = tenant
+        self.headers = dict(headers or {})
+        self.timeout_s = timeout_s
+
+    def send(self, payload: bytes) -> None:
+        """payload = already-snappy-compressed WriteRequest bytes."""
+        req = urllib.request.Request(self.url, data=payload, method="POST")
+        req.add_header("Content-Encoding", "snappy")
+        req.add_header("Content-Type", "application/x-protobuf")
+        req.add_header("X-Prometheus-Remote-Write-Version", "0.1.0")
+        if self.tenant:
+            req.add_header("X-Scope-OrgID", self.tenant)
+        for k, v in self.headers.items():
+            req.add_header(k, v)
+        # urlopen raises HTTPError for >=400 and follows redirects itself
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            resp.read()
+
+
+class RemoteWriteShipper:
+    """Ships a MetricsGenerator's registries; spools on failure.
+
+    Drive with tick() (the generator collection ticker) or start()/stop()
+    for a background loop.
+    """
+
+    def __init__(self, generator, url: str, spool_dir: str,
+                 interval_s: float = 15.0, external_labels: dict | None = None,
+                 headers: dict | None = None, timeout_s: float = 10.0,
+                 max_spool_bytes: int = 64 << 20,
+                 backoff_min_s: float = 1.0, backoff_max_s: float = 120.0):
+        self.generator = generator
+        self.url = url
+        self.spool_dir = spool_dir
+        self.interval_s = interval_s
+        self.external_labels = dict(external_labels or {})
+        self.headers = dict(headers or {})
+        self.timeout_s = timeout_s
+        self.max_spool_bytes = max_spool_bytes
+        self.backoff_min_s = backoff_min_s
+        self.backoff_max_s = backoff_max_s
+        self._backoff_s = 0.0
+        self._next_retry = 0.0
+        self._seq = 0
+        self.sent = 0
+        self.failed = 0
+        self.spooled = 0
+        self.dropped_spool = 0
+        self._log = get_logger("tempo_tpu.remote_write")
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        os.makedirs(spool_dir, exist_ok=True)
+
+    # ---- spool (the WAL role) ----
+
+    def _spool_files(self) -> list[str]:
+        try:
+            names = [n for n in os.listdir(self.spool_dir)
+                     if n.endswith(".rw")]
+        except FileNotFoundError:
+            return []
+        return sorted(names)
+
+    def _spool_usage(self) -> int:
+        return sum(os.path.getsize(os.path.join(self.spool_dir, n))
+                   for n in self._spool_files())
+
+    def _spool(self, tenant: str, payload: bytes) -> None:
+        if self._spool_usage() + len(payload) > self.max_spool_bytes:
+            # drop OLDEST first: newest samples matter most for alerting
+            for n in self._spool_files():
+                if self._spool_usage() + len(payload) <= self.max_spool_bytes:
+                    break
+                os.unlink(os.path.join(self.spool_dir, n))
+                self.dropped_spool += 1
+        self._seq += 1
+        name = f"{time.time_ns():020d}-{self._seq:06d}-{tenant}.rw"
+        path = os.path.join(self.spool_dir, name)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+        self.spooled += 1
+
+    @staticmethod
+    def _tenant_of(name: str) -> str:
+        return name[:-3].split("-", 2)[2]
+
+    # ---- shipping ----
+
+    def _compress(self, data: bytes) -> bytes:
+        from tempo_tpu.ops import native
+
+        return native.snappy_compress(data)
+
+    def _send(self, tenant: str, payload: bytes) -> bool:
+        client = RemoteWriteClient(self.url, tenant=tenant,
+                                   headers=self.headers,
+                                   timeout_s=self.timeout_s)
+        try:
+            client.send(payload)
+            self.sent += 1
+            self._backoff_s = 0.0
+            return True
+        except Exception as e:  # noqa: BLE001 — network errors expected
+            self.failed += 1
+            self._backoff_s = min(self.backoff_max_s,
+                                  (self._backoff_s * 2) or self.backoff_min_s)
+            self._next_retry = time.monotonic() + self._backoff_s
+            self._log.warning("remote write to %s failed (backoff %.0fs): %s",
+                              self.url, self._backoff_s, e)
+            return False
+
+    def _drain_spool(self) -> bool:
+        """Ship spooled payloads oldest-first. Returns False on failure
+        (stop trying this tick)."""
+        for name in self._spool_files():
+            path = os.path.join(self.spool_dir, name)
+            with open(path, "rb") as f:
+                payload = f.read()
+            if not self._send(self._tenant_of(name), payload):
+                return False
+            os.unlink(path)
+        return True
+
+    def tick(self, now_ms: int | None = None) -> None:
+        """One collection cycle: snapshot registries → ship (spool first,
+        then fresh samples)."""
+        with self._lock:
+            if time.monotonic() < self._next_retry:
+                # in backoff: snapshot to spool, don't hit the receiver
+                self._snapshot_to_spool(now_ms)
+                return
+            healthy = self._drain_spool()
+            now_ms = now_ms or time.time_ns() // 1_000_000
+            for tenant, payload in self._snapshots(now_ms):
+                if healthy and self._send(tenant, payload):
+                    continue
+                healthy = False
+                self._spool(tenant, payload)
+
+    def _snapshots(self, now_ms: int):
+        for tenant in self.generator.tenants():
+            samples = self.generator.registry(tenant).samples()
+            if not samples:
+                continue
+            raw = encode_write_request(samples, now_ms, self.external_labels)
+            yield tenant, self._compress(raw)
+
+    def _snapshot_to_spool(self, now_ms: int | None) -> None:
+        now_ms = now_ms or time.time_ns() // 1_000_000
+        for tenant, payload in self._snapshots(now_ms):
+            self._spool(tenant, payload)
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — keep shipping
+                    self._log.exception("remote-write tick")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="remote-write-shipper")
+        self._thread.start()
+
+    def stop(self, final_ship: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if final_ship:
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001
+                pass

@@ -36,6 +36,13 @@ class _Metric:
                              else f"{self.name} {val}")
         return "\n".join(lines)
 
+    def samples(self) -> list:
+        """[(metric_name, ((label, value), ...), float)] — the
+        remote-write drain format."""
+        with self._lock:
+            return [(self.name, key, val)
+                    for key, val in sorted(self._series.items())]
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -104,6 +111,22 @@ class Histogram(_Metric):
                 lines.append(f"{self.name}_count{suffix} {counts[-1]}")
         return "\n".join(lines)
 
+    def samples(self) -> list:
+        out = []
+        with self._lock:
+            for key, counts in sorted(self._counts.items()):
+                base = dict(key)
+                for i, b in enumerate(self.buckets):
+                    out.append((f"{self.name}_bucket",
+                                tuple(sorted({**base, "le": str(b)}.items())),
+                                counts[i]))
+                out.append((f"{self.name}_bucket",
+                            tuple(sorted({**base, "le": "+Inf"}.items())),
+                            counts[-1]))
+                out.append((f"{self.name}_sum", key, self._sums.get(key, 0)))
+                out.append((f"{self.name}_count", key, counts[-1]))
+        return out
+
 
 class _Timer:
     def __init__(self, hist, labels):
@@ -136,6 +159,14 @@ class Registry:
         with self._lock:
             metrics = list(self._metrics.values())
         return "\n".join(m.expose() for m in metrics) + "\n"
+
+    def samples(self) -> list:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = []
+        for m in metrics:
+            out.extend(m.samples())
+        return out
 
 
 REGISTRY = Registry()

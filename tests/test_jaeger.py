@@ -283,6 +283,61 @@ def test_parse_duration(s, ms):
     assert _duration_ms(s) == ms
 
 
+def test_thrift_nesting_depth_capped():
+    """Crafted deep nesting fails with ThriftError (not RecursionError),
+    and over HTTP it maps to 400."""
+    import struct
+
+    # binary: T_STRUCT header per level, 3 bytes each, depth 2000
+    deep_bin = (b"\x0c" + struct.pack(">h", 1)) * 2000
+    with pytest.raises(tp.ThriftError):
+        tp.decode_struct(deep_bin, "binary")
+    # compact: field header (delta 1, type struct) per level
+    deep_cpt = b"\x1c" * 2000
+    with pytest.raises(tp.ThriftError):
+        tp.decode_struct(deep_cpt, "compact")
+
+
+def test_http_deep_nesting_is_400(tmp_path):
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal")))
+    api = HTTPApi(app)
+    import struct as _s
+
+    code, _ = api.handle("POST", "/api/traces", {}, {"X-Scope-OrgID": "t"},
+                         (b"\x0c" + _s.pack(">h", 1)) * 2000)
+    assert code == 400
+
+
+def test_agent_survives_poison_datagrams(app):
+    """RecursionError/overflow-shaped datagrams must not kill the
+    receiver thread."""
+    cp = tp.CompactProtocol()
+    # huge varint that exceeds i64 in a trace-id position
+    evil_batch = [(2, tp.T_LIST, (tp.T_STRUCT, [[(1, tp.T_I64, 0)]]))]
+    msg = bytearray(cp.encode_message("emitBatch", tp.MSG_ONEWAY, 1,
+                                      [(1, tp.T_STRUCT, evil_batch)]))
+    agent = JaegerAgentUDP(app.push, host="127.0.0.1", port=0, tenant="t1")
+    try:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        deep = cp.encode_message("emitBatch", tp.MSG_ONEWAY, 1, [])[:-1] \
+            + b"\x1c" * 2000
+        sock.sendto(deep, ("127.0.0.1", agent.port))
+        deadline = time.monotonic() + 5
+        while agent.rejected < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert agent.rejected == 1
+        # thread still alive: a good datagram is accepted afterwards
+        good = cp.encode_message("emitBatch", tp.MSG_ONEWAY, 2,
+                                 [(1, tp.T_STRUCT, make_jaeger_batch(cp))])
+        sock.sendto(good, ("127.0.0.1", agent.port))
+        deadline = time.monotonic() + 5
+        while agent.accepted < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert agent.accepted == 1
+    finally:
+        agent.close()
+
+
 def test_thrift_negative_name_length_rejected():
     """A crafted negative string length must fail cleanly, not rewind the
     parser position."""
