@@ -193,4 +193,49 @@ unsigned long long tt_xxhash64(const char* data, size_t len,
   return h;
 }
 
+// CRC32C (Castagnoli), slice-by-8 — RecordBatch v2 integrity on the
+// kafka ingest path (pure-python table CRC is ~5 MB/s; this is ~1 GB/s).
+static uint32_t crc32c_tbl[8][256];
+
+// built at library load (single-threaded) — ctypes callers drop the GIL,
+// so lazy init here would be a data race
+static bool crc32c_tables_built = [] {
+  for (uint32_t n = 0; n < 256; n++) {
+    uint32_t c = n;
+    for (int k = 0; k < 8; k++) c = c & 1 ? 0x82f63b78u ^ (c >> 1) : c >> 1;
+    crc32c_tbl[0][n] = c;
+  }
+  for (uint32_t n = 0; n < 256; n++) {
+    uint32_t c = crc32c_tbl[0][n];
+    for (int s = 1; s < 8; s++) {
+      c = crc32c_tbl[0][c & 0xff] ^ (c >> 8);
+      crc32c_tbl[s][n] = c;
+    }
+  }
+  return true;
+}();
+
+unsigned int tt_crc32c(const char* data, size_t len, unsigned int crc) {
+  (void)crc32c_tables_built;
+  const unsigned char* p = (const unsigned char*)data;
+  uint32_t c = crc ^ 0xffffffffu;
+  while (len && ((uintptr_t)p & 7)) {
+    c = crc32c_tbl[0][(c ^ *p++) & 0xff] ^ (c >> 8);
+    len--;
+  }
+  while (len >= 8) {
+    uint64_t x;
+    memcpy(&x, p, 8);
+    x ^= c;
+    c = crc32c_tbl[7][x & 0xff] ^ crc32c_tbl[6][(x >> 8) & 0xff] ^
+        crc32c_tbl[5][(x >> 16) & 0xff] ^ crc32c_tbl[4][(x >> 24) & 0xff] ^
+        crc32c_tbl[3][(x >> 32) & 0xff] ^ crc32c_tbl[2][(x >> 40) & 0xff] ^
+        crc32c_tbl[1][(x >> 48) & 0xff] ^ crc32c_tbl[0][(x >> 56) & 0xff];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) c = crc32c_tbl[0][(c ^ *p++) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
 }  // extern "C"

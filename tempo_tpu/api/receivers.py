@@ -7,9 +7,14 @@ opencensus/kafka/pubsub-lite — shim.go:75-138). Implemented natively:
   - OTLP gRPC: api/grpc_service.py (wire-compatible Trace, zero shim)
   - OTLP HTTP: POST /v1/traces, protobuf body (this module)
   - Zipkin v2 JSON: POST /api/v2/spans (this module)
-  - Jaeger / Kafka / OpenCensus / pubsub-lite: carrier protocols that
-    need their client libs; the translate-and-push pattern below is the
-    extension point (gated in this zero-egress environment).
+  - Jaeger: thrift UDP agent + collector endpoint (api/jaeger.py)
+  - Kafka: from-scratch wire-protocol consumer (api/kafka.py)
+  - pubsub-lite [Shopify fork extra]: the Kafka consumer pointed at
+    Pub/Sub Lite's Kafka-compatible endpoint (api/kafka.py; TLS —
+    gated in this zero-egress environment)
+  - OpenCensus: gRPC TraceService with OC→OTLP translation — the one
+    remaining carrier; the translate-and-push pattern here is its
+    extension point.
 """
 
 from __future__ import annotations

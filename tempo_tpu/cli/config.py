@@ -92,6 +92,7 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         per_tenant_overrides=overrides.get("per_tenant", {}),
         self_tracing=doc.get("self_tracing", {}),
         metrics_generator=doc.get("metrics_generator", {}),
+        receivers=doc.get("distributor", {}).get("receivers", {}),
     )
     server = doc.get("server", {})
     runtime = {

@@ -49,6 +49,11 @@ class AppConfig:
     # metrics_generator: {remote_write: {url, headers, interval_s,
     # external_labels}, spool_dir} — prometheus remote-write shipping
     metrics_generator: dict = field(default_factory=dict)
+    # receivers: {kafka: {brokers, topic, group_id, encoding, ...},
+    # pubsub_lite: {topic, subscription, ...}} — pull-based ingest
+    # (push receivers — OTLP gRPC/HTTP, Zipkin, Jaeger — live on the
+    # server ports and need no config here)
+    receivers: dict = field(default_factory=dict)
 
 
 class App:
@@ -102,6 +107,7 @@ class App:
         self.frontend = QueryFrontend(self.queriers, self.cfg.frontend)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._receivers: list = []
         # self-tracing ("tempo traces tempo"): export into our own
         # distributor by default, or OTLP/HTTP out to a collector
         from tempo_tpu.observability import tracing
@@ -160,10 +166,33 @@ class App:
         loop(5.0, self.heartbeat_tick)
         if self.remote_write is not None:
             self.remote_write.start()
+        self.start_receivers()
+
+    def start_receivers(self) -> None:
+        """Pull-based ingest receivers (kafka / pubsub-lite)."""
+        if self._receivers:
+            return
+        kcfg = self.cfg.receivers.get("kafka")
+        if kcfg:
+            from tempo_tpu.api.kafka import KafkaReceiver, KafkaReceiverConfig
+
+            rx = KafkaReceiver(KafkaReceiverConfig(**kcfg), self.push)
+            rx.start()
+            self._receivers.append(rx)
+        pcfg = self.cfg.receivers.get("pubsub_lite")
+        if pcfg:
+            from tempo_tpu.api.kafka import pubsub_lite_receiver
+
+            rx = pubsub_lite_receiver(pcfg, self.push)
+            rx.start()
+            self._receivers.append(rx)
 
     def shutdown(self) -> None:
         """Graceful: flush everything, stop loops (reference /shutdown)."""
         self._stop.set()
+        for rx in self._receivers:
+            rx.stop()
+        self._receivers.clear()
         if self.tracer is not None:
             from tempo_tpu.observability import tracing
             self.tracer.shutdown()

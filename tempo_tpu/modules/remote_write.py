@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import urllib.parse
 import urllib.request
 
 from tempo_tpu.observability.log import get_logger
@@ -32,15 +33,17 @@ from tempo_tpu.tempopb import remote_write_pb2 as prompb
 def encode_write_request(samples: list, timestamp_ms: int,
                          extra_labels: dict | None = None) -> bytes:
     """[(name, ((label, value), ...), float)] → serialized WriteRequest.
-    Series are emitted sorted by (name, labels) — receivers require
-    stable label ordering inside a series, and prometheus requires
-    __name__ first."""
+    Receivers (Mimir/Thanos) reject out-of-order label sets, so the FULL
+    label set including __name__ is sorted lexicographically — a label
+    like "Env" legitimately sorts before "__name__"."""
     req = prompb.WriteRequest()
     for name, labels, value in sorted(samples, key=lambda s: (s[0], s[1])):
         ts = req.timeseries.add()
-        ts.labels.add(name="__name__", value=name)
-        merged = dict(labels)
-        merged.update(extra_labels or {})
+        # prometheus external-label semantics: the series label wins on
+        # collision, external labels only fill gaps
+        merged = dict(extra_labels or {})
+        merged.update(labels)
+        merged["__name__"] = name
         for k, v in sorted(merged.items()):
             ts.labels.add(name=k, value=str(v))
         ts.samples.add(value=float(value), timestamp=timestamp_ms)
@@ -97,6 +100,7 @@ class RemoteWriteShipper:
         self._backoff_s = 0.0
         self._next_retry = 0.0
         self._seq = 0
+        self._usage: int | None = None  # lazy-scanned, then maintained
         self.sent = 0
         self.failed = 0
         self.spooled = 0
@@ -106,6 +110,7 @@ class RemoteWriteShipper:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         os.makedirs(spool_dir, exist_ok=True)
+        self._sweep_tmp_orphans()
 
     # ---- spool (the WAL role) ----
 
@@ -118,29 +123,60 @@ class RemoteWriteShipper:
         return sorted(names)
 
     def _spool_usage(self) -> int:
-        return sum(os.path.getsize(os.path.join(self.spool_dir, n))
-                   for n in self._spool_files())
+        """Running counter (O(1) on the spool path); rescans only once
+        at first use after construction."""
+        if self._usage is None:
+            self._usage = sum(
+                os.path.getsize(os.path.join(self.spool_dir, n))
+                for n in self._spool_files()
+            )
+        return self._usage
 
     def _spool(self, tenant: str, payload: bytes) -> None:
-        if self._spool_usage() + len(payload) > self.max_spool_bytes:
+        usage = self._spool_usage()
+        if usage + len(payload) > self.max_spool_bytes:
             # drop OLDEST first: newest samples matter most for alerting
             for n in self._spool_files():
-                if self._spool_usage() + len(payload) <= self.max_spool_bytes:
+                if usage + len(payload) <= self.max_spool_bytes:
                     break
-                os.unlink(os.path.join(self.spool_dir, n))
+                p = os.path.join(self.spool_dir, n)
+                try:
+                    size = os.path.getsize(p)
+                    os.unlink(p)
+                except OSError:
+                    continue
+                usage -= size
+                self._usage = usage
                 self.dropped_spool += 1
         self._seq += 1
-        name = f"{time.time_ns():020d}-{self._seq:06d}-{tenant}.rw"
+        # tenant comes from the client-controlled X-Scope-OrgID header —
+        # percent-encode so it can't traverse paths, and round-trips
+        quoted = urllib.parse.quote(tenant, safe="")
+        name = f"{time.time_ns():020d}-{self._seq:06d}-{quoted}.rw"
         path = os.path.join(self.spool_dir, name)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(payload)
         os.replace(tmp, path)
+        self._usage = usage + len(payload)
         self.spooled += 1
 
     @staticmethod
     def _tenant_of(name: str) -> str:
-        return name[:-3].split("-", 2)[2]
+        return urllib.parse.unquote(name[:-3].split("-", 2)[2])
+
+    def _sweep_tmp_orphans(self) -> None:
+        """A crash between open(tmp) and os.replace leaves .tmp files no
+        drain pass will ever ship — clear them on startup."""
+        try:
+            for n in os.listdir(self.spool_dir):
+                if n.endswith(".tmp"):
+                    try:
+                        os.unlink(os.path.join(self.spool_dir, n))
+                    except OSError:
+                        pass
+        except FileNotFoundError:
+            pass
 
     # ---- shipping ----
 
@@ -177,6 +213,8 @@ class RemoteWriteShipper:
             if not self._send(self._tenant_of(name), payload):
                 return False
             os.unlink(path)
+            if self._usage is not None:
+                self._usage = max(0, self._usage - len(payload))
         return True
 
     def tick(self, now_ms: int | None = None) -> None:

@@ -82,6 +82,8 @@ def _bind(lib):
                                      ctypes.c_int]
     lib.tt_xxhash64.restype = ctypes.c_ulonglong
     lib.tt_xxhash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_ulonglong]
+    lib.tt_crc32c.restype = ctypes.c_uint
+    lib.tt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint]
     lib.tt_substr_scan.restype = ctypes.c_longlong
     lib.tt_substr_scan.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
@@ -152,6 +154,11 @@ def snappy_decompress(data: bytes) -> bytes:
 def xxhash64(data: bytes, seed: int = 0) -> int:
     lib = _load()
     return int(lib.tt_xxhash64(data, len(data), seed))
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    lib = _load()
+    return int(lib.tt_crc32c(data, len(data), crc))
 
 
 def substr_scan(packed: bytes, offsets, needle: bytes):

@@ -1,0 +1,359 @@
+"""In-process Kafka broker speaking the real wire protocol.
+
+The protocol-faithful fake for receiver tests (the role minio/azurite
+play for the object backends — SURVEY.md §4 "fixtures & fakes"). Serves
+ApiVersions/Metadata/ListOffsets/Fetch/Produce/FindCoordinator/
+OffsetCommit/OffsetFetch on a real TCP socket, stores produced
+RecordBatch v2 bytes verbatim (rewriting only baseOffset, which is not
+CRC-covered), so consumer-side CRC verification runs against bytes the
+broker never re-encoded.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+
+from tempo_tpu.api.kafka import (
+    API_API_VERSIONS,
+    API_FETCH,
+    API_FIND_COORDINATOR,
+    API_LIST_OFFSETS,
+    API_METADATA,
+    API_OFFSET_COMMIT,
+    API_OFFSET_FETCH,
+    API_PRODUCE,
+    API_SASL_AUTHENTICATE,
+    API_SASL_HANDSHAKE,
+    ERR_OFFSET_OUT_OF_RANGE,
+    Reader,
+    Writer,
+    decode_record_batches,
+)
+
+
+class _Log:
+    def __init__(self):
+        self.batches: list[tuple[int, int, bytes]] = []  # (base, last, bytes)
+        self.next_offset = 0
+        self.start_offset = 0  # advanced by truncate() (retention)
+
+
+class FakeKafkaBroker:
+    def __init__(
+        self,
+        n_partitions: int = 2,
+        topics: list[str] | None = None,
+        sasl: tuple[str, str] | None = None,
+    ):
+        self.n_partitions = n_partitions
+        self.topics = set(topics or [])
+        self.sasl = sasl  # (username, password) required when set
+        self.logs: dict[tuple[str, int], _Log] = {}
+        self.group_offsets: dict[tuple[str, str, int], int] = {}
+        self.lock = threading.Lock()
+        broker = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                state = {"authed": broker.sasl is None}
+                try:
+                    while True:
+                        hdr = self._recvn(4)
+                        if hdr is None:
+                            return
+                        (size,) = struct.unpack(">i", hdr)
+                        payload = self._recvn(size)
+                        if payload is None:
+                            return
+                        resp = broker.dispatch(payload, state)
+                        if resp is None:
+                            return  # unauthenticated: drop the connection
+                        self.request.sendall(struct.pack(">i", len(resp)) + resp)
+                except (ConnectionError, OSError):
+                    pass
+
+            def _recvn(self, n):
+                chunks = []
+                while n:
+                    c = self.request.recv(n)
+                    if not c:
+                        return None
+                    chunks.append(c)
+                    n -= len(c)
+                return b"".join(chunks)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Server(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def _log(self, topic: str, partition: int) -> _Log:
+        self.topics.add(topic)
+        return self.logs.setdefault((topic, partition), _Log())
+
+    # -- direct test helpers -------------------------------------------------
+
+    def append(self, topic: str, partition: int, batch: bytes) -> int:
+        """Store a produced batch; returns its base offset."""
+        recs = decode_record_batches(batch)
+        n = len(recs) or 1
+        with self.lock:
+            log = self._log(topic, partition)
+            base = log.next_offset
+            rebased = struct.pack(">q", base) + batch[8:]
+            log.batches.append((base, base + n - 1, rebased))
+            log.next_offset = base + n
+            return base
+
+    def truncate(self, topic: str, partition: int, new_start: int) -> None:
+        """Simulate retention: delete batches wholly below new_start."""
+        with self.lock:
+            log = self._log(topic, partition)
+            log.batches = [b for b in log.batches if b[1] >= new_start]
+            log.start_offset = max(log.start_offset, new_start)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def dispatch(self, payload: bytes, state: dict | None = None) -> bytes | None:
+        state = state if state is not None else {"authed": True}
+        r = Reader(payload)
+        api_key = r.i16()
+        api_version = r.i16()
+        corr = r.i32()
+        r.string()  # client id
+        w = Writer()
+        w.i32(corr)
+        if api_key == API_SASL_HANDSHAKE:
+            mech = r.string()
+            w.i16(0 if mech == "PLAIN" else 33)  # UNSUPPORTED_SASL_MECHANISM
+            w.i32(1)
+            w.string("PLAIN")
+            state["handshook"] = mech == "PLAIN"
+            return w.getvalue()
+        if api_key == API_SASL_AUTHENTICATE:
+            auth = r.bytes_() or b""
+            parts = auth.split(b"\x00")
+            ok = (
+                self.sasl is not None
+                and state.get("handshook")
+                and len(parts) == 3
+                and parts[1].decode() == self.sasl[0]
+                and parts[2].decode() == self.sasl[1]
+            )
+            w.i16(0 if ok else 58)  # SASL_AUTHENTICATION_FAILED
+            w.string(None if ok else "invalid credentials")
+            w.bytes_(b"")
+            state["authed"] = bool(ok)
+            return w.getvalue()
+        if not state.get("authed"):
+            return None  # real brokers kill unauthenticated connections
+        handler = {
+            API_API_VERSIONS: self._api_versions,
+            API_METADATA: self._metadata,
+            API_LIST_OFFSETS: self._list_offsets,
+            API_FETCH: self._fetch,
+            API_PRODUCE: self._produce,
+            API_FIND_COORDINATOR: self._find_coordinator,
+            API_OFFSET_COMMIT: self._offset_commit,
+            API_OFFSET_FETCH: self._offset_fetch,
+        }[api_key]
+        handler(r, w, api_version)
+        return w.getvalue()
+
+    def _api_versions(self, r, w, v):
+        keys = [
+            (API_PRODUCE, 0, 3), (API_FETCH, 0, 4), (API_LIST_OFFSETS, 0, 1),
+            (API_METADATA, 0, 1), (API_OFFSET_COMMIT, 0, 2), (API_OFFSET_FETCH, 0, 1),
+            (API_FIND_COORDINATOR, 0, 0), (API_API_VERSIONS, 0, 0),
+        ]
+        w.i16(0)
+        w.i32(len(keys))
+        for k, lo, hi in keys:
+            w.i16(k)
+            w.i16(lo)
+            w.i16(hi)
+
+    def _metadata(self, r, w, v):
+        n = r.i32()
+        topics = [r.string() for _ in range(n)] if n >= 0 else sorted(self.topics)
+        if n == 0:
+            topics = sorted(self.topics)
+        w.i32(1)  # brokers
+        w.i32(0)  # node id
+        w.string("127.0.0.1")
+        w.i32(self.port)
+        w.string(None)  # rack
+        w.i32(0)  # controller
+        w.i32(len(topics))
+        for t in topics:
+            w.i16(0)
+            w.string(t)
+            w.i8(0)  # not internal
+            w.i32(self.n_partitions)
+            for p in range(self.n_partitions):
+                w.i16(0)
+                w.i32(p)
+                w.i32(0)  # leader
+                w.i32(1)
+                w.i32(0)  # replicas
+                w.i32(1)
+                w.i32(0)  # isr
+            self.topics.add(t)
+
+    def _list_offsets(self, r, w, v):
+        r.i32()  # replica
+        out = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts = []
+            for _ in range(r.i32()):
+                p = r.i32()
+                ts = r.i64()
+                with self.lock:
+                    log = self._log(topic, p)
+                    off = log.start_offset if ts == -2 else log.next_offset
+                parts.append((p, off))
+            out.append((topic, parts))
+        w.i32(len(out))
+        for topic, parts in out:
+            w.string(topic)
+            w.i32(len(parts))
+            for p, off in parts:
+                w.i32(p)
+                w.i16(0)
+                w.i64(-1)
+                w.i64(off)
+
+    def _fetch(self, r, w, v):
+        r.i32()  # replica
+        r.i32()  # max wait
+        r.i32()  # min bytes
+        r.i32()  # max bytes
+        r.i8()  # isolation
+        out = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts = []
+            for _ in range(r.i32()):
+                p = r.i32()
+                offset = r.i64()
+                r.i32()  # partition max bytes
+                with self.lock:
+                    log = self._log(topic, p)
+                    if offset < log.start_offset or offset > log.next_offset:
+                        parts.append((p, log.next_offset, None))
+                        continue
+                    data = b"".join(
+                        b for base, last, b in log.batches if last >= offset
+                    )
+                    hw = log.next_offset
+                parts.append((p, hw, data))
+            out.append((topic, parts))
+        w.i32(0)  # throttle
+        w.i32(len(out))
+        for topic, parts in out:
+            w.string(topic)
+            w.i32(len(parts))
+            for p, hw, data in parts:
+                w.i32(p)
+                w.i16(ERR_OFFSET_OUT_OF_RANGE if data is None else 0)
+                w.i64(hw)
+                w.i64(hw)  # last stable
+                w.i32(0)  # aborted txns
+                w.bytes_(data or b"")
+
+    def _produce(self, r, w, v):
+        r.string()  # txn id
+        r.i16()  # acks
+        r.i32()  # timeout
+        out = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts = []
+            for _ in range(r.i32()):
+                p = r.i32()
+                batch = r.bytes_() or b""
+                base = self.append(topic, p, batch)
+                parts.append((p, base))
+            out.append((topic, parts))
+        w.i32(len(out))
+        for topic, parts in out:
+            w.string(topic)
+            w.i32(len(parts))
+            for p, base in parts:
+                w.i32(p)
+                w.i16(0)
+                w.i64(base)
+                w.i64(-1)  # log append time
+        w.i32(0)  # throttle
+
+    def _find_coordinator(self, r, w, v):
+        r.string()  # group
+        w.i16(0)
+        w.i32(0)
+        w.string("127.0.0.1")
+        w.i32(self.port)
+
+    def _offset_commit(self, r, w, v):
+        group = r.string()
+        r.i32()  # generation
+        r.string()  # member
+        r.i64()  # retention
+        out = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts = []
+            for _ in range(r.i32()):
+                p = r.i32()
+                off = r.i64()
+                r.string()  # metadata
+                with self.lock:
+                    self.group_offsets[(group, topic, p)] = off
+                parts.append(p)
+            out.append((topic, parts))
+        w.i32(len(out))
+        for topic, parts in out:
+            w.string(topic)
+            w.i32(len(parts))
+            for p in parts:
+                w.i32(p)
+                w.i16(0)
+
+    def _offset_fetch(self, r, w, v):
+        group = r.string()
+        out = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts = []
+            for _ in range(r.i32()):
+                p = r.i32()
+                with self.lock:
+                    off = self.group_offsets.get((group, topic, p), -1)
+                parts.append((p, off))
+            out.append((topic, parts))
+        w.i32(len(out))
+        for topic, parts in out:
+            w.string(topic)
+            w.i32(len(parts))
+            for p, off in parts:
+                w.i32(p)
+                w.i64(off)
+                w.string(None)
+                w.i16(0)

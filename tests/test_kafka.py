@@ -1,0 +1,339 @@
+"""Kafka receiver: wire codec, client↔fake-broker, consume→push e2e.
+
+Covers the reference's kafka receiver role (distributor/receiver
+shim.go factories) the way §4's e2e backend fakes cover object storage:
+a real TCP broker speaking the protocol, real CRC-checked record
+batches, offset-commit resume semantics.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.api.kafka import (
+    KafkaClient,
+    KafkaReceiver,
+    KafkaReceiverConfig,
+    crc32c,
+    decode_record_batches,
+    encode_record_batch,
+)
+from tempo_tpu.utils.test_data import make_trace
+
+from tests.fake_kafka import FakeKafkaBroker
+
+
+@pytest.fixture()
+def broker():
+    b = FakeKafkaBroker(n_partitions=2).start()
+    yield b
+    b.stop()
+
+
+def test_crc32c_known_answer():
+    # RFC 3720 test vector
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+
+
+def test_record_batch_roundtrip():
+    recs = [(b"k1", b"v1"), (None, b"v2"), (b"", os.urandom(100))]
+    batch = encode_record_batch(recs, base_offset=7)
+    got = decode_record_batches(batch)
+    assert [(o, k, v) for o, k, v in got] == [
+        (7, b"k1", b"v1"),
+        (8, None, b"v2"),
+        (9, b"", recs[2][1]),
+    ]
+
+
+def test_record_batch_truncated_tail_dropped():
+    b1 = encode_record_batch([(None, b"a")], base_offset=0)
+    b2 = encode_record_batch([(None, b"b")], base_offset=1)
+    data = b1 + b2[: len(b2) - 3]  # torn fetch response
+    got = decode_record_batches(data)
+    assert [v for _, _, v in got] == [b"a"]
+
+
+def test_corrupt_batch_preserves_good_prefix():
+    """A CRC-corrupt batch mid-response must not discard the valid
+    batches before it (at-least-once: good records are delivered, the
+    corrupt batch is hit at the start of the next fetch)."""
+    good = encode_record_batch([(None, b"a"), (None, b"b")], base_offset=0)
+    bad = bytearray(encode_record_batch([(None, b"z")], base_offset=2))
+    bad[-1] ^= 0xFF
+    got = decode_record_batches(good + bytes(bad))
+    assert [v for _, _, v in got] == [b"a", b"b"]
+
+
+def test_sasl_username_without_password_fails_fast():
+    with pytest.raises(ValueError, match="sasl_password"):
+        KafkaReceiverConfig(["h:1"], sasl_username="user")
+
+
+def test_record_batch_crc_mismatch_raises():
+    batch = bytearray(encode_record_batch([(None, b"payload")]))
+    batch[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="crc32c"):
+        decode_record_batches(bytes(batch))
+
+
+def test_produce_fetch_roundtrip(broker):
+    client = KafkaClient([broker.addr])
+    meta = client.metadata(["otlp_spans"])
+    assert set(meta["otlp_spans"]) == {0, 1}
+    base = client.produce("otlp_spans", 0, [(None, b"one"), (None, b"two")])
+    assert base == 0
+    assert client.produce("otlp_spans", 0, [(None, b"three")]) == 2
+    records, hw = client.fetch("otlp_spans", 0, 0, leader=0)
+    assert [v for _, _, v in records] == [b"one", b"two", b"three"]
+    assert hw == 3
+    # mid-batch fetch: client drops records below the requested offset
+    records, _ = client.fetch("otlp_spans", 0, 1, leader=0)
+    assert [v for _, _, v in records] == [b"two", b"three"]
+    client.close()
+
+
+def test_list_offsets_and_group_offsets(broker):
+    client = KafkaClient([broker.addr])
+    client.produce("t", 1, [(None, b"x")])
+    assert client.list_offset("t", 1, -2, leader=0) == 0  # earliest
+    assert client.list_offset("t", 1, -1, leader=0) == 1  # latest
+    assert client.fetch_offset("g1", "t", 1) == -1
+    client.commit_offset("g1", "t", 1, 1)
+    assert client.fetch_offset("g1", "t", 1) == 1
+    assert client.fetch_offset("g2", "t", 1) == -1  # group isolation
+    client.close()
+
+
+def _otlp_bytes(tid: bytes, seed: int) -> bytes:
+    return make_trace(tid, seed=seed).SerializeToString()
+
+
+def test_receiver_consume_push_commit(broker):
+    client = KafkaClient([broker.addr])
+    tid1, tid2 = os.urandom(16), os.urandom(16)
+    client.produce("otlp_spans", 0, [(tid1, _otlp_bytes(tid1, 1))])
+    client.produce("otlp_spans", 1, [(tid2, _otlp_bytes(tid2, 2))])
+
+    pushed = []
+    cfg = KafkaReceiverConfig([broker.addr], start_at="earliest")
+    rx = KafkaReceiver(cfg, lambda tenant, batches: pushed.append((tenant, batches)))
+    assert rx.poll_once() == 2
+    assert len(pushed) == 2
+    tids = {rs.scope_spans[0].spans[0].trace_id for _, bs in pushed for rs in bs[:1]}
+    assert tids == {tid1, tid2}
+    # nothing new → no duplicate delivery
+    assert rx.poll_once() == 0
+    rx.stop()
+
+    # a fresh receiver (same group) resumes from the committed offsets
+    rx2 = KafkaReceiver(cfg, lambda tenant, batches: pushed.append((tenant, batches)))
+    assert rx2.poll_once() == 0
+    client.produce("otlp_spans", 0, [(None, _otlp_bytes(os.urandom(16), 3))])
+    assert rx2.poll_once() == 1
+    rx2.stop()
+    client.close()
+
+
+def test_receiver_static_membership_partition_split(broker):
+    client = KafkaClient([broker.addr])
+    client.produce("otlp_spans", 0, [(None, _otlp_bytes(os.urandom(16), 1))])
+    client.produce("otlp_spans", 1, [(None, _otlp_bytes(os.urandom(16), 2))])
+    got = {0: 0, 1: 0}
+    for idx in (0, 1):
+        cfg = KafkaReceiverConfig(
+            [broker.addr], start_at="earliest", member_index=idx, members=2
+        )
+        rx = KafkaReceiver(cfg, lambda t, b: None)
+        got[idx] = rx.poll_once()
+        rx.stop()
+    assert got == {0: 1, 1: 1}  # one partition each, no overlap
+    client.close()
+
+
+def test_receiver_decode_error_skips_and_advances(broker):
+    client = KafkaClient([broker.addr])
+    good = _otlp_bytes(os.urandom(16), 5)
+    client.produce("otlp_spans", 0, [(None, b"\xff\xffnot-a-proto-batch\x00"), (None, good)])
+    pushed = []
+    cfg = KafkaReceiverConfig([broker.addr], start_at="earliest")
+    rx = KafkaReceiver(cfg, lambda t, b: pushed.append(b))
+    rx.poll_once()
+    # poison message skipped but offset advanced past it
+    assert rx.decode_errors == 1
+    assert len(pushed) == 1
+    assert rx.poll_once() == 0
+    rx.stop()
+    client.close()
+
+
+def test_receiver_zipkin_encoding(broker):
+    client = KafkaClient([broker.addr])
+    body = (
+        b'[{"traceId":"%s","id":"1112131415161718","name":"op",'
+        b'"localEndpoint":{"serviceName":"svc"},"timestamp":1000,"duration":5}]'
+        % (b"0a" * 16)
+    )
+    client.produce("zipkin_spans", 0, [(None, body)])
+    pushed = []
+    cfg = KafkaReceiverConfig(
+        [broker.addr], topic="zipkin_spans", encoding="zipkin_json", start_at="earliest"
+    )
+    rx = KafkaReceiver(cfg, lambda t, b: pushed.extend(b))
+    assert rx.poll_once() == 1
+    assert pushed[0].resource.attributes[0].value.string_value == "svc"
+    rx.stop()
+    client.close()
+
+
+def test_receiver_background_thread(broker):
+    import time
+
+    pushed = []
+    cfg = KafkaReceiverConfig([broker.addr], start_at="earliest", poll_interval_s=0.05)
+    rx = KafkaReceiver(cfg, lambda t, b: pushed.append(b))
+    rx.start()
+    client = KafkaClient([broker.addr])
+    client.produce("otlp_spans", 0, [(None, _otlp_bytes(os.urandom(16), 9))])
+    deadline = time.time() + 5
+    while not pushed and time.time() < deadline:
+        time.sleep(0.02)
+    rx.stop()
+    client.close()
+    assert pushed
+
+
+def test_sasl_plain_auth(broker):
+    sb = FakeKafkaBroker(n_partitions=1, sasl=("user", "secret")).start()
+    try:
+        # correct credentials: full produce/fetch path works
+        client = KafkaClient([sb.addr], sasl=("user", "secret"))
+        client.produce("t", 0, [(None, b"v")])
+        records, _ = client.fetch("t", 0, 0, leader=0)
+        assert [v for _, _, v in records] == [b"v"]
+        client.close()
+        # wrong password: authenticate is rejected
+        bad = KafkaClient([sb.addr], sasl=("user", "wrong"))
+        with pytest.raises(Exception):
+            bad.metadata(["t"], force=True)
+        bad.close()
+        # no SASL at all: broker drops the connection on first real API
+        anon = KafkaClient([sb.addr])
+        with pytest.raises(Exception):
+            anon.metadata(["t"], force=True)
+        anon.close()
+    finally:
+        sb.stop()
+
+
+def test_dead_connection_evicted_and_reconnects(broker):
+    client = KafkaClient([broker.addr])
+    client.metadata(["t"], force=True)
+    # simulate a dropped socket (broker restart / idle timeout)
+    for conn in client._conns.values():
+        conn.sock.close()
+    with pytest.raises((OSError, ConnectionError, ValueError)):
+        client.metadata(["t"], force=True)
+    # eviction means the next call opens a fresh connection and succeeds
+    assert client.metadata(["t"], force=True)["t"]
+    client.close()
+
+
+def test_offset_out_of_range_resets_to_earliest(broker):
+    client = KafkaClient([broker.addr])
+    for i in range(5):
+        client.produce("otlp_spans", 0, [(None, _otlp_bytes(os.urandom(16), i))])
+    pushed = []
+    cfg = KafkaReceiverConfig([broker.addr], start_at="earliest", members=2)
+    rx = KafkaReceiver(cfg, lambda t, b: pushed.append(b))
+    assert rx.poll_once() == 5
+    rx.stop()
+
+    # retention deletes segments under the committed offset (commit=5,
+    # log now starts at 6) — a fresh consumer must reset, not wedge
+    client.produce("otlp_spans", 0, [(None, _otlp_bytes(os.urandom(16), 9))])
+    client.produce("otlp_spans", 0, [(None, _otlp_bytes(os.urandom(16), 10))])
+    broker.truncate("otlp_spans", 0, 6)
+    rx2 = KafkaReceiver(cfg, lambda t, b: pushed.append(b))
+    assert rx2.poll_once() == 0  # detects out-of-range, schedules reset
+    assert rx2.offset_resets == 1
+    assert rx2.poll_once() == 1  # resumes from the new log start
+    rx2.stop()
+    client.close()
+
+
+def test_metadata_cached_between_polls(broker):
+    client = KafkaClient([broker.addr])
+    m1 = client.metadata(["otlp_spans"])
+    assert client.metadata(["otlp_spans"]) is m1  # TTL cache hit
+    assert client.metadata(["otlp_spans"], force=True) is not m1
+    client.close()
+
+
+def test_app_kafka_receiver_e2e(broker, tmp_path):
+    """config → App → kafka consume → distributor → find_trace."""
+    import time
+
+    from tempo_tpu.cli.config import load_config
+    from tempo_tpu.modules.app import App
+
+    cfg, _runtime = load_config(text=f"""
+storage:
+  backend: memory
+  wal_dir: {tmp_path}/wal
+distributor:
+  receivers:
+    kafka:
+      brokers: ["{broker.addr}"]
+      topic: otlp_spans
+      start_at: earliest
+      poll_interval_s: 0.05
+      tenant: t-kafka
+""")
+    assert cfg.receivers["kafka"]["topic"] == "otlp_spans"
+    tid = os.urandom(16)
+    client = KafkaClient([broker.addr])
+    client.produce("otlp_spans", 0, [(tid, _otlp_bytes(tid, 11))])
+    app = App(cfg)
+    try:
+        app.start_receivers()
+        deadline = time.time() + 5
+        found = None
+        while time.time() < deadline:
+            found = app.find_trace("t-kafka", tid)
+            if found is not None and len(found.trace.batches):
+                break
+            time.sleep(0.05)
+        assert found is not None and len(found.trace.batches)
+    finally:
+        app.shutdown()
+    client.close()
+
+
+def test_pubsub_lite_requires_token():
+    from tempo_tpu.api.kafka import pubsub_lite_receiver
+
+    with pytest.raises(ValueError, match="token"):
+        pubsub_lite_receiver({"topic": "t", "subscription": "s"}, lambda t, b: None)
+
+
+def test_crc32c_native_matches_python():
+    from tempo_tpu.api.kafka import _crc32c_py
+    from tempo_tpu.ops import native
+
+    if not native.available():
+        pytest.skip("native runtime not built")
+    for n in (0, 1, 7, 8, 13, 4096):
+        d = os.urandom(n)
+        assert native.crc32c(d) == _crc32c_py(d)
+
+
+def test_otlp_batch_proto_parse():
+    tid = os.urandom(16)
+    t = tempopb.Trace()
+    t.ParseFromString(_otlp_bytes(tid, 1))
+    assert t.batches[0].scope_spans[0].spans[0].trace_id == tid

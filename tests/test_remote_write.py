@@ -206,6 +206,15 @@ def test_app_wiring(tmp_path):
     try:
         tr = make_trace(random_trace_id(), seed=9)
         app.push("t1", list(tr.batches))
+        # distributor→generator forwarding is async (bounded queue +
+        # worker, reference forwarder.go) — wait for the samples to land
+        import time as _time
+
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            if "t1" in app.generator.tenants() and app.generator.registry("t1").samples():
+                break
+            _time.sleep(0.01)
         app.remote_write.tick()
         assert rx.requests and rx.requests[-1][0] == "t1"
     finally:
