@@ -1,7 +1,7 @@
 #!/bin/sh
 # Regenerate tempo_tpu/tempopb from protos/. Run from repo root.
 set -e
-protoc -I protos --python_out=tempo_tpu/tempopb protos/trace.proto protos/tempo.proto protos/remote_write.proto
+protoc -I protos --python_out=tempo_tpu/tempopb protos/trace.proto protos/tempo.proto protos/remote_write.proto protos/opencensus.proto
 # protoc emits a flat sibling import; rewrite to package-relative so the
 # generated module never collides with a foreign top-level trace_pb2.
 sed -i 's/^import trace_pb2 as trace__pb2$/from . import trace_pb2 as trace__pb2/' \

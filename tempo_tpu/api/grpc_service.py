@@ -144,6 +144,11 @@ def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
             "Export": _unary(otlp_export, tempopb.Trace, tempopb.Trace),
         }))
 
+        # OpenCensus agent TraceService rides the same receiver port
+        from .opencensus import make_oc_handler
+
+        handlers.append(make_oc_handler(otlp_push, tenant_from=_tenant_from))
+
     server.add_generic_rpc_handlers(tuple(handlers))
     server.add_insecure_port(address)
     return server

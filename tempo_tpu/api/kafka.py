@@ -706,10 +706,10 @@ class KafkaReceiverConfig:
         self.poll_interval_s = poll_interval_s
         self.tls = tls
         self.start_at = start_at
-        if sasl_username is not None and sasl_password is None:
+        if (sasl_username is None) != (sasl_password is None):
             raise ValueError(
-                "kafka receiver: sasl_username set without sasl_password "
-                "(check env substitution for the password value)"
+                "kafka receiver: sasl_username and sasl_password must be "
+                "set together (check env substitution for the missing one)"
             )
         self.sasl = (sasl_username, sasl_password) if sasl_username is not None else None
 

@@ -1,0 +1,157 @@
+"""OpenCensus ingest receiver: the OC agent TraceService over gRPC.
+
+Role-equivalent to the reference's embedded otel-collector opencensus
+receiver (modules/distributor/receiver/shim.go factories; default agent
+port 55678). OC's `Export` is a *bidirectional stream* where the first
+request carries `node`/`resource` and later ones may omit them — the
+handler keeps per-stream state and applies the last seen. `Config` is
+answered with an empty echo (the collector does the same when no
+sampling config is pushed).
+
+Translation OC → OTLP (our wire model):
+  trace_id/span_id/parent  bytes, verbatim
+  name                     TruncatableString.value
+  kind                     SERVER→SPAN_KIND_SERVER, CLIENT→SPAN_KIND_CLIENT
+  start/end time           Timestamp → unix nanos
+  attributes               string/int/bool/double → AnyValue
+  annotations              → span events
+  status.code (gRPC)       nonzero → STATUS_CODE_ERROR (message kept)
+  node.service_info.name   → resource service.name (resource labels merged,
+                           per-span resource overrides the request one)
+"""
+
+from __future__ import annotations
+
+from tempo_tpu import tempopb
+from tempo_tpu.tempopb import opencensus_pb2 as ocpb
+from tempo_tpu.utils.ids import pad_trace_id
+
+OC_TRACE_SERVICE = "opencensus.proto.agent.trace.v1.TraceService"
+
+_OC_KIND = {
+    ocpb.OCSpan.SERVER: tempopb.Span.SPAN_KIND_SERVER,
+    ocpb.OCSpan.CLIENT: tempopb.Span.SPAN_KIND_CLIENT,
+}
+
+
+def _ts_nanos(ts) -> int:
+    return int(ts.seconds) * 1_000_000_000 + int(ts.nanos)
+
+
+def _set_attr(kv, v) -> None:
+    which = v.WhichOneof("value")
+    if which == "string_value":
+        kv.value.string_value = v.string_value.value
+    elif which == "int_value":
+        kv.value.int_value = v.int_value
+    elif which == "bool_value":
+        kv.value.bool_value = v.bool_value
+    elif which == "double_value":
+        kv.value.double_value = v.double_value
+
+
+def oc_request_to_batches(req, node=None, resource=None) -> list:
+    """One OC ExportTraceServiceRequest → [ResourceSpans] (grouped by
+    effective resource: request-level unless a span overrides)."""
+    node = req.node if req.HasField("node") else node
+    resource = req.resource if req.HasField("resource") else resource
+
+    def resource_key(res):
+        if res is None:
+            return ()
+        return (res.type, tuple(sorted(res.labels.items())))
+
+    groups: dict[tuple, tempopb.ResourceSpans] = {}
+    for span in req.spans:
+        res = span.resource if span.HasField("resource") else resource
+        key = resource_key(res)
+        rs = groups.get(key)
+        if rs is None:
+            rs = groups[key] = tempopb.ResourceSpans()
+            svc = None
+            if node is not None and node.service_info.name:
+                svc = node.service_info.name
+            if res is not None:
+                for k, v in sorted(res.labels.items()):
+                    if k in ("service.name", "service_name"):
+                        # explicit resource label beats node.service_info
+                        # (per-span resource overrides depend on this);
+                        # either way exactly ONE service.name is emitted
+                        svc = v
+                        continue
+                    kv = rs.resource.attributes.add()
+                    kv.key = k
+                    kv.value.string_value = v
+                if res.type:
+                    kv = rs.resource.attributes.add()
+                    kv.key = "opencensus.resourcetype"
+                    kv.value.string_value = res.type
+            kv = rs.resource.attributes.add()
+            kv.key = "service.name"
+            kv.value.string_value = svc or "unknown"
+            scope = rs.scope_spans.add().scope
+            scope.name = "opencensus-receiver"
+            if node is not None and node.library_info.core_library_version:
+                scope.version = node.library_info.core_library_version
+        s = rs.scope_spans[0].spans.add()
+        s.trace_id = pad_trace_id(span.trace_id)
+        s.span_id = span.span_id[:8].rjust(8, b"\x00")
+        if span.parent_span_id:
+            s.parent_span_id = span.parent_span_id[:8].rjust(8, b"\x00")
+        s.name = span.name.value
+        s.kind = _OC_KIND.get(span.kind, tempopb.Span.SPAN_KIND_UNSPECIFIED)
+        s.start_time_unix_nano = _ts_nanos(span.start_time)
+        s.end_time_unix_nano = _ts_nanos(span.end_time)
+        for k, v in span.attributes.attribute_map.items():
+            kv = s.attributes.add()
+            kv.key = k
+            _set_attr(kv, v)
+        for te in span.time_events.time_event:
+            if te.WhichOneof("value") == "annotation":
+                ev = s.events.add()
+                ev.time_unix_nano = _ts_nanos(te.time)
+                ev.name = te.annotation.description.value
+                for k, v in te.annotation.attributes.attribute_map.items():
+                    kv = ev.attributes.add()
+                    kv.key = k
+                    _set_attr(kv, v)
+        if span.HasField("status") and span.status.code != 0:
+            s.status.code = tempopb.Status.STATUS_CODE_ERROR
+            s.status.message = span.status.message
+    return list(groups.values())
+
+
+def make_oc_handler(push_fn, tenant_from=None):
+    """grpc GenericRpcHandler serving the OC TraceService; register it on
+    any grpc.Server (the distributor's, alongside OTLP)."""
+    import grpc
+
+    def export(request_iterator, context):
+        node = resource = None
+        tenant = tenant_from(context) if tenant_from else "single-tenant"
+        for req in request_iterator:
+            if req.HasField("node"):
+                node = req.node
+            if req.HasField("resource"):
+                resource = req.resource
+            batches = oc_request_to_batches(req, node, resource)
+            if batches:
+                push_fn(tenant, batches)
+            yield ocpb.OCExportTraceServiceResponse()
+
+    def config(request_iterator, context):
+        for req in request_iterator:
+            yield ocpb.OCUpdatedLibraryConfig()
+
+    return grpc.method_handlers_generic_handler(OC_TRACE_SERVICE, {
+        "Export": grpc.stream_stream_rpc_method_handler(
+            export,
+            request_deserializer=ocpb.OCExportTraceServiceRequest.FromString,
+            response_serializer=ocpb.OCExportTraceServiceResponse.SerializeToString,
+        ),
+        "Config": grpc.stream_stream_rpc_method_handler(
+            config,
+            request_deserializer=ocpb.OCCurrentLibraryConfig.FromString,
+            response_serializer=ocpb.OCUpdatedLibraryConfig.SerializeToString,
+        ),
+    })

@@ -12,9 +12,8 @@ opencensus/kafka/pubsub-lite — shim.go:75-138). Implemented natively:
   - pubsub-lite [Shopify fork extra]: the Kafka consumer pointed at
     Pub/Sub Lite's Kafka-compatible endpoint (api/kafka.py; TLS —
     gated in this zero-egress environment)
-  - OpenCensus: gRPC TraceService with OC→OTLP translation — the one
-    remaining carrier; the translate-and-push pattern here is its
-    extension point.
+  - OpenCensus: agent TraceService bidi stream with OC→OTLP
+    translation (api/opencensus.py), on the same gRPC port as OTLP
 """
 
 from __future__ import annotations

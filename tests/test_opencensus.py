@@ -1,0 +1,183 @@
+"""OpenCensus receiver: OC→OTLP translation + streaming gRPC ingest.
+
+Covers the reference's opencensus receiver role (distributor/receiver
+shim factories): a real OC agent `Export` stream over gRPC, node/resource
+stickiness across stream messages, attribute/kind/status/annotation
+translation, and query-back through the normal read path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import grpc
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.api.opencensus import OC_TRACE_SERVICE, oc_request_to_batches
+from tempo_tpu.tempopb import opencensus_pb2 as ocpb
+
+
+def _oc_span(tid: bytes, sid: bytes, name="op", service=None, **attrs):
+    s = ocpb.OCSpan()
+    s.trace_id = tid
+    s.span_id = sid
+    s.name.value = name
+    s.kind = ocpb.OCSpan.SERVER
+    s.start_time.seconds = 1_600_000_000
+    s.end_time.seconds = 1_600_000_001
+    s.end_time.nanos = 500_000_000
+    for k, v in attrs.items():
+        av = s.attributes.attribute_map[k]
+        if isinstance(v, bool):
+            av.bool_value = v
+        elif isinstance(v, int):
+            av.int_value = v
+        elif isinstance(v, float):
+            av.double_value = v
+        else:
+            av.string_value.value = str(v)
+    return s
+
+
+def test_translation_basics():
+    tid, sid = os.urandom(16), os.urandom(8)
+    req = ocpb.OCExportTraceServiceRequest()
+    req.node.service_info.name = "checkout"
+    req.resource.labels["region"] = "us-east1"
+    span = _oc_span(tid, sid, name="charge", http_status=500, retried=True,
+                    amount=1.5, route="/pay")
+    span.status.code = 2  # gRPC UNKNOWN → error
+    span.status.message = "boom"
+    span.parent_span_id = b"\x01" * 8
+    ann = span.time_events.time_event.add()
+    ann.time.seconds = 1_600_000_000
+    ann.annotation.description.value = "retrying"
+    ann.annotation.attributes.attribute_map["attempt"].int_value = 2
+    req.spans.append(span)
+
+    batches = oc_request_to_batches(req)
+    assert len(batches) == 1
+    rs = batches[0]
+    res_attrs = {kv.key: kv.value.string_value for kv in rs.resource.attributes}
+    assert res_attrs["service.name"] == "checkout"
+    assert res_attrs["region"] == "us-east1"
+    s = rs.scope_spans[0].spans[0]
+    assert s.trace_id == tid and s.span_id == sid
+    assert s.parent_span_id == b"\x01" * 8
+    assert s.name == "charge"
+    assert s.kind == tempopb.Span.SPAN_KIND_SERVER
+    assert s.start_time_unix_nano == 1_600_000_000 * 10**9
+    assert s.end_time_unix_nano == 1_600_000_001 * 10**9 + 500_000_000
+    attrs = {kv.key: kv.value for kv in s.attributes}
+    assert attrs["http_status"].int_value == 500
+    assert attrs["retried"].bool_value is True
+    assert attrs["amount"].double_value == 1.5
+    assert attrs["route"].string_value == "/pay"
+    assert s.status.code == tempopb.Status.STATUS_CODE_ERROR
+    assert s.status.message == "boom"
+    assert s.events[0].name == "retrying"
+    assert s.events[0].attributes[0].value.int_value == 2
+
+
+def test_per_span_resource_override_groups():
+    req = ocpb.OCExportTraceServiceRequest()
+    req.node.service_info.name = "svc-a"
+    sp1 = _oc_span(os.urandom(16), os.urandom(8))
+    sp2 = _oc_span(os.urandom(16), os.urandom(8))
+    sp2.resource.labels["service.name"] = "svc-b"
+    req.spans.extend([sp1, sp2])
+    batches = oc_request_to_batches(req)
+    names = sorted(
+        next(kv.value.string_value for kv in b.resource.attributes
+             if kv.key == "service.name")
+        for b in batches
+    )
+    assert names == ["svc-a", "svc-b"]
+
+
+def test_node_vs_label_service_name_no_duplicate():
+    req = ocpb.OCExportTraceServiceRequest()
+    req.node.service_info.name = "from-node"
+    req.resource.labels["service.name"] = "from-label"
+    req.spans.append(_oc_span(os.urandom(16), os.urandom(8)))
+    (rs,) = oc_request_to_batches(req)
+    svc_attrs = [kv.value.string_value for kv in rs.resource.attributes
+                 if kv.key == "service.name"]
+    assert svc_attrs == ["from-label"]  # exactly one; explicit label wins
+
+
+def test_short_trace_id_padded():
+    req = ocpb.OCExportTraceServiceRequest()
+    req.spans.append(_oc_span(b"\x05" * 8, os.urandom(8)))
+    (rs,) = oc_request_to_batches(req)
+    assert len(rs.scope_spans[0].spans[0].trace_id) == 16
+
+
+def test_streaming_export_node_stickiness_e2e(tmp_path):
+    """Real gRPC bidi stream: node only on the first message; spans on
+    later messages inherit it. Query back via the app."""
+    from tempo_tpu.api.grpc_service import make_grpc_server
+    from tempo_tpu.modules import App, AppConfig
+
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal")))
+    server = make_grpc_server(app, "127.0.0.1:0")
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        rpc = channel.stream_stream(
+            f"/{OC_TRACE_SERVICE}/Export",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=ocpb.OCExportTraceServiceResponse.FromString,
+        )
+        tid1, tid2 = os.urandom(16), os.urandom(16)
+
+        def gen():
+            first = ocpb.OCExportTraceServiceRequest()
+            first.node.service_info.name = "stream-svc"
+            first.spans.append(_oc_span(tid1, os.urandom(8), name="one"))
+            yield first
+            second = ocpb.OCExportTraceServiceRequest()  # no node
+            second.spans.append(_oc_span(tid2, os.urandom(8), name="two"))
+            yield second
+
+        responses = list(rpc(gen(), metadata=(("x-scope-orgid", "oc-t"),)))
+        assert len(responses) == 2
+
+        for tid, name in ((tid1, "one"), (tid2, "two")):
+            found = app.find_trace("oc-t", tid)
+            assert found.trace.batches, name
+            rs = found.trace.batches[0]
+            svc = next(kv.value.string_value for kv in rs.resource.attributes
+                       if kv.key == "service.name")
+            assert svc == "stream-svc"
+        channel.close()
+    finally:
+        server.stop(0)
+        app.shutdown()
+
+
+def test_config_stream_echoes():
+    from tempo_tpu.api.grpc_service import make_grpc_server
+    from tempo_tpu.modules import App, AppConfig
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        app = App(AppConfig(wal_dir=td + "/wal"))
+        server = make_grpc_server(app, "127.0.0.1:0")
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        try:
+            channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+            rpc = channel.stream_stream(
+                f"/{OC_TRACE_SERVICE}/Config",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=ocpb.OCUpdatedLibraryConfig.FromString,
+            )
+            out = list(rpc(iter([ocpb.OCCurrentLibraryConfig()])))
+            assert len(out) == 1
+            channel.close()
+        finally:
+            server.stop(0)
+            app.shutdown()
