@@ -70,10 +70,20 @@ class Memberlist:
                  grpc_addr: str = "", http_addr: str = "",
                  gossip_interval_s: float = 1.0, fanout: int = 3,
                  suspect_timeout_s: float = 15.0,
-                 replication_factor: int = 3):
+                 replication_factor: int = 3,
+                 resolver=None):
         self.id = instance_id
         self.role = role
+        # join entries may be plain host:port or thanos-style dns+ /
+        # dnssrv+ specs (utils/dns.py), re-resolved every gossip round;
+        # malformed specs fail here, not silently per-tick
+        from tempo_tpu.utils.dns import validate_spec
+
         self.join_addrs = list(join or [])
+        for spec in self.join_addrs:
+            validate_spec(spec)
+        self._resolver = resolver
+        self._seed_warn_at = 0.0
         self.gossip_interval_s = gossip_interval_s
         self.fanout = fanout
         self.suspect_timeout_s = suspect_timeout_s
@@ -226,7 +236,7 @@ class Memberlist:
         # seeds we haven't absorbed yet (bootstrap)
         with self._lock:
             known_addrs = {m.gossip_addr for m in self._members.values()}
-        targets += [a for a in self.join_addrs
+        targets += [a for a in self._resolved_seeds()
                     if a not in known_addrs and a != self.gossip_addr][:2]
         for addr in targets:
             _gossip_rounds.inc()
@@ -234,6 +244,27 @@ class Memberlist:
                 self._exchange(addr)
             except (OSError, json.JSONDecodeError):
                 _gossip_errors.inc()
+
+    def _resolved_seeds(self) -> list[str]:
+        """join_addrs with dns+/dnssrv+ specs expanded (cached per-TTL in
+        the resolver; plain host:port entries pass through untouched)."""
+        if not any(a.startswith(("dns+", "dnssrv+")) for a in self.join_addrs):
+            return self.join_addrs
+        if self._resolver is None:
+            from tempo_tpu.utils.dns import default_resolver
+
+            self._resolver = default_resolver()
+        resolved = self._resolver.resolve_all(self.join_addrs)
+        if not resolved:
+            now = time.monotonic()
+            if now - self._seed_warn_at > 60:
+                self._seed_warn_at = now
+                self.log.warning(
+                    "memberlist: no join seeds resolved from %s (DNS down "
+                    "or empty records) — gossiping to known peers only",
+                    self.join_addrs,
+                )
+        return resolved
 
     # ---- lifecycle ----
 

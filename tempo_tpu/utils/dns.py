@@ -1,0 +1,275 @@
+"""DNS service discovery: thanos-style ``dns+`` / ``dnssrv+`` specs.
+
+Role-equivalent to the reference's thanos DNS provider uses — memberlist
+join resolution (cmd/tempo/app modules.go:294) and querier worker →
+frontend discovery (modules/querier/worker/worker.go:44). Address specs:
+
+  "host:port"                     → passed through unchanged
+  "dns+host:port"                 → A lookup on host, one addr per record
+  "dnssrv+_svc._proto.domain"     → SRV lookup; each target resolved to
+                                    A records, port taken from the SRV
+
+Implemented directly on the DNS wire format (RFC 1035/2782) over UDP —
+header/question encode, answer parse with name-compression pointers,
+additional-section A records used when the server provides glue.
+Nameserver read from /etc/resolv.conf (overridable). Results are cached
+for min(TTL, max_ttl) so gossip-loop callers can re-resolve every round
+cheaply; failures serve the last-good answer (stale-on-error, like the
+tenant-index staleness fallback in db/poller.py).
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+import time
+
+TYPE_A = 1
+TYPE_AAAA = 28
+TYPE_SRV = 33
+CLASS_IN = 1
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+
+
+def encode_query(qname: str, qtype: int, txid: int) -> bytes:
+    out = struct.pack(">HHHHHH", txid, 0x0100, 1, 0, 0, 0)  # RD=1
+    for label in qname.rstrip(".").split("."):
+        b = label.encode()
+        if not 0 < len(b) < 64:
+            raise ValueError(f"dns: bad label in {qname!r}")
+        out += bytes([len(b)]) + b
+    return out + b"\x00" + struct.pack(">HH", qtype, CLASS_IN)
+
+
+def _read_name(msg: bytes, pos: int, depth: int = 0) -> tuple[str, int]:
+    """Decompress a (possibly pointer-compressed) name. Returns
+    (name, position after the name in the original stream)."""
+    if depth > 16:
+        raise ValueError("dns: compression pointer loop")
+    labels = []
+    while True:
+        if pos >= len(msg):
+            raise ValueError("dns: truncated name")
+        n = msg[pos]
+        if n == 0:
+            return ".".join(labels), pos + 1
+        if n & 0xC0 == 0xC0:  # compression pointer
+            ptr = struct.unpack_from(">H", msg, pos)[0] & 0x3FFF
+            suffix, _ = _read_name(msg, ptr, depth + 1)
+            if suffix:
+                labels.append(suffix)
+            return ".".join(labels), pos + 2
+        pos += 1
+        labels.append(msg[pos : pos + n].decode("ascii", "replace"))
+        pos += n
+
+
+def parse_response(msg: bytes, txid: int):
+    """→ (answers, additionals); each record is
+    (name, type, ttl, rdata-parsed). A → "ip", SRV → (prio, weight,
+    port, target), others → raw bytes. All malformed-packet failures
+    surface as ValueError (struct.error would otherwise slip past the
+    callers' except clauses and kill the gossip thread)."""
+    try:
+        return _parse_response(msg, txid)
+    except struct.error as e:
+        raise ValueError(f"dns: malformed response: {e}") from e
+
+
+def _parse_response(msg: bytes, txid: int):
+    if len(msg) < 12:
+        raise ValueError("dns: short response")
+    rid, flags, qd, an, ns, ar = struct.unpack_from(">HHHHHH", msg, 0)
+    if rid != txid:
+        raise ValueError("dns: transaction id mismatch")
+    rcode = flags & 0xF
+    if rcode not in (0, 3):  # NOERROR / NXDOMAIN
+        raise ValueError(f"dns: server error rcode={rcode}")
+    pos = 12
+    for _ in range(qd):  # skip questions
+        _, pos = _read_name(msg, pos)
+        pos += 4
+
+    def read_records(count):
+        nonlocal pos
+        recs = []
+        for _ in range(count):
+            name, pos2 = _read_name(msg, pos)
+            pos = pos2
+            rtype, rclass, ttl, rdlen = struct.unpack_from(">HHIH", msg, pos)
+            pos += 10
+            rdata = msg[pos : pos + rdlen]
+            rd_start = pos
+            pos += rdlen
+            if rtype == TYPE_A and rdlen == 4:
+                parsed = socket.inet_ntoa(rdata)
+            elif rtype == TYPE_SRV:
+                prio, weight, port = struct.unpack_from(">HHH", msg, rd_start)
+                target, _ = _read_name(msg, rd_start + 6)
+                parsed = (prio, weight, port, target)
+            else:
+                parsed = rdata
+            recs.append((name.lower(), rtype, ttl, parsed))
+        return recs
+
+    answers = read_records(an)
+    read_records(ns)
+    additionals = read_records(ar)
+    return answers, additionals
+
+
+# ---------------------------------------------------------------------------
+# resolver
+
+
+def validate_spec(spec: str) -> None:
+    """Reject permanently-malformed address specs (a config typo must
+    fail at startup, not be silently skipped as a dead seed forever)."""
+    if spec.startswith("dnssrv+"):
+        name = spec[len("dnssrv+"):]
+        if not name or ":" in name:
+            raise ValueError(
+                f"dnssrv+ spec takes a bare SRV name (port comes from the "
+                f"record), got {spec!r}"
+            )
+    elif spec.startswith("dns+"):
+        host, _, port = spec[len("dns+"):].rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"dns+ spec needs host:port, got {spec!r}")
+
+
+def default_nameserver() -> tuple[str, int]:
+    try:
+        with open("/etc/resolv.conf") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2 and parts[0] == "nameserver":
+                    return parts[1], 53
+    except OSError:
+        pass
+    return "127.0.0.1", 53
+
+
+class Resolver:
+    """Minimal UDP stub resolver with per-name TTL cache and
+    stale-on-error fallback."""
+
+    def __init__(self, nameserver: tuple[str, int] | None = None,
+                 timeout_s: float = 2.0, retries: int = 2,
+                 max_ttl_s: float = 30.0, neg_ttl_s: float = 5.0):
+        self.nameserver = nameserver or default_nameserver()
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self.max_ttl_s = max_ttl_s
+        self.neg_ttl_s = neg_ttl_s
+        self._lock = threading.Lock()
+        # (qname, qtype) → (expiry_monotonic, records)
+        self._cache: dict[tuple[str, int], tuple[float, list]] = {}
+        # negative cache: failed lookups fast-fail until this deadline so
+        # a dead DNS server costs one timeout per neg_ttl, not per call
+        # (the gossip loop calls resolve every tick)
+        self._neg: dict[tuple[str, int], float] = {}
+
+    def query(self, qname: str, qtype: int) -> list:
+        """Answer records of the requested type (cache-aware)."""
+        key = (qname.lower().rstrip("."), qtype)
+        now = time.monotonic()
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit and hit[0] > now:
+                return hit[1]
+            if self._neg.get(key, 0) > now and not hit:
+                raise OSError(f"dns: {qname} lookup failing (negative-cached)")
+        try:
+            answers, additionals = self._query_wire(qname, qtype)
+        except (OSError, ValueError):
+            if hit:  # stale-on-error
+                return hit[1]
+            with self._lock:
+                self._neg[key] = now + self.neg_ttl_s
+            raise
+        with self._lock:
+            self._neg.pop(key, None)
+        records = [r for r in answers if r[1] == qtype]
+        ttl = min([r[2] for r in records] or [0])
+        expiry = now + min(max(ttl, 1), self.max_ttl_s)
+        # glue: additional-section A records answer the SRV targets'
+        # follow-up queries without another round-trip
+        glue: dict[str, list] = {}
+        for rec in additionals:
+            if rec[1] == TYPE_A:
+                glue.setdefault(rec[0], []).append(rec)
+        with self._lock:
+            self._cache[key] = (expiry, records)
+            for gname, recs in glue.items():
+                gttl = min(r[2] for r in recs)
+                gexp = now + min(max(gttl, 1), self.max_ttl_s)
+                self._cache[(gname, TYPE_A)] = (gexp, recs)
+        return records
+
+    def _query_wire(self, qname: str, qtype: int):
+        last: Exception | None = None
+        for _ in range(self.retries + 1):
+            txid = random.randrange(1, 0xFFFF)
+            pkt = encode_query(qname, qtype, txid)
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                sock.settimeout(self.timeout_s)
+                sock.sendto(pkt, self.nameserver)
+                resp, _ = sock.recvfrom(4096)
+                return parse_response(resp, txid)
+            except (OSError, ValueError, struct.error) as e:
+                last = e
+            finally:
+                sock.close()
+        raise last if last else OSError("dns: query failed")
+
+    # -- spec resolution ----------------------------------------------------
+
+    def resolve_spec(self, spec: str) -> list[str]:
+        """One address spec → list of host:port strings (see module doc)."""
+        if spec.startswith("dnssrv+"):
+            name = spec[len("dnssrv+"):]
+            out = []
+            for _name, _t, _ttl, (_prio, _weight, port, target) in self.query(
+                name, TYPE_SRV
+            ):
+                ips = [p for _, t, _, p in self.query(target, TYPE_A) if t == TYPE_A]
+                out.extend(f"{ip}:{port}" for ip in ips)
+            return sorted(set(out))
+        if spec.startswith("dns+"):
+            hostport = spec[len("dns+"):]
+            host, _, port = hostport.rpartition(":")
+            if not host:
+                raise ValueError(f"dns+ spec needs host:port, got {spec!r}")
+            ips = [p for _, t, _, p in self.query(host, TYPE_A) if t == TYPE_A]
+            return sorted({f"{ip}:{port}" for ip in ips})
+        return [spec]
+
+    def resolve_all(self, specs: list[str]) -> list[str]:
+        """Resolve a mixed list of specs; per-spec failures are skipped
+        (a dead seed must not stop the gossip loop)."""
+        out: list[str] = []
+        for spec in specs:
+            try:
+                out.extend(self.resolve_spec(spec))
+            except (OSError, ValueError):
+                continue
+        # de-dup, stable order
+        seen: set[str] = set()
+        return [a for a in out if not (a in seen or seen.add(a))]
+
+
+_default: Resolver | None = None
+
+
+def default_resolver() -> Resolver:
+    global _default
+    if _default is None:
+        _default = Resolver()
+    return _default

@@ -1,0 +1,105 @@
+"""In-process DNS server speaking the real wire format over UDP.
+
+Protocol-faithful fake for utils/dns.py tests: answers A and SRV
+queries from a configured zone, emits name-compression pointers in
+responses (so the parser's pointer-following is exercised), and can
+attach glue A records to SRV answers in the additional section.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+from tempo_tpu.utils.dns import TYPE_A, TYPE_SRV, _read_name
+
+
+def _encode_name(name: str, offsets: dict[str, int], pos: int) -> bytes:
+    """Encode with compression: reuse an earlier occurrence of any
+    suffix already emitted."""
+    out = b""
+    labels = name.rstrip(".").split(".")
+    for i in range(len(labels)):
+        suffix = ".".join(labels[i:]).lower()
+        if suffix in offsets:
+            return out + struct.pack(">H", 0xC000 | offsets[suffix])
+        if pos + len(out) < 0x3FFF:
+            offsets[suffix] = pos + len(out)
+        b = labels[i].encode()
+        out += bytes([len(b)]) + b
+    return out + b"\x00"
+
+
+class FakeDNSServer:
+    """zone: {("name", TYPE): [rdata, ...]} where rdata is "1.2.3.4" for
+    A and (prio, weight, port, "target.name") for SRV."""
+
+    def __init__(self, zone: dict):
+        self.zone = {(n.lower().rstrip("."), t): v for (n, t), v in zone.items()}
+        self.queries: list[tuple[str, int]] = []
+        fake = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                data, sock = self.request
+                resp = fake.answer(data)
+                if resp:
+                    sock.sendto(resp, self.client_address)
+
+        self.server = socketserver.ThreadingUDPServer(("127.0.0.1", 0), Handler)
+        self.server.daemon_threads = True
+        self.addr = self.server.server_address  # (host, port)
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    def answer(self, query: bytes) -> bytes:
+        txid, _flags, qd, *_ = struct.unpack_from(">HHHHHH", query, 0)
+        qname, pos = _read_name(query, 12)
+        qtype, _qclass = struct.unpack_from(">HH", query, pos)
+        self.queries.append((qname.lower(), qtype))
+        answers = self.zone.get((qname.lower(), qtype), [])
+
+        # build: header + echoed question + answers (+ SRV glue)
+        offsets: dict[str, int] = {}
+        body = _encode_name(qname, offsets, 12)
+        body += struct.pack(">HH", qtype, 1)
+
+        def rr(name, rtype, rdata_fn):
+            nonlocal body
+            body_local = _encode_name(name, offsets, 12 + len(body))
+            rdata = rdata_fn(12 + len(body) + len(body_local) + 10)
+            body_local += struct.pack(">HHIH", rtype, 1, 5, len(rdata)) + rdata
+            body += body_local
+
+        additional: list[tuple[str, str]] = []
+        for rd in answers:
+            if qtype == TYPE_A:
+                rr(qname, TYPE_A, lambda _pos, ip=rd: socket.inet_aton(ip))
+            elif qtype == TYPE_SRV:
+                prio, weight, port, target = rd
+
+                def srv_rdata(rd_pos, p=prio, w=weight, pt=port, tg=target):
+                    return struct.pack(">HHH", p, w, pt) + _encode_name(
+                        tg, offsets, rd_pos + 6
+                    )
+
+                rr(qname, TYPE_SRV, srv_rdata)
+                for ip in self.zone.get((target.lower().rstrip("."), TYPE_A), []):
+                    additional.append((target, ip))
+        for target, ip in additional:
+            rr(target, TYPE_A, lambda _pos, i=ip: socket.inet_aton(i))
+
+        rcode = 0 if answers else 3  # NXDOMAIN when empty
+        header = struct.pack(
+            ">HHHHHH", txid, 0x8180 | rcode, 1, len(answers), 0, len(additional)
+        )
+        return header + body

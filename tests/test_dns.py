@@ -1,0 +1,192 @@
+"""DNS discovery: wire codec, resolver, spec expansion, memberlist join.
+
+Covers the reference's thanos-DNS-provider role (memberlist join +
+worker→frontend discovery) against a protocol-faithful in-process UDP
+DNS server with name compression and SRV glue records.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import pytest
+
+from tempo_tpu.utils.dns import (
+    TYPE_A,
+    TYPE_SRV,
+    Resolver,
+    encode_query,
+    parse_response,
+)
+
+from tests.fake_dns import FakeDNSServer
+
+ZONE = {
+    ("ingest.example.org", TYPE_A): ["10.0.0.1", "10.0.0.2"],
+    ("_gossip._tcp.example.org", TYPE_SRV): [
+        (0, 50, 7946, "node-a.example.org"),
+        (0, 50, 7947, "node-b.example.org"),
+    ],
+    ("node-a.example.org", TYPE_A): ["10.1.0.1"],
+    ("node-b.example.org", TYPE_A): ["10.1.0.2"],
+}
+
+
+@pytest.fixture()
+def dns():
+    s = FakeDNSServer(ZONE).start()
+    yield s
+    s.stop()
+
+
+def _resolver(dns, **kw):
+    return Resolver(nameserver=dns.addr, timeout_s=1.0, retries=0, **kw)
+
+
+def test_a_lookup_wire(dns):
+    r = _resolver(dns)
+    recs = r.query("ingest.example.org", TYPE_A)
+    assert sorted(p for _, _, _, p in recs) == ["10.0.0.1", "10.0.0.2"]
+
+
+def test_srv_lookup_with_compression_and_glue(dns):
+    r = _resolver(dns)
+    recs = r.query("_gossip._tcp.example.org", TYPE_SRV)
+    assert sorted(p[3] for _, _, _, p in recs) == [
+        "node-a.example.org",
+        "node-b.example.org",
+    ]
+    # glue A records landed in the cache: target resolution needs no
+    # extra query round-trip
+    n_queries = len(dns.queries)
+    assert [p for _, _, _, p in r.query("node-a.example.org", TYPE_A)] == ["10.1.0.1"]
+    assert len(dns.queries) == n_queries
+
+
+def test_resolve_specs(dns):
+    r = _resolver(dns)
+    assert r.resolve_spec("1.2.3.4:7946") == ["1.2.3.4:7946"]
+    assert r.resolve_spec("dns+ingest.example.org:7946") == [
+        "10.0.0.1:7946",
+        "10.0.0.2:7946",
+    ]
+    assert r.resolve_spec("dnssrv+_gossip._tcp.example.org") == [
+        "10.1.0.1:7946",
+        "10.1.0.2:7947",
+    ]
+
+
+def test_resolve_all_skips_failures(dns):
+    r = _resolver(dns)
+    out = r.resolve_all(
+        ["dns+nope.example.org:1", "dns+ingest.example.org:9", "static:5"]
+    )
+    assert out == ["10.0.0.1:9", "10.0.0.2:9", "static:5"]
+
+
+def test_cache_and_stale_on_error(dns):
+    r = _resolver(dns)
+    first = r.resolve_spec("dns+ingest.example.org:7946")
+    n = len(dns.queries)
+    assert r.resolve_spec("dns+ingest.example.org:7946") == first  # cached
+    assert len(dns.queries) == n
+    # server dies → TTL expires → stale answer still served
+    dns.stop()
+    with r._lock:
+        r._cache = {k: (0.0, v[1]) for k, v in r._cache.items()}  # expire all
+    assert r.resolve_spec("dns+ingest.example.org:7946") == first
+
+
+def test_nxdomain_raises(dns):
+    r = _resolver(dns)
+    with pytest.raises((OSError, ValueError)):
+        r.resolve_spec("dnssrv+_missing._tcp.example.org") or (_ for _ in ()).throw(
+            OSError("empty")
+        )
+    # NXDOMAIN on A gives empty record set → empty result, not a crash
+    assert r.resolve_spec("dns+missing.example.org:1") == []
+
+
+def test_malformed_packet_raises_valueerror_not_struct_error(dns):
+    # header promises records the packet doesn't contain — must surface
+    # as ValueError (struct.error would kill the gossip thread)
+    hdr = struct.pack(">HHHHHH", 7, 0x8180, 0, 3, 0, 0)
+    with pytest.raises(ValueError):
+        parse_response(hdr + b"\x00\x00\x01", 7)
+
+
+def test_negative_cache_fast_fails(dns):
+    r = Resolver(nameserver=("127.0.0.1", 1), timeout_s=0.05, retries=0,
+                 neg_ttl_s=30.0)
+    import time as _t
+
+    t0 = _t.monotonic()
+    with pytest.raises(OSError):
+        r.query("x.example.org", TYPE_A)
+    first = _t.monotonic() - t0
+    t0 = _t.monotonic()
+    with pytest.raises(OSError):  # negative-cached: no network wait
+        r.query("x.example.org", TYPE_A)
+    assert _t.monotonic() - t0 < first
+
+
+def test_malformed_join_spec_fails_at_construction():
+    from tempo_tpu.modules.membership import Memberlist
+
+    with pytest.raises(ValueError, match="host:port"):
+        Memberlist("x", "querier", bind="127.0.0.1:0",
+                   join=["dns+gossip.svc"])  # missing :port
+    with pytest.raises(ValueError, match="SRV"):
+        Memberlist("x", "querier", bind="127.0.0.1:0",
+                   join=["dnssrv+_svc._tcp.local:7946"])  # port not allowed
+
+
+def test_txid_mismatch_rejected():
+    q = encode_query("x.example.org", TYPE_A, 42)
+    resp = struct.pack(">HHHHHH", 43, 0x8180, 0, 0, 0, 0)
+    with pytest.raises(ValueError, match="transaction"):
+        parse_response(resp, 42)
+    assert q[:2] == struct.pack(">H", 42)
+
+
+def test_compression_pointer_loop_rejected():
+    # name at offset 12 pointing at itself
+    hdr = struct.pack(">HHHHHH", 1, 0x8180, 0, 1, 0, 0)
+    loop = struct.pack(">H", 0xC00C)
+    msg = hdr + loop + struct.pack(">HHIH", TYPE_A, 1, 5, 4) + b"\x01\x02\x03\x04"
+    with pytest.raises(ValueError):
+        parse_response(msg, 1)
+
+
+def test_memberlist_dns_join(dns):
+    """Two memberlists converge when the seed is a dnssrv+ spec whose SRV
+    targets resolve to the real gossip listener."""
+    import time
+
+    from tempo_tpu.modules.membership import Memberlist
+
+    a = Memberlist("node-a", "ingester", bind="127.0.0.1:0")
+    host, port = a.gossip_addr.rsplit(":", 1)
+    # zone entry pointing at a's real listener
+    dns.zone[("_tempo._tcp.local", TYPE_SRV)] = [(0, 0, int(port), "a.local")]
+    dns.zone[("a.local", TYPE_A)] = [host]
+    b = Memberlist(
+        "node-b", "querier", bind="127.0.0.1:0",
+        join=["dnssrv+_tempo._tcp.local"],
+        resolver=_resolver(dns),
+    )
+    try:
+        deadline = time.time() + 10
+        ids_a = ids_b = set()
+        while time.time() < deadline:
+            b.tick()
+            a.tick()
+            ids_b = {m.id for m in b.members()}
+            ids_a = {m.id for m in a.members()}
+            if "node-a" in ids_b and "node-b" in ids_a:
+                break
+            time.sleep(0.05)
+        assert "node-a" in ids_b and "node-b" in ids_a
+    finally:
+        a.shutdown()
+        b.shutdown()
