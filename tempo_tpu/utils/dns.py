@@ -127,6 +127,14 @@ def _parse_response(msg: bytes, txid: int):
 # resolver
 
 
+def _validate_name(name: str, spec: str) -> None:
+    """The same label rules encode_query enforces — a name that can never
+    be queried must fail at validation time, not per-tick."""
+    for label in name.rstrip(".").split("."):
+        if not 0 < len(label.encode()) < 64:
+            raise ValueError(f"dns spec {spec!r}: bad label {label!r}")
+
+
 def validate_spec(spec: str) -> None:
     """Reject permanently-malformed address specs (a config typo must
     fail at startup, not be silently skipped as a dead seed forever)."""
@@ -137,10 +145,12 @@ def validate_spec(spec: str) -> None:
                 f"dnssrv+ spec takes a bare SRV name (port comes from the "
                 f"record), got {spec!r}"
             )
+        _validate_name(name, spec)
     elif spec.startswith("dns+"):
         host, _, port = spec[len("dns+"):].rpartition(":")
         if not host or not port.isdigit():
             raise ValueError(f"dns+ spec needs host:port, got {spec!r}")
+        _validate_name(host, spec)
 
 
 def default_nameserver() -> tuple[str, int]:
@@ -183,15 +193,21 @@ class Resolver:
             hit = self._cache.get(key)
             if hit and hit[0] > now:
                 return hit[1]
-            if self._neg.get(key, 0) > now and not hit:
+            if self._neg.get(key, 0) > now:
+                # server known-bad: fast-fail, or fast-serve the stale
+                # answer — never pay the wire timeout again within neg_ttl
+                if hit:
+                    return hit[1]
                 raise OSError(f"dns: {qname} lookup failing (negative-cached)")
         try:
             answers, additionals = self._query_wire(qname, qtype)
         except (OSError, ValueError):
+            with self._lock:
+                # deadline stamped AFTER the (possibly seconds-long) wire
+                # attempt, else it can expire before it's ever consulted
+                self._neg[key] = time.monotonic() + self.neg_ttl_s
             if hit:  # stale-on-error
                 return hit[1]
-            with self._lock:
-                self._neg[key] = now + self.neg_ttl_s
             raise
         with self._lock:
             self._neg.pop(key, None)
@@ -220,8 +236,11 @@ class Resolver:
             sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
             try:
                 sock.settimeout(self.timeout_s)
-                sock.sendto(pkt, self.nameserver)
-                resp, _ = sock.recvfrom(4096)
+                # connect() makes the kernel drop datagrams from any
+                # other source — spoofed replies must match addr AND txid
+                sock.connect(self.nameserver)
+                sock.send(pkt)
+                resp = sock.recv(4096)
                 return parse_response(resp, txid)
             except (OSError, ValueError, struct.error) as e:
                 last = e
@@ -239,6 +258,8 @@ class Resolver:
             for _name, _t, _ttl, (_prio, _weight, port, target) in self.query(
                 name, TYPE_SRV
             ):
+                if not target.rstrip("."):
+                    continue  # RFC 2782 root target "." = decidedly unavailable
                 ips = [p for _, t, _, p in self.query(target, TYPE_A) if t == TYPE_A]
                 out.extend(f"{ip}:{port}" for ip in ips)
             return sorted(set(out))

@@ -97,14 +97,46 @@ def test_cache_and_stale_on_error(dns):
     assert r.resolve_spec("dns+ingest.example.org:7946") == first
 
 
-def test_nxdomain_raises(dns):
+def test_nxdomain_returns_empty(dns):
     r = _resolver(dns)
-    with pytest.raises((OSError, ValueError)):
-        r.resolve_spec("dnssrv+_missing._tcp.example.org") or (_ for _ in ()).throw(
-            OSError("empty")
-        )
-    # NXDOMAIN on A gives empty record set → empty result, not a crash
+    # NXDOMAIN parses as an empty answer set → empty result, not a crash
+    assert r.resolve_spec("dnssrv+_missing._tcp.example.org") == []
     assert r.resolve_spec("dns+missing.example.org:1") == []
+
+
+def test_srv_root_target_skipped(dns):
+    dns.zone[("_mixed._tcp.example.org", TYPE_SRV)] = [
+        (0, 0, 7946, "node-a.example.org"),
+        (0, 0, 0, "."),  # RFC 2782: service decidedly unavailable
+    ]
+    r = _resolver(dns)
+    assert r.resolve_spec("dnssrv+_mixed._tcp.example.org") == ["10.1.0.1:7946"]
+
+
+def test_validate_spec_rejects_bad_labels():
+    from tempo_tpu.utils.dns import validate_spec
+
+    with pytest.raises(ValueError, match="label"):
+        validate_spec("dns+gossip..svc:7946")  # empty label
+    with pytest.raises(ValueError, match="label"):
+        validate_spec("dnssrv+_g._tcp." + "x" * 70 + ".org")
+    validate_spec("dns+gossip.svc:7946")  # fine
+
+
+def test_stale_served_fast_while_dns_down(dns):
+    import time as _t
+
+    r = Resolver(nameserver=dns.addr, timeout_s=0.5, retries=0, neg_ttl_s=30.0)
+    first = r.resolve_spec("dns+ingest.example.org:7946")
+    dns.stop()
+    with r._lock:  # expire the positive entries
+        r._cache = {k: (0.0, v[1]) for k, v in r._cache.items()}
+    # first post-outage call pays one timeout and serves stale
+    assert r.resolve_spec("dns+ingest.example.org:7946") == first
+    # second call is negative-cached: stale served with NO wire wait
+    t0 = _t.monotonic()
+    assert r.resolve_spec("dns+ingest.example.org:7946") == first
+    assert _t.monotonic() - t0 < 0.25
 
 
 def test_malformed_packet_raises_valueerror_not_struct_error(dns):
