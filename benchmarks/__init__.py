@@ -1,0 +1,13 @@
+"""Benchmark + load-test harnesses (reference SURVEY.md §4 parity).
+
+  benchmarks/micro.py — the `make benchmark` analog: ingest push rate,
+      WAL append per codec, block write/read per codec, search under
+      concurrent write load, compaction throughput. Each prints a JSON
+      line; `python -m benchmarks.micro` runs all.
+  benchmarks/load.py — the k6 smoke/stress analog: staged virtual users
+      driving the real HTTP API (in-process single binary by default, or
+      --url for a running cluster), with latency thresholds.
+
+The north-star TPU-vs-CPU scan benchmark stays at the repo root
+(bench.py) — the driver runs that one on real hardware.
+"""

@@ -1,0 +1,214 @@
+"""Staged-VU load harness — the k6 smoke/stress analog.
+
+Mirrors the reference's integration/bench (smoke_test.js: concurrent
+write + read + health scenarios with latency thresholds;
+stress_test_write_path.js: staged VU ramp on the write path), driven
+in-process against the real HTTP API by default or against a running
+cluster with --url.
+
+  python -m benchmarks.load smoke   [--vus 4]  [--duration 5]
+  python -m benchmarks.load stress  [--stages 2:5,8:10,2:5] [--url http://...]
+
+Each scenario prints one JSON line with req/s, p50/p99 latencies and
+error rate, and exits non-zero when thresholds fail (k6 semantics):
+smoke: error rate < 1%, write p99 < 500ms; stress: error rate < 5%.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from tempo_tpu.utils.ids import random_trace_id
+from tempo_tpu.utils.test_data import make_trace
+
+
+class Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.lat: list[float] = []
+        self.errors = 0
+
+    def ok(self, dt: float):
+        with self.lock:
+            self.lat.append(dt)
+
+    def err(self):
+        with self.lock:
+            self.errors += 1
+
+    def summary(self) -> dict:
+        with self.lock:
+            lat = sorted(self.lat)
+            n = len(lat)
+            total = n + self.errors
+            pct = lambda p: lat[min(n - 1, int(p * n))] if n else None  # noqa: E731
+            return {
+                "requests": total,
+                "errors": self.errors,
+                "error_rate": self.errors / total if total else 0.0,
+                "p50_ms": round(pct(0.50) * 1000, 1) if n else None,
+                "p99_ms": round(pct(0.99) * 1000, 1) if n else None,
+            }
+
+
+class Target:
+    """HTTP target; spins an in-process single binary unless url given."""
+
+    def __init__(self, url: str | None):
+        self._own = None
+        self._tmp = None
+        if url:
+            self.url = url.rstrip("/")
+            return
+        from tempo_tpu.api.http import HTTPApi, serve_http
+        from tempo_tpu.modules import App, AppConfig
+
+        self._tmp = tempfile.mkdtemp()
+        self.app = App(AppConfig(wal_dir=os.path.join(self._tmp, "wal")))
+        self.server = serve_http(HTTPApi(self.app), host="127.0.0.1", port=0)
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        self._own = True
+
+    def close(self):
+        if self._own:
+            self.server.shutdown()
+            self.app.shutdown()
+            shutil.rmtree(self._tmp, ignore_errors=True)
+
+
+def _request(url: str, data: bytes | None = None, headers: dict | None = None,
+             timeout: float = 10.0) -> bytes:
+    req = urllib.request.Request(url, data=data, method="POST" if data else "GET")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def _vu_loop(target: Target, stats: dict, stop: threading.Event, vu_id: int,
+             write_only: bool = False):
+    """One virtual user: push a trace, read it back, hit /ready —
+    the smoke_test.js scenario body."""
+    tenant = "load"
+    hdr = {"X-Scope-OrgID": tenant,
+           "Content-Type": "application/x-protobuf"}
+    rng = random.Random(vu_id)
+    written: list[bytes] = []
+    while not stop.is_set():
+        tid = random_trace_id()
+        body = make_trace(tid, seed=rng.randrange(1 << 30)).SerializeToString()
+        t0 = time.perf_counter()
+        try:
+            _request(f"{target.url}/v1/traces", data=body, headers=hdr)
+            stats["write"].ok(time.perf_counter() - t0)
+            written.append(tid)
+        except (urllib.error.URLError, OSError):
+            stats["write"].err()
+        if write_only:
+            continue
+        if written and rng.random() < 0.5:
+            rtid = rng.choice(written[-50:])
+            t0 = time.perf_counter()
+            try:
+                _request(f"{target.url}/api/traces/{rtid.hex()}", headers=hdr)
+                stats["read"].ok(time.perf_counter() - t0)
+            except (urllib.error.URLError, OSError):
+                stats["read"].err()
+        t0 = time.perf_counter()
+        try:
+            _request(f"{target.url}/ready")
+            stats["health"].ok(time.perf_counter() - t0)
+        except (urllib.error.URLError, OSError):
+            stats["health"].err()
+
+
+def run_smoke(target: Target, vus: int, duration_s: float) -> int:
+    stats = {k: Stats() for k in ("write", "read", "health")}
+    stop = threading.Event()
+    threads = [threading.Thread(target=_vu_loop, args=(target, stats, stop, i),
+                                daemon=True) for i in range(vus)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    wall = time.perf_counter() - t0
+    out = {"scenario": "smoke", "vus": vus, "duration_s": round(wall, 1)}
+    for k, s in stats.items():
+        out[k] = s.summary()
+    total_reqs = sum(out[k]["requests"] for k in stats)
+    out["rps"] = round(total_reqs / wall, 1)
+    w = out["write"]
+    passed = (w["error_rate"] < 0.01
+              and (w["p99_ms"] is not None and w["p99_ms"] < 500))
+    out["passed"] = passed
+    print(json.dumps(out), flush=True)
+    return 0 if passed else 1
+
+
+def run_stress(target: Target, stages: list[tuple[int, float]]) -> int:
+    """Staged write-path ramp: [(vus, seconds), ...]."""
+    stats = {"write": Stats(), "read": Stats(), "health": Stats()}
+    stop = threading.Event()
+    threads: list[threading.Thread] = []
+    t0 = time.perf_counter()
+    for vus, secs in stages:
+        while len(threads) < vus:
+            t = threading.Thread(
+                target=_vu_loop,
+                args=(target, stats, stop, len(threads)),
+                kwargs={"write_only": True}, daemon=True)
+            t.start()
+            threads.append(t)
+        time.sleep(secs)  # VUs never scale down mid-run (k6 keeps them)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    wall = time.perf_counter() - t0
+    w = stats["write"].summary()
+    out = {"scenario": "stress_write_path",
+           "peak_vus": max(v for v, _ in stages),
+           "duration_s": round(wall, 1),
+           "write": w,
+           "rps": round(w["requests"] / wall, 1),
+           "passed": w["error_rate"] < 0.05}
+    print(json.dumps(out), flush=True)
+    return 0 if out["passed"] else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tempo-tpu load harness")
+    p.add_argument("scenario", choices=["smoke", "stress"])
+    p.add_argument("--url", default=None,
+                   help="target base URL (default: in-process single binary)")
+    p.add_argument("--vus", type=int, default=4)
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--stages", default="2:3,6:5,2:3",
+                   help="stress stages vus:seconds,...")
+    args = p.parse_args(argv)
+    target = Target(args.url)
+    try:
+        if args.scenario == "smoke":
+            return run_smoke(target, args.vus, args.duration)
+        stages = [(int(v), float(s)) for v, s in
+                  (part.split(":") for part in args.stages.split(","))]
+        return run_stress(target, stages)
+    finally:
+        target.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

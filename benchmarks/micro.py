@@ -1,0 +1,192 @@
+"""Micro-benchmarks — the reference's Go bench suite, re-hosted.
+
+Mirrors (SURVEY.md §4 / §6):
+  ingest push rate            modules/ingester/instance_test.go:632-656
+  WAL append                  tempodb/wal/wal_test.go:473-490
+  block write/read per codec  encoding/v2/streaming_block_test.go:298-331
+  search under write load     modules/ingester/instance_search_test.go:401
+  compaction throughput       tempodb/compactor_test.go:610
+
+Each benchmark prints one JSON line:
+  {"bench": "...", "value": N, "unit": "..."}
+Run all: python -m benchmarks.micro [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+from tempo_tpu import tempopb
+from tempo_tpu.utils.ids import random_trace_id
+from tempo_tpu.utils.test_data import make_trace
+
+CODECS = ("none", "snappy", "lz4", "zstd", "gzip")
+
+
+def _emit(bench: str, value: float, unit: str, **extra):
+    print(json.dumps({"bench": bench, "value": round(value, 1),
+                      "unit": unit, **extra}), flush=True)
+
+
+def _objects(n, seed0=0, start=1_600_000_000):
+    """[(trace_id, v2-object-bytes)], sorted by id."""
+    from tempo_tpu.model import codec_for
+
+    codec = codec_for("v2")
+    out = []
+    for i in range(n):
+        tid = random_trace_id()
+        tr = make_trace(tid, seed=seed0 + i, batches=1, spans_per_batch=4)
+        out.append((tid, codec.marshal(tr, start + i % 600, start + i % 600 + 5)))
+    return sorted(out)
+
+
+def bench_ingest_push(n=2000):
+    """Distributor→ingester push hot path (spans/s)."""
+    from tempo_tpu.modules import App, AppConfig
+
+    tmp = tempfile.mkdtemp()
+    app = App(AppConfig(wal_dir=os.path.join(tmp, "wal")))
+    traces = [make_trace(random_trace_id(), seed=i) for i in range(n)]
+    n_spans = sum(len(ss.spans) for t in traces for rs in t.batches
+                  for ss in rs.scope_spans)
+    t0 = time.perf_counter()
+    for tr in traces:
+        app.push("bench", list(tr.batches))
+    dt = time.perf_counter() - t0
+    app.shutdown()
+    shutil.rmtree(tmp, ignore_errors=True)
+    _emit("ingest_push", n_spans / dt, "spans/s", traces=n)
+
+
+def bench_wal_append(n=500):
+    """WAL append throughput (MiB/s of object bytes; the WAL is
+    deliberately append-plain — page compression happens at block
+    completion, so there is no per-codec axis here)."""
+    from tempo_tpu.wal import WAL
+
+    objs = _objects(n)
+    total = sum(len(b) for _, b in objs)
+    tmp = tempfile.mkdtemp()
+    try:
+        wal = WAL(tmp)
+        blk = wal.new_block("bench")
+        t0 = time.perf_counter()
+        for tid, b in objs:
+            blk.append(tid, b)
+        dt = time.perf_counter() - t0
+        _emit("wal_append", total / dt / (1 << 20), "MiB/s", objects=n)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_block_write_read(n=500):
+    """Streaming-block write + full iterate read per codec (MiB/s)."""
+    from tempo_tpu.backend import BlockMeta, open_backend
+    from tempo_tpu.encoding.v2 import BackendBlock, StreamingBlock
+
+    objs = _objects(n)
+    total = sum(len(b) for _, b in objs)
+    for enc in CODECS:
+        backend = open_backend({"backend": "memory"})
+        sb = StreamingBlock(BlockMeta(tenant_id="bench", encoding=enc))
+        t0 = time.perf_counter()
+        for i, (tid, b) in enumerate(objs):
+            sb.add_object(tid, b, start=1000 + i, end=1100 + i)
+        meta = sb.complete(backend)
+        wdt = time.perf_counter() - t0
+        blk = BackendBlock(backend, meta)
+        t0 = time.perf_counter()
+        m = sum(1 for _ in blk.iter_objects())
+        rdt = time.perf_counter() - t0
+        assert m == len(objs)
+        _emit("block_write", total / wdt / (1 << 20), "MiB/s", codec=enc)
+        _emit("block_read", total / rdt / (1 << 20), "MiB/s", codec=enc)
+
+
+def bench_search_under_write_load(n_seed=1000, writers=2, duration_s=2.0):
+    """Search QPS while concurrent pushes hammer the same instance."""
+    from tempo_tpu.modules import App, AppConfig
+
+    tmp = tempfile.mkdtemp()
+    app = App(AppConfig(wal_dir=os.path.join(tmp, "wal")))
+    for i in range(n_seed):
+        app.push("bench", list(make_trace(random_trace_id(), seed=i).batches))
+    stop = threading.Event()
+
+    def writer(k):
+        i = 0
+        while not stop.is_set():
+            tr = make_trace(random_trace_id(), seed=10_000 + k * 100_000 + i)
+            app.push("bench", list(tr.batches))
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(k,), daemon=True)
+               for k in range(writers)]
+    for t in threads:
+        t.start()
+    req = tempopb.SearchRequest()
+    req.limit = 20
+    queries = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < duration_s:
+        app.search("bench", req)
+        queries += 1
+    dt = time.perf_counter() - t0
+    stop.set()
+    for t in threads:
+        t.join(timeout=2)
+    app.shutdown()
+    shutil.rmtree(tmp, ignore_errors=True)
+    _emit("search_under_write_load", queries / dt, "queries/s",
+          concurrent_writers=writers)
+
+
+def bench_compaction(n=2000, n_blocks=4):
+    """K-way merge compaction throughput (MiB/s of input bytes)."""
+    from tempo_tpu.backend import open_backend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+
+    tmp = tempfile.mkdtemp()
+    backend = open_backend({"backend": "memory"})
+    db = TempoDB(backend, os.path.join(tmp, "wal"), TempoDBConfig())
+    per = n // n_blocks
+    now = int(time.time())
+    for b in range(n_blocks):
+        blk = db.wal.new_block("bench")
+        for tid, obj in _objects(per, seed0=b * per, start=now - 300):
+            blk.append(tid, obj)
+        db.complete_block(blk)
+    db.poll()
+    metas = db.blocklist.metas("bench")
+    total = sum(m.size for m in metas)
+    t0 = time.perf_counter()
+    out = db.compact_tenant_once("bench")
+    dt = time.perf_counter() - t0
+    assert out is not None, "selector found nothing to compact"
+    _emit("compaction", total / dt / (1 << 20), "MiB/s",
+          input_blocks=len(metas))
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(quick: bool = False):
+    scale = 0.1 if quick else 1.0
+    bench_ingest_push(n=int(2000 * scale) or 50)
+    bench_wal_append(n=int(500 * scale) or 20)
+    bench_block_write_read(n=int(500 * scale) or 20)
+    bench_search_under_write_load(
+        n_seed=int(1000 * scale) or 30,
+        duration_s=0.5 if quick else 2.0,
+    )
+    bench_compaction(n=int(2000 * scale) or 40)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(quick="--quick" in sys.argv)

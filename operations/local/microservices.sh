@@ -5,39 +5,44 @@
 #
 #   sh operations/local/microservices.sh /tmp/tempo-playground
 #
-# Then: curl -X POST localhost:3200/v1/traces ... ; curl localhost:3200/api/search?...
+# Then: curl -X POST localhost:3200/v1/traces ... ; curl "localhost:3203/api/search?..."
 set -e
 ROOT=${1:-/tmp/tempo-tpu-playground}
 REPO=$(cd "$(dirname "$0")/../.." && pwd)
 mkdir -p "$ROOT"
-cat > "$ROOT/tempo.yaml" <<EOF
+
+# each process needs its own gossip bind; all join the first three seeds
+mkconfig() { # target gossip_port
+  cat > "$ROOT/$1.yaml" <<EOF
 server:
-  http_port: 3200
-  grpc_port: 9095
+  http_port: 0
+  grpc_port: 0
 storage:
   backend: local
   local: {path: $ROOT/blocks}
-  wal_dir: $ROOT/wal
+  wal_dir: $ROOT/wal-$1
 ingester:
   replication_factor: 1
 memberlist:
-  bind: "127.0.0.1:7946"
+  bind: "127.0.0.1:$2"
   join: ["127.0.0.1:7946", "127.0.0.1:7947", "127.0.0.1:7948"]
 EOF
-
-run() { # target http grpc gossip
-  PYTHONPATH="$REPO:$PYTHONPATH" python -m tempo_tpu.cli.main \
-    -config.file "$ROOT/tempo.yaml" -target "$1" \
-    -http-port "$2" -grpc-port "$3" -instance-id "$1-local" \
-    > "$ROOT/$1.log" 2>&1 &
-  echo "$1 pid $! (http :$2, logs $ROOT/$1.log)"
 }
 
-run distributor 3200 9095
-run ingester 3201 9096
-run querier 3202 9097
-run query-frontend 3203 9098
-run compactor 3204 9099
+run() { # target http grpc gossip
+  mkconfig "$1" "$4"
+  PYTHONPATH="$REPO:$PYTHONPATH" python -m tempo_tpu.cli.main \
+    -config.file "$ROOT/$1.yaml" -target "$1" \
+    -http-port "$2" -grpc-port "$3" -instance-id "$1-local" \
+    > "$ROOT/$1.log" 2>&1 &
+  echo "$1 pid $! (http :$2, gossip :$4, logs $ROOT/$1.log)"
+}
+
+run distributor 3200 9095 7946
+run ingester 3201 9096 7947
+run querier 3202 9097 7948
+run query-frontend 3203 9098 7949
+run compactor 3204 9099 7950
 echo "frontend API: http://127.0.0.1:3203  (OTLP gRPC ingest: 127.0.0.1:9095)"
 echo "stop: pkill -f tempo_tpu.cli.main"
 wait

@@ -1,0 +1,39 @@
+"""The bench/load harnesses must stay runnable (SURVEY.md §4 load/perf
+parity) — tiny-scale executions asserting shape, not speed."""
+
+from __future__ import annotations
+
+import json
+
+
+def test_micro_benchmarks_run(capsys):
+    from benchmarks import micro
+
+    micro.bench_wal_append(n=20)
+    micro.bench_block_write_read(n=20)
+    micro.bench_compaction(n=40, n_blocks=4)
+    lines = [json.loads(ln) for ln in capsys.readouterr().out.strip().splitlines()]
+    benches = {ln["bench"] for ln in lines}
+    assert {"wal_append", "block_write", "block_read", "compaction"} <= benches
+    assert all(ln["value"] > 0 for ln in lines)
+    codecs = {ln.get("codec") for ln in lines if "codec" in ln}
+    assert {"none", "snappy", "lz4", "zstd", "gzip"} == codecs
+
+
+def test_load_smoke_scenario(capsys):
+    from benchmarks import load
+
+    rc = load.main(["smoke", "--vus", "2", "--duration", "1.5"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["passed"]
+    assert out["write"]["requests"] > 0 and out["write"]["error_rate"] == 0.0
+    assert out["read"]["requests"] > 0  # reads verified against writes
+
+
+def test_load_stress_scenario(capsys):
+    from benchmarks import load
+
+    rc = load.main(["stress", "--stages", "1:1,3:1"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["passed"]
+    assert out["peak_vus"] == 3 and out["write"]["requests"] > 0
