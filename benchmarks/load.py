@@ -112,7 +112,8 @@ def _vu_loop(target: Target, stats: dict, stop: threading.Event, vu_id: int,
         try:
             _request(f"{target.url}/v1/traces", data=body, headers=hdr)
             stats["write"].ok(time.perf_counter() - t0)
-            written.append(tid)
+            if not write_only:  # stress mode never reads these back
+                written.append(tid)
         except (urllib.error.URLError, OSError):
             stats["write"].err()
         if write_only:
@@ -152,8 +153,11 @@ def run_smoke(target: Target, vus: int, duration_s: float) -> int:
     total_reqs = sum(out[k]["requests"] for k in stats)
     out["rps"] = round(total_reqs / wall, 1)
     w = out["write"]
+    # a broken read or health path must fail the smoke run too
     passed = (w["error_rate"] < 0.01
-              and (w["p99_ms"] is not None and w["p99_ms"] < 500))
+              and (w["p99_ms"] is not None and w["p99_ms"] < 500)
+              and out["read"]["error_rate"] < 0.01
+              and out["health"]["error_rate"] < 0.01)
     out["passed"] = passed
     print(json.dumps(out), flush=True)
     return 0 if passed else 1

@@ -27,7 +27,8 @@ def test_load_smoke_scenario(capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0 and out["passed"]
     assert out["write"]["requests"] > 0 and out["write"]["error_rate"] == 0.0
-    assert out["read"]["requests"] > 0  # reads verified against writes
+    assert out["read"]["requests"] > 0
+    assert out["read"]["error_rate"] == 0.0  # reads really succeeded
 
 
 def test_load_stress_scenario(capsys):
