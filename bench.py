@@ -113,9 +113,16 @@ def _timed_rate(enqueue_fn, fetch_fn, n_entries, iters):
         fetch_fn(out)
         return time.perf_counter() - t0
 
-    t_one = run(1)
-    t_many = run(iters + 1)
-    per_iter = max((t_many - t_one) / iters, 1e-9)
+    # adaptive: grow the batch until the measured delta clears the relay
+    # noise floor, else tiny per-iter times under-resolve to garbage
+    while True:
+        t_one = run(1)
+        t_many = run(iters + 1)
+        delta = t_many - t_one
+        if delta > 0.05 or iters >= 4096:
+            break
+        iters *= 4
+    per_iter = max(delta / iters, 1e-9)
     return n_entries / per_iter
 
 

@@ -118,12 +118,13 @@ class DistributedScanEngine:
         k = self.top_k
         while k < cq.limit:
             k *= 2
+        from tempo_tpu.search.engine import ScanEngine
+
+        tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(cq)
         count, inspected, scores, idx = self._dist_kernel(
             d["kv_key"], d["kv_val"],
             d["entry_start"], d["entry_end"], d["entry_dur"], d["entry_valid"],
-            jnp.asarray(cq.term_keys), jnp.asarray(cq.val_ranges),
-            jnp.uint32(cq.dur_lo), jnp.uint32(min(cq.dur_hi, 0xFFFFFFFF)),
-            jnp.uint32(cq.win_start), jnp.uint32(min(cq.win_end, 0xFFFFFFFF)),
+            tk, vr, dlo, dhi, ws, we,
             n_terms=cq.n_terms, top_k=k,
         )
         return int(count), int(inspected), np.asarray(scores), np.asarray(idx)

@@ -197,12 +197,15 @@ class MultiBlockEngine:
         while k < mq.limit:
             k *= 2
         d = batch.device
+        # params uploaded once per MultiQuery (duck-typed: MultiQuery has
+        # the same param attributes CompiledQuery has)
+        from .engine import ScanEngine
+
+        tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(mq)
         return multi_scan_kernel(
             d["kv_key"], d["kv_val"], d["entry_start"], d["entry_end"],
             d["entry_dur"], d["entry_valid"], d["page_block"],
-            jnp.asarray(mq.term_keys), jnp.asarray(mq.val_ranges),
-            jnp.uint32(mq.dur_lo), jnp.uint32(min(mq.dur_hi, UINT32_MAX)),
-            jnp.uint32(mq.win_start), jnp.uint32(min(mq.win_end, UINT32_MAX)),
+            tk, vr, dlo, dhi, ws, we,
             n_terms=mq.n_terms, top_k=k,
         )
 

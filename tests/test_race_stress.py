@@ -221,11 +221,22 @@ def test_gossip_merge_during_ticks():
 
         return run
 
+    high_water: dict[tuple[str, str], int] = {}
+    hw_lock = threading.Lock()
+
     def read(ml):
         def run():
             while not h.stop.is_set():
-                ms = ml.members(alive_only=False)
-                assert len({m.id for m in ms}) == len(ms)
+                for m in ml.members(alive_only=False):
+                    key = (ml.id, m.id)
+                    with hw_lock:
+                        prev = high_water.get(key, 0)
+                        # a torn merge would let a member's heartbeat
+                        # counter go backwards on this node's view
+                        assert m.heartbeat >= prev, (
+                            f"{key}: heartbeat regressed {prev}→{m.heartbeat}"
+                        )
+                        high_water[key] = m.heartbeat
 
         return run
 
