@@ -123,7 +123,7 @@ class HTTPApi:
 
             return 200, REGISTRY.expose()
         if path == "/status" or path.startswith("/status/"):
-            return 200, self._status(path)
+            return 200, self._status(path, query)
         if path == "/flush":
             completed = self.app.flush_tick(force=True)
             return 200, {"completed_blocks": len(completed)}
@@ -178,8 +178,11 @@ class HTTPApi:
             return 200, data
         return 404, {"error": f"no jaeger route {sub}"}
 
-    def _status(self, path) -> dict:
+    def _status(self, path, query: dict | None = None) -> dict:
         app = self.app
+        if path == "/status/config":
+            # reference /status/config?mode=diff|defaults (app.go:332-378)
+            return self._status_config((query or {}).get("mode", ""))
         out = {
             "ready": app.ready(),
             "ring": {
@@ -194,6 +197,57 @@ class HTTPApi:
             out["blocks"] = {t: len(db.blocklist.metas(t))
                              for t in db.blocklist.tenants()}
         return out
+
+    _SECRET_KEY_RE = None  # compiled lazily below
+
+    @classmethod
+    def _redact(cls, node):
+        """Secrets must not leak on the tenant-facing port: any key that
+        looks credential-bearing gets its whole value replaced."""
+        import re
+
+        if cls._SECRET_KEY_RE is None:
+            cls._SECRET_KEY_RE = re.compile(
+                r"secret|password|token|credential|authorization|headers"
+                r"|access_key|account_key|sasl", re.I)
+        if isinstance(node, dict):
+            return {
+                k: ("<redacted>" if cls._SECRET_KEY_RE.search(str(k))
+                    else cls._redact(v))
+                for k, v in node.items()
+            }
+        if isinstance(node, list):
+            return [cls._redact(v) for v in node]
+        return node
+
+    def _status_config(self, mode: str) -> dict:
+        """Running config as a dict (secrets redacted); mode=defaults
+        shows the built-in defaults, mode=diff only the changed keys."""
+        import dataclasses
+
+        def to_dict(cfg):
+            return self._redact(dataclasses.asdict(cfg))
+
+        from tempo_tpu.modules import AppConfig
+
+        current = to_dict(self.app.cfg)
+        if mode == "defaults":
+            return to_dict(AppConfig())
+        if mode == "diff":
+            def diff(cur, dfl):
+                out = {}
+                for k, cv in cur.items():
+                    dv = dfl.get(k) if isinstance(dfl, dict) else None
+                    if isinstance(cv, dict) and isinstance(dv, dict):
+                        sub = diff(cv, dv)
+                        if sub:
+                            out[k] = sub
+                    elif cv != dv:
+                        out[k] = cv
+                return out
+
+            return diff(current, to_dict(AppConfig()))
+        return current
 
 
 def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):

@@ -125,7 +125,10 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest) -> Mu
     ]
     if all(cq is None for cq in per_block):
         return None
-    T = len(req.tags)
+    # term count comes from the compiled queries, not len(req.tags):
+    # the exhaustive debug tag compiles to ZERO terms — counting raw tags
+    # would leave an unmatchable -1 key per block and invert its meaning
+    T = max((cq.n_terms for cq in per_block if cq is not None), default=0)
     B = len(blocks)
     rmax = 1
     for cq in per_block:

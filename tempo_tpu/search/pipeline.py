@@ -22,6 +22,16 @@ from tempo_tpu import tempopb
 INT32_SENTINEL = np.int32(2**31 - 1)
 UINT32_MAX = 0xFFFFFFFF
 
+# Hidden debug flag (reference tempodb/search/pipeline.go:14
+# SecretExhaustiveSearchTag): a request carrying this tag bypasses block
+# pruning and tag predicates entirely — every valid entry matches (modulo
+# duration/time filters). In-band, undocumented, for benchmarking scans.
+EXHAUSTIVE_SEARCH_TAG = "x-dbg-exhaustive"
+
+
+def is_exhaustive(req: tempopb.SearchRequest) -> bool:
+    return EXHAUSTIVE_SEARCH_TAG in req.tags
+
 
 @dataclass
 class CompiledQuery:
@@ -58,6 +68,8 @@ def ids_to_ranges(ids: np.ndarray) -> np.ndarray:
 def matches_block_header(header: dict, req: tempopb.SearchRequest) -> bool:
     """Block-level pruning from the search header rollup (time range and
     duration bounds)."""
+    if is_exhaustive(req):
+        return True  # debug flag: never prune
     if req.start and header.get("max_end_s", UINT32_MAX) < req.start:
         return False
     if req.end and header.get("min_start_s", 0) > req.end:
@@ -112,7 +124,10 @@ def compile_query(key_dict: list, val_dict: list,
     the key dictionary, or no dictionary value satisfies a term)."""
     term_key_ids = []
     term_val_sets = []
+    exhaustive = is_exhaustive(req)
     for k, v in sorted(req.tags.items()):
+        if exhaustive:
+            break  # scan-everything: no tag predicates, no pruning
         i = bisect.bisect_left(key_dict, k)
         if i >= len(key_dict) or key_dict[i] != k:
             return None

@@ -270,3 +270,90 @@ def test_http_body_limits(app):
         conn.close()
     finally:
         server.shutdown()
+
+
+def test_status_config_modes(app):
+    api = HTTPApi(app)
+    code, full = api.handle("GET", "/status/config", {}, {})
+    assert code == 200 and full["wal_dir"] == app.cfg.wal_dir
+    code, defaults = api.handle("GET", "/status/config", {"mode": "defaults"}, {})
+    assert code == 200 and defaults["wal_dir"] == "./wal"
+    code, diff = api.handle("GET", "/status/config", {"mode": "diff"}, {})
+    assert code == 200
+    # only the overridden keys appear in the diff
+    assert diff["wal_dir"] == app.cfg.wal_dir
+    assert "replication_factor" not in diff
+
+
+def test_exhaustive_debug_tag(app):
+    """Hidden debug flag (reference SecretExhaustiveSearchTag): bypasses
+    pruning and tag predicates — everything matches."""
+    from tempo_tpu.search.pipeline import EXHAUSTIVE_SEARCH_TAG
+
+    tids = [random_trace_id() for _ in range(5)]
+    for i, tid in enumerate(tids):
+        app.push("t1", list(make_trace(tid, seed=i).batches))
+    app.flush_tick(force=True)
+    app.poll_tick()
+
+    narrow = _mk_req({"service.name": "no-such-service-anywhere"})
+    narrow.limit = 50
+    assert len(app.search("t1", narrow).traces) == 0
+
+    dbg = _mk_req({EXHAUSTIVE_SEARCH_TAG: "1",
+                   "service.name": "no-such-service-anywhere"})
+    dbg.limit = 50
+    resp = app.search("t1", dbg)
+    assert len(resp.traces) == len(tids)  # pruning + predicates bypassed
+
+
+def test_status_config_redacts_secrets(tmp_path):
+    app2 = App(AppConfig(
+        wal_dir=str(tmp_path / "wal2"),
+        backend={"backend": "memory",
+                 "s3": {"bucket": "b", "secret_key": "sssh", "access_key": "ak"}},
+        metrics_generator={"remote_write": {
+            "url": "http://mim/push",
+            "headers": {"Authorization": "Bearer tok"}}},
+    ))
+    api = HTTPApi(app2)
+    _, full = api.handle("GET", "/status/config", {}, {})
+    s3 = full["backend"]["s3"]
+    assert s3["secret_key"] == "<redacted>" and s3["access_key"] == "<redacted>"
+    assert s3["bucket"] == "b"
+    rw = full["metrics_generator"]["remote_write"]
+    assert rw["headers"] == "<redacted>" and rw["url"] == "http://mim/push"
+    _, diff = api.handle("GET", "/status/config", {"mode": "diff"}, {})
+    assert "sssh" not in str(diff) and "tok" not in str(diff)
+    app2.shutdown()
+
+
+def test_exhaustive_tag_multiblock():
+    """The debug tag must mean 'everything' through the multi-block
+    engine too (term count from compiled queries, not raw tags)."""
+    import numpy as np
+
+    from tempo_tpu.search.multiblock import compile_multi
+    from tempo_tpu.search.pipeline import EXHAUSTIVE_SEARCH_TAG
+
+    from tempo_tpu.search.columnar import ColumnarPages
+    from tempo_tpu.search.data import SearchData
+    import os as _os
+
+    entries = []
+    for i in range(8):
+        sd = SearchData(trace_id=_os.urandom(16))
+        sd.start_s, sd.end_s, sd.dur_ms = 100 + i, 105 + i, 50
+        sd.kvs = {"service.name": {"svc"}}
+        entries.append(sd)
+    pages = ColumnarPages.build(entries)
+    req = _mk_req({EXHAUSTIVE_SEARCH_TAG: "1",
+                   "service.name": "no-such-service"})
+    mq = compile_multi([pages], req)
+    assert mq is not None and mq.n_terms == 0
+    # and the kernel really matches everything
+    from tempo_tpu.search.multiblock import MultiBlockEngine, stack_blocks
+
+    batch = stack_blocks([pages])
+    count, inspected, _, _ = MultiBlockEngine().scan(batch, mq)
+    assert count == 8 == inspected

@@ -289,7 +289,7 @@ memberlist:
             except Exception:
                 return False
 
-        wait_for(found, timeout_s=30, interval_s=0.5,
+        wait_for(found, timeout_s=60, interval_s=0.5,
                  what="trace via frontend")
 
         # flush + backend search
@@ -307,7 +307,7 @@ memberlist:
             except Exception:
                 return False
 
-        wait_for(searched, timeout_s=30, interval_s=0.5,
+        wait_for(searched, timeout_s=60, interval_s=0.5,
                  what="backend search via frontend")
     finally:
         for p in procs:

@@ -227,7 +227,9 @@ def test_gossip_merge_during_ticks():
     def read(ml):
         def run():
             while not h.stop.is_set():
-                for m in ml.members(alive_only=False):
+                ms = ml.members(alive_only=False)
+                assert len({m.id for m in ms}) == len(ms), "duplicate member"
+                for m in ms:
                     key = (ml.id, m.id)
                     with hw_lock:
                         prev = high_water.get(key, 0)
