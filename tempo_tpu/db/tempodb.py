@@ -19,6 +19,7 @@ from tempo_tpu.backend.types import BlockMeta
 from tempo_tpu.encoding.v2 import BackendBlock, StreamingBlock
 from tempo_tpu.model.codec import codec_for
 from tempo_tpu.search import SearchResults, write_search_block
+from tempo_tpu.search.pipeline import matches_block_header
 from tempo_tpu.search.backend_search_block import BackendSearchBlock
 from tempo_tpu.search.columnar import PageGeometry
 from tempo_tpu.search.engine import ScanEngine
@@ -48,6 +49,8 @@ class TempoDBConfig:
     search_geometry: PageGeometry = field(default_factory=PageGeometry)
     tenant_index_builder: bool = True
     search_cache_blocks: int = 64         # staged (HBM) blocks kept hot
+    search_prefetch_blocks: int = 2       # blocks staged ahead of the scan
+                                          # (0 = stage synchronously)
 
 
 class TempoDB:
@@ -179,11 +182,14 @@ class TempoDB:
         results = results or SearchResults(limit=req.limit or 20)
         with obs.query_seconds.time(op="search"), \
                 tracing.start_span("tempodb.Search", tenant=tenant) as span:
+            metas = []
             for m in self.blocklist.metas(tenant):
                 if not self._include_block(m, "", "", req.start, req.end):
                     results.metrics.skipped_blocks += 1
                     continue
-                self._search_block_for(m).search(req, results, engine=self.engine)
+                metas.append(m)
+            for bsb in self._staged_blocks(metas, req):
+                bsb.search(req, results, engine=self.engine)
                 if results.complete:
                     break
             span.set_attributes(
@@ -192,6 +198,67 @@ class TempoDB:
                 skipped_blocks=results.metrics.skipped_blocks)
         obs.search_inspected.inc(results.metrics.inspected_traces, tenant=tenant)
         return results
+
+    def _staged_blocks(self, metas, req=None):
+        """Yield search blocks with staging (IO + decompress + H2D
+        dispatch) pipelined N blocks ahead of the scan — the SURVEY §7
+        double-buffering requirement: while the device scans block i, the
+        host prepares block i+1..i+N so the TPU never starves on IO.
+        Depth 0 falls back to synchronous staging."""
+        depth = self.cfg.search_prefetch_blocks
+        if depth <= 0 or len(metas) <= 1:
+            for m in metas:
+                yield self._search_block_for(m)
+            return
+
+        import queue as _queue
+
+        q: _queue.Queue = _queue.Queue(maxsize=depth)
+        stop = threading.Event()
+
+        def producer():
+            for m in metas:
+                if stop.is_set():
+                    return
+                try:
+                    bsb = self._search_block_for(m)
+                    # stage only blocks the header rollup can't prune —
+                    # bsb.search re-checks and skips without staging
+                    if req is None or matches_block_header(bsb.header(), req):
+                        bsb.staged()  # async H2D dispatch happens here
+                    item = (bsb, None)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    item = (None, e)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+                if item[1] is not None:
+                    return
+            if not stop.is_set():
+                try:
+                    q.put(None, timeout=1.0)
+                except _queue.Full:
+                    pass
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="search-prefetch")
+        t.start()
+        served = 0
+        try:
+            while served < len(metas):
+                item = q.get()
+                if item is None:
+                    return
+                bsb, err = item
+                if err is not None:
+                    raise err
+                served += 1
+                yield bsb
+        finally:
+            stop.set()
 
     def search_block(self, req: tempopb.SearchBlockRequest) -> SearchResults:
         """One search job (the SearchBlockRequest protocol unit). The block

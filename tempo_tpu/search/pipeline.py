@@ -124,10 +124,9 @@ def compile_query(key_dict: list, val_dict: list,
     the key dictionary, or no dictionary value satisfies a term)."""
     term_key_ids = []
     term_val_sets = []
-    exhaustive = is_exhaustive(req)
-    for k, v in sorted(req.tags.items()):
-        if exhaustive:
-            break  # scan-everything: no tag predicates, no pruning
+    # exhaustive debug flag: no tag predicates, no pruning — zero terms
+    terms = [] if is_exhaustive(req) else sorted(req.tags.items())
+    for k, v in terms:
         i = bisect.bisect_left(key_dict, k)
         if i >= len(key_dict) or key_dict[i] != k:
             return None

@@ -227,3 +227,63 @@ def test_retention_two_phase(tmp_path):
     marked2, deleted2 = db.retain_tenant("t1", now_s=cm.compacted_time + 1000)
     assert deleted2 == 1
     assert db.backend.list_blocks("t1") == []
+
+
+def test_search_prefetch_pipeline(tmp_path):
+    """Prefetched staging returns identical results to synchronous, does
+    not stage header-pruned blocks, and bounds read-ahead on early stop."""
+    from tempo_tpu.search.backend_search_block import BackendSearchBlock
+
+    db = _db(tmp_path, search_prefetch_blocks=2)
+    for b in range(5):
+        _ingest(db, "t1", 6, seed_base=b * 100)
+    db.poll()
+    assert len(db.blocklist.metas("t1")) == 5
+
+    req = _mk_req({})
+    req.limit = 1000
+    staged_calls = []
+    orig = BackendSearchBlock.staged
+
+    def counting(self):
+        staged_calls.append(self.meta.block_id)
+        return orig(self)
+
+    BackendSearchBlock.staged = counting
+    try:
+        r_pre = db.search("t1", req)
+        db.cfg.search_prefetch_blocks = 0
+        db._search_blocks.clear()
+        r_sync = db.search("t1", req)
+    finally:
+        BackendSearchBlock.staged = orig
+    assert len(r_pre.response().traces) == len(r_sync.response().traces) == 30
+    assert r_pre.metrics.inspected_traces == r_sync.metrics.inspected_traces
+
+    # early stop: limit hits after the first block — prefetch may run at
+    # most `depth` blocks ahead, never the whole list
+    db.cfg.search_prefetch_blocks = 2
+    db._search_blocks.clear()
+    staged_calls.clear()
+    small = _mk_req({})
+    small.limit = 3
+    BackendSearchBlock.staged = counting
+    try:
+        r = db.search("t1", small)
+    finally:
+        BackendSearchBlock.staged = orig
+    assert r.complete and len(r.response().traces) >= 3
+    assert len(set(staged_calls)) <= 1 + 2 + 1  # consumed + depth + slack
+
+    # header-pruned blocks (time window far in the future) stage nothing
+    db._search_blocks.clear()
+    staged_calls.clear()
+    future = _mk_req({})
+    future.start = 2**31 - 10
+    future.end = 2**31 - 1
+    BackendSearchBlock.staged = counting
+    try:
+        r = db.search("t1", future)
+    finally:
+        BackendSearchBlock.staged = orig
+    assert not staged_calls
