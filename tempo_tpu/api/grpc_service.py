@@ -65,7 +65,7 @@ def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
 
         def ing_search(request, context):
             from tempo_tpu.search import SearchResults
-            results = SearchResults(limit=request.limit or 20)
+            results = SearchResults.for_request(request)
             ingester.search(_tenant_from(context), request, results)
             return results.response()
 

@@ -19,8 +19,8 @@ from tempo_tpu.backend.types import BlockMeta
 from tempo_tpu.encoding.v2 import BackendBlock, StreamingBlock
 from tempo_tpu.model.codec import codec_for
 from tempo_tpu.search import SearchResults, write_search_block
-from tempo_tpu.search.pipeline import matches_block_header
 from tempo_tpu.search.backend_search_block import BackendSearchBlock
+from tempo_tpu.search.batcher import BlockBatcher, ScanJob
 from tempo_tpu.search.columnar import PageGeometry
 from tempo_tpu.search.engine import ScanEngine
 from tempo_tpu.observability import metrics as obs
@@ -48,16 +48,24 @@ class TempoDBConfig:
     compacted_retention_s: int = 3600
     search_geometry: PageGeometry = field(default_factory=PageGeometry)
     tenant_index_builder: bool = True
-    search_cache_blocks: int = 64         # staged (HBM) blocks kept hot
-    search_prefetch_blocks: int = 2       # blocks staged ahead of the scan
-                                          # (0 = stage synchronously)
+    search_cache_blocks: int = 64         # open search-block objects kept
+    # serving-path batching (the TPU inversion of the reference's per-job
+    # fan-out, searchsharding.go): blocks group into one kernel dispatch
+    search_max_batch_pages: int = 4096    # pages stacked per dispatch
+    search_batch_cache_bytes: int = 4 << 30   # staged-batch HBM budget
+    search_pipeline_depth: int = 2        # dispatches in flight
+    # shard batches over the device mesh when >1 device is visible
+    auto_mesh: bool = True
 
 
 class TempoDB:
     """Reader + Writer + Compactor over one backend."""
 
     def __init__(self, backend: RawBackend, wal_dir: str,
-                 cfg: TempoDBConfig | None = None):
+                 cfg: TempoDBConfig | None = None, mesh=None):
+        """mesh: a jax.sharding.Mesh to shard batched scans over; when
+        None and cfg.auto_mesh is set, a 1-axis mesh over all visible
+        devices is built automatically if more than one is present."""
         self.backend = backend
         self.cfg = cfg or TempoDBConfig()
         self.wal = WAL(wal_dir)
@@ -68,8 +76,33 @@ class TempoDB:
             max_inputs=self.cfg.compaction_max_inputs,
         )
         self.engine = ScanEngine()
+        self.mesh = mesh
+        # auto-mesh resolves lazily on the first search: jax.devices()
+        # initializes the backend (and on TPU hosts claims the chip), which
+        # write/compact-only processes must never pay for
+        self._mesh_resolved = mesh is not None
+        self.batcher = BlockBatcher(
+            mesh=mesh,
+            max_batch_pages=self.cfg.search_max_batch_pages,
+            cache_bytes=self.cfg.search_batch_cache_bytes,
+            pipeline_depth=self.cfg.search_pipeline_depth,
+        )
         self._search_blocks: dict[str, BackendSearchBlock] = {}
         self._search_lock = threading.Lock()
+
+    def _ensure_mesh(self) -> None:
+        if self._mesh_resolved:
+            return
+        self._mesh_resolved = True
+        if self.cfg.auto_mesh:
+            import jax
+
+            if len(jax.devices()) > 1:
+                from tempo_tpu.parallel.mesh import make_mesh
+
+                self.mesh = make_mesh()
+                self.batcher.engine.mesh = self.mesh
+                self.batcher.engine.n_shards = int(self.mesh.devices.size)
 
     # ------------------------------------------------------------------
     # Writer
@@ -120,10 +153,11 @@ class TempoDB:
     def poll(self) -> None:
         metas, compacted = self.poller.poll()
         self.blocklist.apply_poll_results(metas, compacted)
+        live = {m.block_id for ms in metas.values() for m in ms}
         with self._search_lock:
-            live = {m.block_id for ms in metas.values() for m in ms}
             for bid in [b for b in self._search_blocks if b not in live]:
                 del self._search_blocks[bid]
+        self.batcher.invalidate(live)
 
     @staticmethod
     def _include_block(m: BlockMeta, block_start: str, block_end: str,
@@ -175,11 +209,44 @@ class TempoDB:
                     self._search_blocks.pop(next(iter(self._search_blocks)))
             return bsb
 
+    def _scan_job(self, m: BlockMeta, start_page: int = 0,
+                  pages: int | None = None) -> ScanJob:
+        """A batcher job covering pages [start_page, start_page+pages) of
+        the block's search container (whole block by default). Raises if
+        the block has no search container (caller falls back to the
+        trace-block proto scan)."""
+        bsb = self._search_block_for(m)
+        hdr = bsb.header()
+        total = hdr["n_pages"]
+        n = total - start_page if pages is None else min(pages, total - start_page)
+        n = max(0, n)
+        if start_page == 0 and n == total:
+            pages_fn = bsb.pages
+            n_entries = hdr["n_entries"]
+        else:
+            def pages_fn(bsb=bsb, s=start_page, c=n):
+                return bsb.pages().slice_pages(s, c)
+            # exact count comes from the slice at staging time; estimate
+            # proportionally for planning
+            n_entries = int(hdr["n_entries"] * n / max(1, total))
+        return ScanJob(
+            key=(m.block_id, start_page, n),
+            pages_fn=pages_fn, header=hdr, n_pages=n, n_entries=n_entries,
+            geometry=(hdr["entries_per_page"], hdr["kv_per_entry"]),
+            meta=m,
+        )
+
     def search(self, tenant: str, req: tempopb.SearchRequest,
                results: SearchResults | None = None) -> SearchResults:
-        """Search all (time-pruned) blocks of a tenant through the device
-        engine, early-stopping at the result limit."""
-        results = results or SearchResults(limit=req.limit or 20)
+        """Search all (time-pruned) blocks of a tenant through the batched
+        device engine — few kernel dispatches for many blocks, sharded
+        over the mesh when one is configured — early-stopping at the
+        result limit. Blocks without a search container fall back to the
+        trace-block proto scan (reference backend_block.go:159-209)."""
+        from tempo_tpu.backend.raw import DoesNotExist
+
+        results = results or SearchResults.for_request(req)
+        self._ensure_mesh()
         with obs.query_seconds.time(op="search"), \
                 tracing.start_span("tempodb.Search", tenant=tenant) as span:
             metas = []
@@ -188,90 +255,75 @@ class TempoDB:
                     results.metrics.skipped_blocks += 1
                     continue
                 metas.append(m)
-            for bsb in self._staged_blocks(metas, req):
-                bsb.search(req, results, engine=self.engine)
-                if results.complete:
-                    break
+            jobs, fallback = [], []
+            for m in metas:
+                try:
+                    jobs.append(self._scan_job(m))
+                except DoesNotExist:
+                    fallback.append(m)  # block has no search container
+            self.batcher.search(jobs, req, results)
+            if fallback and not results.complete:
+                self._fallback_search(fallback, req, results)
             span.set_attributes(
                 inspected_traces=results.metrics.inspected_traces,
                 inspected_blocks=results.metrics.inspected_blocks,
-                skipped_blocks=results.metrics.skipped_blocks)
+                skipped_blocks=results.metrics.skipped_blocks,
+                fallback_blocks=len(fallback))
         obs.search_inspected.inc(results.metrics.inspected_traces, tenant=tenant)
         return results
 
-    def _staged_blocks(self, metas, req=None):
-        """Yield search blocks with staging (IO + decompress + H2D
-        dispatch) pipelined N blocks ahead of the scan — the SURVEY §7
-        double-buffering requirement: while the device scans block i, the
-        host prepares block i+1..i+N so the TPU never starves on IO.
-        Depth 0 falls back to synchronous staging."""
-        depth = self.cfg.search_prefetch_blocks
-        if depth <= 0 or len(metas) <= 1:
-            for m in metas:
-                yield self._search_block_for(m)
-            return
+    def _fallback_search(self, metas: list[BlockMeta], req,
+                         results: SearchResults,
+                         start_page: int = 0, pages: int | None = None) -> None:
+        """Trace-block proto scan for blocks lacking search data: decode
+        every object and evaluate the request against the full proto
+        (reference encoding/v2/backend_block.go:159-209 +
+        pkg/model/trace/matches.go:33-184)."""
+        from tempo_tpu.model.matches import matches as proto_matches
+        from tempo_tpu.model.matches import trace_search_metadata
 
-        import queue as _queue
-
-        q: _queue.Queue = _queue.Queue(maxsize=depth)
-        stop = threading.Event()
-
-        def producer():
-            for m in metas:
-                if stop.is_set():
+        for m in metas:
+            block = BackendBlock(self.backend, m)
+            codec = codec_for(m.data_encoding)
+            obs.fallback_scans.inc(tenant=m.tenant_id)
+            results.metrics.inspected_blocks += 1
+            results.metrics.inspected_bytes += block.bytes_in_pages(
+                start_page, pages)
+            for oid, obj in block.iter_objects(start_page, pages):
+                results.metrics.inspected_traces += 1
+                trace = codec.prepare_for_read(obj)
+                if proto_matches(trace, req):
+                    results.add(trace_search_metadata(oid, trace))
+                if results.complete:
                     return
-                try:
-                    bsb = self._search_block_for(m)
-                    # stage only blocks the header rollup can't prune —
-                    # bsb.search re-checks and skips without staging
-                    if req is None or matches_block_header(bsb.header(), req):
-                        bsb.staged()  # async H2D dispatch happens here
-                    item = (bsb, None)
-                except Exception as e:  # noqa: BLE001 — surfaced below
-                    item = (None, e)
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    except _queue.Full:
-                        continue
-                if item[1] is not None:
-                    return
-            if not stop.is_set():
-                try:
-                    q.put(None, timeout=1.0)
-                except _queue.Full:
-                    pass
-
-        t = threading.Thread(target=producer, daemon=True,
-                             name="search-prefetch")
-        t.start()
-        served = 0
-        try:
-            while served < len(metas):
-                item = q.get()
-                if item is None:
-                    return
-                bsb, err = item
-                if err is not None:
-                    raise err
-                served += 1
-                yield bsb
-        finally:
-            stop.set()
 
     def search_block(self, req: tempopb.SearchBlockRequest) -> SearchResults:
         """One search job (the SearchBlockRequest protocol unit). The block
         meta travels in the request, as in the reference querier
-        (internalSearchBlock rebuilding BlockMeta from params)."""
+        (internalSearchBlock rebuilding BlockMeta from params); start_page/
+        pages_to_search scope the job to a page range of the search
+        container (reference searchsharding.go page math). Runs through
+        the batcher so repeated jobs hit the staged cache and shard over
+        the mesh."""
         meta = BlockMeta(
             tenant_id=req.tenant_id, block_id=req.block_id,
             encoding=req.encoding or "zstd", version=req.version or "vT1",
             data_encoding=req.data_encoding or "v2",
         )
-        results = SearchResults(limit=req.search_req.limit or 20)
-        self._search_block_for(meta).search(req.search_req, results,
-                                            engine=self.engine)
+        from tempo_tpu.backend.raw import DoesNotExist
+
+        results = SearchResults.for_request(req.search_req)
+        self._ensure_mesh()
+        start = req.start_page
+        count = req.pages_to_search or None
+        try:
+            job = self._scan_job(meta, start, count)
+        except DoesNotExist:  # no search container: proto scan
+            self._fallback_search([meta], req.search_req, results,
+                                  start, count)
+            return results
+        if job.n_pages > 0:
+            self.batcher.search([job], req.search_req, results)
         return results
 
     # ------------------------------------------------------------------

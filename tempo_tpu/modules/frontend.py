@@ -158,7 +158,7 @@ class QueryFrontend:
         if len(errors) > self.cfg.tolerate_failed_blocks:
             raise errors[0]
 
-        merged = SearchResults(limit=req.limit or 20)
+        merged = SearchResults.for_request(req)
         merged.metrics.skipped_blocks += len(errors)  # tolerated failures
         for r in responses:
             for t in r.traces:

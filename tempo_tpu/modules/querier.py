@@ -88,7 +88,7 @@ class Querier:
     # ---- search (reference SearchRecent :278, SearchBlock :397) ----
 
     def search_recent(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
-        results = SearchResults(limit=req.limit or 20)
+        results = SearchResults.for_request(req)
         for ing in self.ingesters.values():
             try:
                 ing.search(tenant, req, results)

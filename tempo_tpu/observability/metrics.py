@@ -187,3 +187,13 @@ search_inspected = Counter("tempo_search_inspected_traces_total",
 compactions = Counter("tempodb_compaction_runs_total", "compaction runs")
 retention_deleted = Counter("tempodb_retention_deleted_total",
                             "blocks hard-deleted by retention")
+scan_dispatches = Counter("tempo_search_scan_dispatches_total",
+                          "device scan kernel dispatches")
+batch_cache_events = Counter("tempo_search_batch_cache_events_total",
+                             "staged-batch HBM cache hits/misses")
+fallback_scans = Counter("tempo_search_fallback_scans_total",
+                         "trace-block proto scans for blocks lacking "
+                         "search data")
+truncated_tag_entries = Counter(
+    "tempo_search_truncated_entries_total",
+    "entries whose tag set exceeded the kv-slot capacity at block build")

@@ -121,13 +121,15 @@ class DistributedScanEngine:
         from tempo_tpu.search.engine import ScanEngine
 
         tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(cq)
-        count, inspected, scores, idx = self._dist_kernel(
+        out = self._dist_kernel(
             d["kv_key"], d["kv_val"],
             d["entry_start"], d["entry_end"], d["entry_dur"], d["entry_valid"],
             tk, vr, dlo, dhi, ws, we,
             n_terms=cq.n_terms, top_k=k,
         )
-        return int(count), int(inspected), np.asarray(scores), np.asarray(idx)
+        from tempo_tpu.search.engine import fetch_scan_out
+
+        return fetch_scan_out(out)
 
     def scan(self, pages: ColumnarPages, cq: CompiledQuery):
         return self.scan_staged(self.stage(pages), cq)

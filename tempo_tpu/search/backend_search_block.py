@@ -43,6 +43,13 @@ def write_search_block(backend: RawBackend, meta: BlockMeta,
     header = dict(pages.header)
     header["encoding"] = encoding
     header["compressed_size"] = len(blob)
+    if header.get("truncated_entries"):
+        # surface kv-slot truncation (a silent false-negative class:
+        # entries wider than C lose tags) — operators watch this counter
+        from tempo_tpu.observability import metrics as obs
+
+        obs.truncated_tag_entries.inc(header["truncated_entries"],
+                                      tenant=meta.tenant_id)
     backend.write(meta.tenant_id, meta.block_id, NAME_SEARCH, blob)
     backend.write(meta.tenant_id, meta.block_id, NAME_SEARCH_HEADER,
                   json.dumps(header).encode())
@@ -54,7 +61,9 @@ class BackendSearchBlock:
         self.backend = backend
         self.meta = meta
         self._header: dict | None = None
+        self._pages: ColumnarPages | None = None
         self._staged: StagedPages | None = None
+        self._lock = __import__("threading").Lock()
 
     def header(self) -> dict:
         if self._header is None:
@@ -63,22 +72,32 @@ class BackendSearchBlock:
             ))
         return self._header
 
+    def pages(self) -> ColumnarPages:
+        """Load the host columnar container (cached). Device staging is a
+        separate step: the batcher stages groups of blocks together, and
+        dictionary-only readers (tag lookups) never need device arrays."""
+        with self._lock:
+            if self._pages is None:
+                hdr = self.header()
+                blob = self.backend.read(self.meta.tenant_id,
+                                         self.meta.block_id, NAME_SEARCH)
+                raw = decompress(blob, hdr.get("encoding", "zstd"))
+                self._pages = ColumnarPages.from_bytes(raw)
+            return self._pages
+
     def staged(self) -> StagedPages:
-        """Load + device-stage the columnar pages (cached — HBM is the
-        cache tier for hot blocks, cf. reference shouldCache heuristics)."""
+        """Device-stage this block alone (cached — HBM is the cache tier
+        for hot blocks, cf. reference shouldCache heuristics). The batched
+        serving path uses the batcher's group staging instead."""
         if self._staged is None:
-            hdr = self.header()
-            blob = self.backend.read(self.meta.tenant_id, self.meta.block_id,
-                                     NAME_SEARCH)
-            raw = decompress(blob, hdr.get("encoding", "zstd"))
-            self._staged = stage(ColumnarPages.from_bytes(raw))
+            self._staged = stage(self.pages())
         return self._staged
 
     def search(self, req: tempopb.SearchRequest,
                results: SearchResults | None = None,
                engine: ScanEngine | None = None) -> SearchResults:
         engine = engine or default_engine()
-        results = results or SearchResults(limit=req.limit or 20)
+        results = results or SearchResults.for_request(req)
         results.metrics.inspected_blocks += 1
 
         if not matches_block_header(self.header(), req):
@@ -99,6 +118,9 @@ class BackendSearchBlock:
             return results
 
         count, inspected, scores, idx = engine.scan_staged(sp, cq)
+        from tempo_tpu.observability import metrics as obs
+
+        obs.scan_dispatches.inc(mode="single")
         results.metrics.inspected_traces += inspected
         results.metrics.inspected_bytes += int(
             self.header().get("compressed_size", 0)

@@ -1,0 +1,230 @@
+"""Serving-path batch scanning: many blocks (or page ranges), few kernels.
+
+This is where the TPU economics land in the serving path. The reference's
+production search IS its job fan-out — one goroutine per 10 MiB page range
+(modules/frontend/searchsharding.go:163-306, tempodb/pool) — because on
+CPU the per-job cost is the scan itself. On TPU the per-dispatch overhead
+(host sync + kernel launch through a relay, ~ms) dwarfs the scan of a
+single block, so the batcher inverts the shape: jobs GROUP into batches
+whose pages stack along the device page axis and scan in ONE kernel call
+(`multiblock.multi_scan_kernel`; with a mesh, the shard_map variant whose
+collectives replace the Results funnel).
+
+Properties the grouping keeps:
+- **stable**: jobs sort by (block id, page range) and fill greedily, so
+  the same blocklist yields the same groups query after query and the
+  staged-batch HBM cache (LRU by bytes) hits.
+- **bucketed**: only jobs sharing page geometry (E entries/page, C kv
+  slots) stack together — static shapes per bucket mean XLA compiles once
+  per (bucket, n_terms, top_k).
+- **prune-aware without cache churn**: header- or dictionary-pruned jobs
+  stay IN the staged batch (composition never depends on the query); the
+  compiled query neutralizes them (key id -1 → no page can match) and
+  their entries are subtracted from inspected counts on the host.
+- **pipelined with early quit**: group i+1 stages + dispatches while
+  group i's results transfer; dispatch stops once the result limit is met
+  (reference results.go:38-78 quit channel).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tempo_tpu.observability import metrics as obs
+from tempo_tpu.observability import tracing
+
+from .engine import DEFAULT_TOP_K, start_fetch
+from .multiblock import MultiBlockEngine, compile_multi
+from .pipeline import matches_block_header
+from .results import SearchResults
+
+
+@dataclass
+class ScanJob:
+    """One schedulable scan unit: a page range of one block's search
+    container (whole block = range [0, n_pages))."""
+    key: tuple              # (block_id, start_page, n_pages) — cache identity
+    pages_fn: object        # () -> ColumnarPages for this range (host)
+    header: dict            # search-header rollup (pruning + sizes)
+    n_pages: int
+    n_entries: int
+    geometry: tuple         # (entries_per_page, kv_per_entry) bucket key
+    meta: object = None     # BlockMeta, for diagnostics
+
+    @property
+    def bytes_est(self) -> int:
+        """Share of the block's compressed bytes this job covers — the
+        inspected_bytes accounting unit (reference results.go metrics)."""
+        total = max(1, self.header.get("n_pages", self.n_pages))
+        return int(self.header.get("compressed_size", 0) * self.n_pages / total)
+
+
+@dataclass
+class _CachedBatch:
+    batch: object           # multiblock.BlockBatch
+    nbytes: int
+    jobs: list = field(default_factory=list)
+
+
+class BlockBatcher:
+    """Groups ScanJobs into staged device batches and runs searches over
+    them. Thread-safe; one instance per TempoDB."""
+
+    def __init__(self, mesh=None, top_k: int = DEFAULT_TOP_K,
+                 max_batch_pages: int = 4096,
+                 cache_bytes: int = 4 << 30,
+                 pipeline_depth: int = 2,
+                 io_workers: int = 8):
+        self.engine = MultiBlockEngine(top_k=top_k, mesh=mesh)
+        self.max_batch_pages = max_batch_pages
+        self.cache_bytes = cache_bytes
+        self.pipeline_depth = max(1, pipeline_depth)
+        self.io_workers = io_workers
+        self._cache: OrderedDict[tuple, _CachedBatch] = OrderedDict()
+        self._cache_total = 0
+        self._lock = threading.Lock()
+        self.last_dispatches = 0  # diagnostics: kernel calls in last search
+
+    # ------------------------------------------------------------------
+    # planning
+
+    def plan(self, jobs: list[ScanJob]) -> list[list[ScanJob]]:
+        buckets: dict[tuple, list[ScanJob]] = {}
+        for j in sorted(jobs, key=lambda j: j.key):
+            buckets.setdefault(j.geometry, []).append(j)
+        groups = []
+        for _geo, js in sorted(buckets.items()):
+            cur: list[ScanJob] = []
+            cur_pages = 0
+            for j in js:
+                if cur and cur_pages + j.n_pages > self.max_batch_pages:
+                    groups.append(cur)
+                    cur, cur_pages = [], 0
+                cur.append(j)
+                cur_pages += j.n_pages
+            if cur:
+                groups.append(cur)
+        return groups
+
+    # ------------------------------------------------------------------
+    # staging cache
+
+    def _staged(self, group: list[ScanJob]) -> _CachedBatch:
+        key = tuple(j.key for j in group)
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None:
+                self._cache.move_to_end(key)
+                obs.batch_cache_events.inc(result="hit")
+                return hit
+        # load host pages outside the lock (IO + decompress dominate)
+        import concurrent.futures
+
+        if len(group) > 1:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(self.io_workers, len(group))
+            ) as ex:
+                pages = list(ex.map(lambda j: j.pages_fn(), group))
+        else:
+            pages = [group[0].pages_fn()]
+        batch = self.engine.stage(pages)
+        nbytes = int(sum(int(a.nbytes) for a in batch.device.values()))
+        entry = _CachedBatch(batch=batch, nbytes=nbytes, jobs=list(group))
+        with self._lock:
+            obs.batch_cache_events.inc(result="miss")
+            prev = self._cache.pop(key, None)
+            if prev is not None:
+                self._cache_total -= prev.nbytes
+            self._cache[key] = entry
+            self._cache_total += nbytes
+            while self._cache_total > self.cache_bytes and len(self._cache) > 1:
+                _, old = self._cache.popitem(last=False)
+                self._cache_total -= old.nbytes
+        return entry
+
+    def invalidate(self, live_block_ids: set[str]) -> None:
+        """Drop cached batches containing blocks no longer in the
+        blocklist (called from the poll loop)."""
+        with self._lock:
+            dead = [k for k in self._cache
+                    if any(jk[0] not in live_block_ids for jk in k)]
+            for k in dead:
+                self._cache_total -= self._cache.pop(k).nbytes
+
+    # ------------------------------------------------------------------
+    # search
+
+    def search(self, jobs: list[ScanJob], req,
+               results: SearchResults | None = None) -> SearchResults:
+        """Run the request over all jobs: group → stage → compile →
+        dispatch (pipelined, early-quitting) → merge."""
+        from .pipeline import is_exhaustive
+
+        results = results or SearchResults.for_request(req)
+        exhaustive = is_exhaustive(req)
+        groups = self.plan(jobs)
+        inflight: deque = deque()
+        dispatches = 0
+
+        def drain_one():
+            cached, mq, skip, fut = inflight.popleft()
+            count, inspected, scores, idx = fut
+            inspected = int(inspected)
+            for j, sk in zip(cached.jobs, skip):
+                if sk:
+                    inspected -= j.n_entries
+                    continue
+                results.metrics.inspected_blocks += 1
+                results.metrics.inspected_bytes += j.bytes_est
+            results.metrics.inspected_traces += max(0, inspected)
+            for m in self.engine.results(cached.batch, mq,
+                                         np.asarray(scores), np.asarray(idx)):
+                results.add(m)
+
+        with tracing.start_span("batcher.Search") as span:
+            for group in groups:
+                if results.complete:
+                    break
+                skip = [not matches_block_header(j.header, req) for j in group]
+                if all(skip):
+                    # decidable from headers alone — no staging, no device
+                    results.metrics.skipped_blocks += len(group)
+                    continue
+                cached = self._staged(group)
+                mq = compile_multi([b for b in cached.batch.blocks], req,
+                                   skip=skip)
+                if mq is None:
+                    # every job in the group pruned before any device work
+                    results.metrics.skipped_blocks += len(group)
+                    continue
+                # dictionary-pruned jobs (term key -1 across all terms)
+                # count as skipped; under the exhaustive flag nothing is
+                # skipped — every page is scanned by definition
+                if not exhaustive:
+                    for i, j in enumerate(group):
+                        if not skip[i] and mq.n_terms and np.all(
+                            mq.term_keys[i] == -1
+                        ):
+                            skip[i] = True
+                results.metrics.skipped_blocks += sum(skip)
+                fut = self.engine.scan_async(cached.batch, mq)
+                start_fetch(fut)  # D2H begins now, overlapping next groups
+                dispatches += 1
+                inflight.append((cached, mq, skip, fut))
+                while len(inflight) >= self.pipeline_depth:
+                    drain_one()
+            while inflight:
+                if results.complete:
+                    inflight.clear()
+                    break
+                drain_one()
+            span.set_attributes(groups=len(groups), scan_dispatches=dispatches,
+                                inspected_blocks=results.metrics.inspected_blocks,
+                                skipped_blocks=results.metrics.skipped_blocks)
+        obs.scan_dispatches.inc(dispatches, mode="batched")
+        self.last_dispatches = dispatches
+        return results

@@ -78,6 +78,27 @@ class ColumnarPages:
     def n_pages(self) -> int:
         return self.kv_key.shape[0]
 
+    def slice_pages(self, start: int, count: int) -> "ColumnarPages":
+        """A view over pages [start, start+count) — the unit of the
+        reference's page-range search jobs (SearchBlockRequest
+        startPage/pagesToSearch, searchsharding.go:332-343). Numpy slices
+        are views: no copy; dictionaries are shared with the parent."""
+        end = min(start + count, self.n_pages)
+        start = min(start, end)
+        kw = {name: getattr(self, name)[start:end] for name, _ in self._ARRAYS}
+        hdr = dict(self.header)
+        hdr["n_pages"] = end - start
+        hdr["n_entries"] = int(kw["entry_valid"].sum())
+        out = ColumnarPages(
+            geometry=self.geometry, key_dict=self.key_dict,
+            val_dict=self.val_dict, n_entries=hdr["n_entries"],
+            header=hdr, **kw,
+        )
+        cached = getattr(self, "_packed_vals", None)
+        if cached is not None:  # dictionaries are shared; so is the packing
+            out._packed_vals = cached
+        return out
+
     def packed_val_dict(self) -> tuple:
         """Cached (bytes, offsets) packing for the native substring scan
         (huge dictionaries — see pipeline.substring_value_ids)."""

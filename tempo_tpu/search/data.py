@@ -142,11 +142,11 @@ def search_data_matches(sd: SearchData, req) -> bool:
         return False
     if req.end and sd.start_s > req.end:
         return False
-    from .pipeline import is_exhaustive
+    from .pipeline import EXHAUSTIVE_SEARCH_TAG
 
-    if is_exhaustive(req):
-        return True  # debug flag: tag predicates bypassed on every path
     for k, v in req.tags.items():
+        if k == EXHAUSTIVE_SEARCH_TAG:
+            continue  # debug flag: forces traversal, is not itself a predicate
         vs = sd.kvs.get(k)
         if not vs:
             return False

@@ -52,8 +52,11 @@ class BlockBatch:
         return int(self.page_block.shape[0])
 
 
-def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None) -> BlockBatch:
-    """Concatenate uniform-geometry blocks along the page axis."""
+def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None,
+                 sharding=None) -> BlockBatch:
+    """Concatenate uniform-geometry blocks along the page axis. With
+    `sharding` (a NamedSharding over the page axis) the stacked arrays are
+    placed sharded across the mesh instead of on the default device."""
     E = blocks[0].geometry.entries_per_page
     C = max(b.geometry.kv_per_entry for b in blocks)
     arrays = {name: [] for name in ("kv_key", "kv_val", "entry_start",
@@ -88,8 +91,11 @@ def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None) -> Bloc
             page_block, np.full(extra, -1, dtype=np.int32)
         ])
 
-    dev = {k: jnp.asarray(v) for k, v in cat.items()}
-    dev["page_block"] = jnp.asarray(page_block)
+    cat["page_block"] = page_block
+    if sharding is not None:
+        dev = {k: jax.device_put(v, sharding) for k, v in cat.items()}
+    else:
+        dev = {k: jnp.asarray(v) for k, v in cat.items()}
     return BlockBatch(device=dev, page_block=page_block, blocks=blocks,
                       page_offset=page_offset)
 
@@ -107,27 +113,31 @@ class MultiQuery:
     n_terms: int
 
 
-def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest) -> MultiQuery | None:
+def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
+                  skip: list[bool] | None = None) -> MultiQuery | None:
     """Compile the request against every block's dictionaries; blocks that
-    prune get key id -1 (no page of theirs can match)."""
+    prune get key id -1 (no page of theirs can match). `skip[i]` marks
+    blocks already pruned by their header rollup — they stay in the batch
+    (staging is query-independent) but compile to the -1 sentinel without
+    touching their dictionaries."""
     from tempo_tpu.ops import native
     from .pipeline import NATIVE_SCAN_THRESHOLD
 
     use_packed = bool(req.tags) and native.available()
     per_block: list[CompiledQuery | None] = [
-        compile_query(
+        None if (skip is not None and skip[i]) else compile_query(
             b.key_dict, b.val_dict, req,
             packed_vals=(b.packed_val_dict()
                          if use_packed and len(b.val_dict) >= NATIVE_SCAN_THRESHOLD
                          else None),
         )
-        for b in blocks
+        for i, b in enumerate(blocks)
     ]
     if all(cq is None for cq in per_block):
         return None
-    # term count comes from the compiled queries, not len(req.tags):
-    # the exhaustive debug tag compiles to ZERO terms — counting raw tags
-    # would leave an unmatchable -1 key per block and invert its meaning
+    # term count comes from the compiled queries, not len(req.tags): the
+    # exhaustive debug tag is not itself a predicate, so raw-tag counting
+    # would leave an unmatchable extra -1 key per block
     T = max((cq.n_terms for cq in per_block if cq is not None), default=0)
     B = len(blocks)
     rmax = 1
@@ -156,14 +166,15 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest) -> Mu
     )
 
 
-@functools.partial(jax.jit, static_argnames=("n_terms", "top_k"))
-def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
-                      entry_valid, page_block, term_keys, val_ranges,
-                      dur_lo, dur_hi, win_start, win_end,
-                      *, n_terms: int, top_k: int):
-    """Like scan_kernel but term columns are selected per page through the
-    page_block index: key id and ranges become [P]-indexed gathers over the
-    SMALL [B,...] tables (cheap — B entries, not 8M)."""
+def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
+                     entry_valid, page_block, term_keys, val_ranges,
+                     dur_lo, dur_hi, win_start, win_end, *, n_terms: int):
+    """The multi-block predicate: [P,E] bool mask of matching entries.
+    Like engine.entry_match_mask but term columns are selected per page
+    through the page_block index: key id and ranges become [P]-indexed
+    gathers over the SMALL [B,...] tables (cheap — B entries, not 8M).
+    Shared by the single-device kernel and the shard_map distributed
+    kernel (each shard evaluates it over its local page slice)."""
     safe_block = jnp.maximum(page_block, 0)
     mask = entry_valid & (page_block >= 0)[:, None]
     if n_terms:
@@ -183,16 +194,98 @@ def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
     mask = mask & (dur >= dur_lo.astype(jnp.uint32)) & (dur <= dur_hi.astype(jnp.uint32))
     mask = mask & (entry_end.astype(jnp.uint32) >= win_start.astype(jnp.uint32))
     mask = mask & (entry_start.astype(jnp.uint32) <= win_end.astype(jnp.uint32))
+    return mask
 
+
+@functools.partial(jax.jit, static_argnames=("n_terms", "top_k"))
+def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
+                      entry_valid, page_block, term_keys, val_ranges,
+                      dur_lo, dur_hi, win_start, win_end,
+                      *, n_terms: int, top_k: int):
+    mask = multi_entry_mask(
+        kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
+        page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
+        win_end, n_terms=n_terms,
+    )
     count = jnp.sum(mask, dtype=jnp.int32)
     inspected = jnp.sum(entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
     scores, idx = masked_topk(mask, entry_start, top_k)
     return count, inspected, scores, idx
 
 
+@functools.partial(jax.jit, static_argnames=("mesh", "n_terms", "top_k"))
+def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
+                           entry_dur, entry_valid, page_block, term_keys,
+                           val_ranges, dur_lo, dur_hi, win_start, win_end,
+                           *, n_terms: int, top_k: int):
+    """Multi-block scan sharded over the mesh's scan axis: the stacked
+    page axis (blocks × pages — the corpus 'sequence' axis, SURVEY.md §5)
+    splits across devices; the [B,...] term tables replicate; counts
+    reduce with psum and per-shard top-k candidates all_gather into a
+    global top-k — one jit call, collectives riding ICI (the TPU-native
+    Results funnel, reference results.go:38-141)."""
+    from jax.sharding import PartitionSpec as P
+    from tempo_tpu.parallel.mesh import SCAN_AXIS
+
+    n_shards = mesh.devices.size
+    E = entry_valid.shape[1]
+    local_flat = kv_key.shape[0] // n_shards * E
+
+    def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
+                 entry_valid, page_block, term_keys, val_ranges,
+                 dur_lo, dur_hi, win_start, win_end):
+        mask = multi_entry_mask(
+            kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
+            page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
+            win_end, n_terms=n_terms,
+        )
+        local_count = jnp.sum(mask, dtype=jnp.int32)
+        local_inspected = jnp.sum(
+            entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
+        scores, idx = masked_topk(mask, entry_start, top_k)
+        shard = jax.lax.axis_index(SCAN_AXIS).astype(jnp.int32)
+        gidx = idx + shard * local_flat
+        count = jax.lax.psum(local_count, SCAN_AXIS)
+        inspected = jax.lax.psum(local_inspected, SCAN_AXIS)
+        all_scores = jax.lax.all_gather(scores, SCAN_AXIS).reshape(-1)
+        all_idx = jax.lax.all_gather(gidx, SCAN_AXIS).reshape(-1)
+        k = min(top_k, all_scores.shape[0])
+        top_scores, pos = jax.lax.top_k(all_scores, k)
+        return count, inspected, top_scores, all_idx[pos]
+
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 6,
+        out_specs=(P(), P(), P(), P()),
+        # all_gather+top_k yields identical values on every shard, but the
+        # VMA checker can't infer replication through the gather
+        check_vma=False,
+    )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
+      page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end)
+
+
 class MultiBlockEngine:
-    def __init__(self, top_k: int = DEFAULT_TOP_K):
+    """Batched scan over many blocks in one kernel dispatch; with a mesh,
+    the batch shards across devices (the serving-path union of the
+    reference's job fan-out and the Results merge)."""
+
+    def __init__(self, top_k: int = DEFAULT_TOP_K, mesh=None):
         self.top_k = top_k
+        self.mesh = mesh
+        self.n_shards = int(mesh.devices.size) if mesh is not None else 1
+
+    def stage(self, blocks: list[ColumnarPages]) -> BlockBatch:
+        """Stack + place a batch on device(s). With a mesh the page axis
+        pads to a shard multiple and shards across it."""
+        if self.mesh is None:
+            return stack_blocks(blocks)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from tempo_tpu.parallel.mesh import SCAN_AXIS
+
+        total = sum(b.n_pages for b in blocks)
+        pad_to = -(-total // self.n_shards) * self.n_shards
+        spec = NamedSharding(self.mesh, P(SCAN_AXIS))
+        return stack_blocks(blocks, pad_to=pad_to, sharding=spec)
 
     def scan_async(self, batch: BlockBatch, mq: MultiQuery):
         """Dispatch without device→host sync; returns device arrays."""
@@ -205,16 +298,18 @@ class MultiBlockEngine:
         from .engine import ScanEngine
 
         tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(mq)
-        return multi_scan_kernel(
-            d["kv_key"], d["kv_val"], d["entry_start"], d["entry_end"],
-            d["entry_dur"], d["entry_valid"], d["page_block"],
-            tk, vr, dlo, dhi, ws, we,
-            n_terms=mq.n_terms, top_k=k,
-        )
+        args = (d["kv_key"], d["kv_val"], d["entry_start"], d["entry_end"],
+                d["entry_dur"], d["entry_valid"], d["page_block"],
+                tk, vr, dlo, dhi, ws, we)
+        if self.mesh is not None:
+            return dist_multi_scan_kernel(self.mesh, *args,
+                                          n_terms=mq.n_terms, top_k=k)
+        return multi_scan_kernel(*args, n_terms=mq.n_terms, top_k=k)
 
     def scan(self, batch: BlockBatch, mq: MultiQuery):
-        count, inspected, scores, idx = self.scan_async(batch, mq)
-        return int(count), int(inspected), np.asarray(scores), np.asarray(idx)
+        from .engine import fetch_scan_out
+
+        return fetch_scan_out(self.scan_async(batch, mq))
 
     def results(self, batch: BlockBatch, mq: MultiQuery,
                 scores: np.ndarray, idx: np.ndarray) -> list:

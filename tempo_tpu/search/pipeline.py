@@ -23,9 +23,12 @@ INT32_SENTINEL = np.int32(2**31 - 1)
 UINT32_MAX = 0xFFFFFFFF
 
 # Hidden debug flag (reference tempodb/search/pipeline.go:14
-# SecretExhaustiveSearchTag): a request carrying this tag bypasses block
-# pruning and tag predicates entirely — every valid entry matches (modulo
-# duration/time filters). In-band, undocumented, for benchmarking scans.
+# SecretExhaustiveSearchTag): a request carrying this tag forces a FULL
+# traversal — block pruning and result-limit early-quit are suppressed so
+# every page of every block is scanned. The remaining (non-secret) tag
+# predicates still apply, as in the reference where the secret tag adds a
+# filter without dropping the others. In-band, undocumented, for
+# benchmarking scans.
 EXHAUSTIVE_SEARCH_TAG = "x-dbg-exhaustive"
 
 
@@ -121,17 +124,24 @@ def compile_query(key_dict: list, val_dict: list,
                   req: tempopb.SearchRequest,
                   packed_vals: tuple | None = None) -> CompiledQuery | None:
     """Returns None when the block provably cannot match (key absent from
-    the key dictionary, or no dictionary value satisfies a term)."""
+    the key dictionary, or no dictionary value satisfies a term). Under the
+    exhaustive debug flag blocks are never pruned: an unsatisfiable term
+    compiles to an empty value-range set (scanned, matches nothing)."""
+    exhaustive = is_exhaustive(req)
     term_key_ids = []
     term_val_sets = []
-    # exhaustive debug flag: no tag predicates, no pruning — zero terms
-    terms = [] if is_exhaustive(req) else sorted(req.tags.items())
+    terms = sorted((k, v) for k, v in req.tags.items()
+                   if k != EXHAUSTIVE_SEARCH_TAG)
     for k, v in terms:
         i = bisect.bisect_left(key_dict, k)
         if i >= len(key_dict) or key_dict[i] != k:
-            return None
+            if not exhaustive:
+                return None
+            term_key_ids.append(-1)
+            term_val_sets.append(np.zeros(0, dtype=np.int32))
+            continue
         ids = substring_value_ids(val_dict, v, packed=packed_vals)
-        if ids.size == 0:
+        if ids.size == 0 and not exhaustive:
             return None
         term_key_ids.append(i)
         term_val_sets.append(np.sort(ids))

@@ -13,10 +13,21 @@ from tempo_tpu import tempopb
 
 
 class SearchResults:
-    def __init__(self, limit: int = 20):
+    def __init__(self, limit: int = 20, no_quit: bool = False):
         self.limit = limit
+        # no_quit suppresses `complete` so fan-out never early-stops —
+        # set by the exhaustive debug tag (reference's secret tag keeps the
+        # scan from quitting by rejecting everything; here the flag is
+        # explicit so real matches still come back)
+        self.no_quit = no_quit
         self._by_id: dict[str, tempopb.TraceSearchMetadata] = {}
         self.metrics = tempopb.SearchMetrics()
+
+    @classmethod
+    def for_request(cls, req) -> "SearchResults":
+        from .pipeline import is_exhaustive
+
+        return cls(limit=req.limit or 20, no_quit=is_exhaustive(req))
 
     def add(self, meta: tempopb.TraceSearchMetadata) -> None:
         prev = self._by_id.get(meta.trace_id)
@@ -37,7 +48,7 @@ class SearchResults:
 
     @property
     def complete(self) -> bool:
-        return len(self._by_id) >= self.limit
+        return not self.no_quit and len(self._by_id) >= self.limit
 
     def response(self) -> tempopb.SearchResponse:
         resp = tempopb.SearchResponse()

@@ -286,8 +286,10 @@ def test_status_config_modes(app):
 
 
 def test_exhaustive_debug_tag(app):
-    """Hidden debug flag (reference SecretExhaustiveSearchTag): bypasses
-    pruning and tag predicates — everything matches."""
+    """Hidden debug flag (reference SecretExhaustiveSearchTag): forces a
+    FULL traversal — no block pruning, no early quit — while the other
+    tag predicates still apply (the reference keeps them and suppresses
+    early exit; round-1 had this inverted, see ADVICE r1)."""
     from tempo_tpu.search.pipeline import EXHAUSTIVE_SEARCH_TAG
 
     tids = [random_trace_id() for _ in range(5)]
@@ -296,15 +298,27 @@ def test_exhaustive_debug_tag(app):
     app.flush_tick(force=True)
     app.poll_tick()
 
-    narrow = _mk_req({"service.name": "no-such-service-anywhere"})
+    # a key no block has: normally the whole tenant prunes with no scan
+    narrow = _mk_req({"no.such.key": "x"})
     narrow.limit = 50
-    assert len(app.search("t1", narrow).traces) == 0
+    resp = app.search("t1", narrow)
+    assert len(resp.traces) == 0
+    assert resp.metrics.inspected_traces == 0  # pruned, nothing scanned
 
-    dbg = _mk_req({EXHAUSTIVE_SEARCH_TAG: "1",
-                   "service.name": "no-such-service-anywhere"})
+    # with the debug flag the predicate still rejects everything, but the
+    # scan is forced through every entry
+    dbg = _mk_req({EXHAUSTIVE_SEARCH_TAG: "1", "no.such.key": "x"})
     dbg.limit = 50
     resp = app.search("t1", dbg)
-    assert len(resp.traces) == len(tids)  # pruning + predicates bypassed
+    assert len(resp.traces) == 0
+    assert resp.metrics.inspected_traces >= len(tids)  # full traversal
+
+    # flag alone: full scan, everything matches, limit ignored for quitting
+    dbg2 = _mk_req({EXHAUSTIVE_SEARCH_TAG: "1"})
+    dbg2.limit = 2
+    resp = app.search("t1", dbg2)
+    assert resp.metrics.inspected_traces >= len(tids)
+    assert len(resp.traces) == 2  # response still honors the limit
 
 
 def test_status_config_redacts_secrets(tmp_path):
@@ -329,10 +343,10 @@ def test_status_config_redacts_secrets(tmp_path):
 
 
 def test_exhaustive_tag_multiblock():
-    """The debug tag must mean 'everything' through the multi-block
-    engine too (term count from compiled queries, not raw tags)."""
-    import numpy as np
-
+    """The debug tag forces traversal through the multi-block engine too:
+    a block that would prune (no dictionary value satisfies the term)
+    still compiles and scans — the term just matches nothing — and the
+    secret tag itself never becomes a predicate."""
     from tempo_tpu.search.multiblock import compile_multi
     from tempo_tpu.search.pipeline import EXHAUSTIVE_SEARCH_TAG
 
@@ -347,13 +361,22 @@ def test_exhaustive_tag_multiblock():
         sd.kvs = {"service.name": {"svc"}}
         entries.append(sd)
     pages = ColumnarPages.build(entries)
-    req = _mk_req({EXHAUSTIVE_SEARCH_TAG: "1",
-                   "service.name": "no-such-service"})
+
+    # without the flag: unsatisfiable term prunes the whole block
+    assert compile_multi([pages], _mk_req({"service.name": "nope"})) is None
+
+    req = _mk_req({EXHAUSTIVE_SEARCH_TAG: "1", "service.name": "nope"})
     mq = compile_multi([pages], req)
-    assert mq is not None and mq.n_terms == 0
-    # and the kernel really matches everything
+    assert mq is not None and mq.n_terms == 1  # real predicate kept
     from tempo_tpu.search.multiblock import MultiBlockEngine, stack_blocks
 
     batch = stack_blocks([pages])
     count, inspected, _, _ = MultiBlockEngine().scan(batch, mq)
+    assert inspected == 8  # forced full scan
+    assert count == 0      # predicate still rejects
+
+    # flag alone: zero terms, everything scanned and matched
+    mq2 = compile_multi([pages], _mk_req({EXHAUSTIVE_SEARCH_TAG: "1"}))
+    assert mq2 is not None and mq2.n_terms == 0
+    count, inspected, _, _ = MultiBlockEngine().scan(batch, mq2)
     assert count == 8 == inspected

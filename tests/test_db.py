@@ -229,61 +229,69 @@ def test_retention_two_phase(tmp_path):
     assert db.backend.list_blocks("t1") == []
 
 
-def test_search_prefetch_pipeline(tmp_path):
-    """Prefetched staging returns identical results to synchronous, does
-    not stage header-pruned blocks, and bounds read-ahead on early stop."""
-    from tempo_tpu.search.backend_search_block import BackendSearchBlock
+def test_search_batched_pipeline(tmp_path):
+    """The serving path batches many blocks into FEW kernel dispatches
+    (the round-2 wiring of MultiBlockEngine into TempoDB.search), with
+    results identical to the per-block job path, early quit across
+    groups, and zero dispatches for fully pruned queries."""
+    from tempo_tpu.search.multiblock import MultiBlockEngine
 
-    db = _db(tmp_path, search_prefetch_blocks=2)
+    db = _db(tmp_path)
     for b in range(5):
         _ingest(db, "t1", 6, seed_base=b * 100)
     db.poll()
-    assert len(db.blocklist.metas("t1")) == 5
+    metas = db.blocklist.metas("t1")
+    assert len(metas) == 5
 
-    req = _mk_req({})
-    req.limit = 1000
-    staged_calls = []
-    orig = BackendSearchBlock.staged
+    dispatches = []
+    orig = MultiBlockEngine.scan_async
 
-    def counting(self):
-        staged_calls.append(self.meta.block_id)
-        return orig(self)
+    def counting(self, batch, mq):
+        dispatches.append(len(batch.blocks))
+        return orig(self, batch, mq)
 
-    BackendSearchBlock.staged = counting
+    MultiBlockEngine.scan_async = counting
     try:
-        r_pre = db.search("t1", req)
-        db.cfg.search_prefetch_blocks = 0
-        db._search_blocks.clear()
-        r_sync = db.search("t1", req)
-    finally:
-        BackendSearchBlock.staged = orig
-    assert len(r_pre.response().traces) == len(r_sync.response().traces) == 30
-    assert r_pre.metrics.inspected_traces == r_sync.metrics.inspected_traces
+        req = _mk_req({})
+        req.limit = 1000
+        r_batched = db.search("t1", req)
+        # 5 blocks, one geometry bucket, under the page budget → 1 dispatch
+        assert dispatches == [5]
 
-    # early stop: limit hits after the first block — prefetch may run at
-    # most `depth` blocks ahead, never the whole list
-    db.cfg.search_prefetch_blocks = 2
-    db._search_blocks.clear()
-    staged_calls.clear()
-    small = _mk_req({})
-    small.limit = 3
-    BackendSearchBlock.staged = counting
-    try:
+        # per-block jobs (the SearchBlockRequest protocol path) agree
+        per_block = set()
+        for m in metas:
+            breq = tempopb.SearchBlockRequest()
+            breq.search_req.CopyFrom(req)
+            breq.tenant_id = "t1"
+            breq.block_id = m.block_id
+            breq.encoding = m.encoding
+            breq.version = m.version
+            breq.data_encoding = m.data_encoding
+            for t in db.search_block(breq).response().traces:
+                per_block.add(t.trace_id)
+        batched_ids = {t.trace_id for t in r_batched.response().traces}
+        assert len(batched_ids) == 30 and batched_ids == per_block
+
+        # early quit: force one group per block; a small limit stops
+        # dispatching before all groups run
+        db.batcher.max_batch_pages = 1
+        db.batcher._cache.clear()
+        db.batcher._cache_total = 0
+        dispatches.clear()
+        small = _mk_req({})
+        small.limit = 3
         r = db.search("t1", small)
-    finally:
-        BackendSearchBlock.staged = orig
-    assert r.complete and len(r.response().traces) >= 3
-    assert len(set(staged_calls)) <= 1 + 2 + 1  # consumed + depth + slack
+        assert r.complete and len(r.response().traces) >= 3
+        assert len(dispatches) < 5  # stopped early
 
-    # header-pruned blocks (time window far in the future) stage nothing
-    db._search_blocks.clear()
-    staged_calls.clear()
-    future = _mk_req({})
-    future.start = 2**31 - 10
-    future.end = 2**31 - 1
-    BackendSearchBlock.staged = counting
-    try:
+        # fully pruned query (future time window): no device work at all
+        dispatches.clear()
+        future = _mk_req({})
+        future.start = 2**31 - 10
+        future.end = 2**31 - 1
         r = db.search("t1", future)
+        assert not dispatches
+        assert r.metrics.skipped_blocks >= 5
     finally:
-        BackendSearchBlock.staged = orig
-    assert not staged_calls
+        MultiBlockEngine.scan_async = orig
