@@ -112,6 +112,9 @@ def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
         def search_block(request, context):
             return querier.search_block(request)
 
+        def search_blocks(request, context):
+            return querier.search_blocks(request)
+
         def search_tags(request, context):
             return querier.search_tags(_tenant_from(context))
 
@@ -126,6 +129,8 @@ def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
                                    tempopb.SearchResponse),
             "SearchBlock": _unary(search_block, tempopb.SearchBlockRequest,
                                   tempopb.SearchResponse),
+            "SearchBlocks": _unary(search_blocks, tempopb.SearchBlocksRequest,
+                                   tempopb.SearchResponse),
             "SearchTags": _unary(search_tags, tempopb.SearchTagsRequest,
                                  tempopb.SearchTagsResponse),
             "SearchTagValues": _unary(search_tag_values,
@@ -283,6 +288,10 @@ class QuerierClient(_Base):
 
     def search_block(self, req) -> tempopb.SearchResponse:
         return self._call(SERVICE_QUERIER, "SearchBlock", req,
+                          tempopb.SearchResponse)
+
+    def search_blocks(self, req) -> tempopb.SearchResponse:
+        return self._call(SERVICE_QUERIER, "SearchBlocks", req,
                           tempopb.SearchResponse)
 
     def search_tags(self, tenant) -> tempopb.SearchTagsResponse:

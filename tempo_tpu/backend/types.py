@@ -53,6 +53,12 @@ class BlockMeta:
     bloom_shard_size_bytes: int = 0
     min_id: str = ""  # hex, lowest object id in block
     max_id: str = ""  # hex, highest object id in block
+    # search container geometry, recorded so the frontend can compute
+    # page-range jobs from the blocklist alone — no per-query header
+    # fetches (cf. reference BlockMeta Size/TotalRecords feeding
+    # searchsharding.go page math)
+    search_pages: int = 0
+    search_size: int = 0              # compressed container bytes
 
     def __post_init__(self):
         if not self.block_id:

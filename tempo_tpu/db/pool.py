@@ -13,10 +13,12 @@ import threading
 
 
 def run_jobs(jobs, fn, workers: int = 50, stop_on_first: bool = False,
-             collect_errors: bool = True):
+             collect_errors: bool = True, stop_event: threading.Event | None = None):
     """Run fn(job) for each job. Returns (results, errors) where results
     excludes None. With stop_on_first, pending jobs are skipped after the
-    first non-None result.
+    first non-None result. A caller-owned stop_event cancels remaining
+    jobs when set (the reference's Results quit channel, results.go:38-78
+    — search stops dispatching once the limit is met).
 
     Jobs run under a copy of the caller's contextvars context, so the
     active tracing span parents the per-block spans across the pool."""
@@ -24,7 +26,7 @@ def run_jobs(jobs, fn, workers: int = 50, stop_on_first: bool = False,
     errors = []
     if not jobs:
         return results, errors
-    stop = threading.Event()
+    stop = stop_event if stop_event is not None else threading.Event()
     lock = threading.Lock()
     caller_ctx = contextvars.copy_context()
 

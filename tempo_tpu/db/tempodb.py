@@ -93,16 +93,21 @@ class TempoDB:
     def _ensure_mesh(self) -> None:
         if self._mesh_resolved:
             return
-        self._mesh_resolved = True
-        if self.cfg.auto_mesh:
-            import jax
+        # serialized, flag set LAST: a concurrent first search must never
+        # see a half-configured engine (unsharded batch → dist kernel)
+        with self._search_lock:
+            if self._mesh_resolved:
+                return
+            if self.cfg.auto_mesh:
+                import jax
 
-            if len(jax.devices()) > 1:
-                from tempo_tpu.parallel.mesh import make_mesh
+                if len(jax.devices()) > 1:
+                    from tempo_tpu.parallel.mesh import make_mesh
 
-                self.mesh = make_mesh()
-                self.batcher.engine.mesh = self.mesh
-                self.batcher.engine.n_shards = int(self.mesh.devices.size)
+                    self.mesh = make_mesh()
+                    self.batcher.engine.mesh = self.mesh
+                    self.batcher.engine.n_shards = int(self.mesh.devices.size)
+            self._mesh_resolved = True
 
     # ------------------------------------------------------------------
     # Writer
@@ -226,9 +231,15 @@ class TempoDB:
         else:
             def pages_fn(bsb=bsb, s=start_page, c=n):
                 return bsb.pages().slice_pages(s, c)
-            # exact count comes from the slice at staging time; estimate
-            # proportionally for planning
-            n_entries = int(hdr["n_entries"] * n / max(1, total))
+            # exact slice occupancy: entries fill pages densely in build
+            # order, so page p holds min(E, total_entries - p*E) entries —
+            # the batcher subtracts this from kernel counts when a sliced
+            # job is pruned, and an estimate would corrupt the metrics
+            E = hdr["entries_per_page"]
+            n_entries = sum(
+                max(0, min(E, hdr["n_entries"] - p * E))
+                for p in range(start_page, start_page + n)
+            )
         return ScanJob(
             key=(m.block_id, start_page, n),
             pages_fn=pages_fn, header=hdr, n_pages=n, n_entries=n_entries,
@@ -273,12 +284,12 @@ class TempoDB:
         return results
 
     def _fallback_search(self, metas: list[BlockMeta], req,
-                         results: SearchResults,
-                         start_page: int = 0, pages: int | None = None) -> None:
-        """Trace-block proto scan for blocks lacking search data: decode
-        every object and evaluate the request against the full proto
-        (reference encoding/v2/backend_block.go:159-209 +
-        pkg/model/trace/matches.go:33-184)."""
+                         results: SearchResults) -> None:
+        """Whole-block trace proto scan for blocks lacking search data:
+        decode every object and evaluate the request against the full
+        proto (reference encoding/v2/backend_block.go:159-209 +
+        pkg/model/trace/matches.go:33-184). Always whole-block: search
+        page ranges address the container's page space, not this one."""
         from tempo_tpu.model.matches import matches as proto_matches
         from tempo_tpu.model.matches import trace_search_metadata
 
@@ -287,9 +298,8 @@ class TempoDB:
             codec = codec_for(m.data_encoding)
             obs.fallback_scans.inc(tenant=m.tenant_id)
             results.metrics.inspected_blocks += 1
-            results.metrics.inspected_bytes += block.bytes_in_pages(
-                start_page, pages)
-            for oid, obj in block.iter_objects(start_page, pages):
+            results.metrics.inspected_bytes += block.bytes_in_pages(0, None)
+            for oid, obj in block.iter_objects():
                 results.metrics.inspected_traces += 1
                 trace = codec.prepare_for_read(obj)
                 if proto_matches(trace, req):
@@ -318,12 +328,48 @@ class TempoDB:
         count = req.pages_to_search or None
         try:
             job = self._scan_job(meta, start, count)
-        except DoesNotExist:  # no search container: proto scan
-            self._fallback_search([meta], req.search_req, results,
-                                  start, count)
+        except DoesNotExist:
+            # No search container. Page ranges address CONTAINER pages, a
+            # different page space from trace-block pages, so a range is
+            # meaningless here: the start_page==0 job scans the whole
+            # trace block once; sibling range jobs contribute nothing
+            # (coverage stays exactly-once across the job set).
+            if start == 0:
+                self._fallback_search([meta], req.search_req, results)
             return results
         if job.n_pages > 0:
             self.batcher.search([job], req.search_req, results)
+        return results
+
+    def search_blocks(self, breq: tempopb.SearchBlocksRequest) -> SearchResults:
+        """A batched job request (many page-range jobs, one kernel
+        dispatch per geometry group) — the TPU-native protocol unit the
+        frontend emits. Jobs whose blocks lack a search container run the
+        proto fallback scan after the batched pass."""
+        from tempo_tpu.backend.raw import DoesNotExist
+
+        results = SearchResults.for_request(breq.search_req)
+        self._ensure_mesh()
+        jobs, fallback = [], []
+        for j in breq.jobs:
+            meta = BlockMeta(
+                tenant_id=breq.tenant_id, block_id=j.block_id,
+                encoding=j.encoding or "zstd", version=j.version or "vT1",
+                data_encoding=j.data_encoding or "v2",
+            )
+            try:
+                jobs.append(self._scan_job(meta, j.start_page,
+                                           j.pages_to_search or None))
+            except DoesNotExist:
+                # container missing: only the 0-start job scans (whole
+                # trace block, its own page space) — see search_block
+                if j.start_page == 0:
+                    fallback.append(meta)
+        self.batcher.search(jobs, breq.search_req, results)
+        for meta in fallback:
+            if results.complete:
+                break
+            self._fallback_search([meta], breq.search_req, results)
         return results
 
     # ------------------------------------------------------------------

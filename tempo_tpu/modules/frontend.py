@@ -32,6 +32,13 @@ class FrontendConfig:
     max_concurrent_jobs: int = 50    # reference: bounded fan-out 50
     retries: int = 2                 # reference retry ware
     tolerate_failed_blocks: int = 0
+    # page-range job sizing (reference searchsharding.go:26-27
+    # target_bytes_per_job default 10 MiB): a block whose search container
+    # exceeds this splits into multiple page-range jobs
+    target_bytes_per_job: int = 10 << 20
+    # TPU-native batching: jobs per SearchBlocksRequest, so each querier
+    # stacks its share into few kernel dispatches
+    batch_jobs_per_request: int = 32
 
 
 def create_block_boundaries(shards: int) -> list[str]:
@@ -124,7 +131,26 @@ class QueryFrontend:
                 results=len(resp.traces))
             return resp
 
+    def _block_jobs(self, metas) -> list[tuple]:
+        """Page-range jobs per block (reference searchsharding.go:323-367
+        backendRequests): pages_per_job from target_bytes_per_job and the
+        block's recorded container geometry; blocks without geometry info
+        (old metas, search-less blocks) become one whole-block job."""
+        jobs = []
+        for m in sorted(metas, key=lambda m: m.block_id):
+            if m.search_pages and m.search_size:
+                per_page = max(1, m.search_size // m.search_pages)
+                pages_per_job = max(1, self.cfg.target_bytes_per_job // per_page)
+                for sp in range(0, m.search_pages, pages_per_job):
+                    jobs.append((m, sp, min(pages_per_job,
+                                            m.search_pages - sp)))
+            else:
+                jobs.append((m, 0, 0))  # 0 = all pages / fallback scan
+        return jobs
+
     def _search(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
+        import threading
+
         db = self.db  # block metas come from the frontend's own reader
         metas = [
             m for m in db.blocklist.metas(tenant)
@@ -132,40 +158,74 @@ class QueryFrontend:
             and not (req.end and m.start_time and m.start_time > req.end)
         ]
 
-        jobs = [("recent", None)] + [("block", m) for m in metas]
-
-        def run(job):
-            kind, m = job
-            if kind == "recent":
-                return self._retrying(
-                    lambda _: self._querier().search_recent(tenant, req), job
-                )
-            breq = tempopb.SearchBlockRequest()
-            breq.search_req.CopyFrom(req)
-            breq.tenant_id = tenant
-            breq.block_id = m.block_id
-            breq.encoding = "zstd"
-            breq.version = m.version
-            breq.data_encoding = m.data_encoding
-            return self._retrying(
-                lambda _: self._querier().search_block(breq), job
-            )
-
-        responses, errors = run_jobs(jobs, run,
-                                     workers=self.cfg.max_concurrent_jobs)
-        # partial failures past the tolerance are an error, not a silently
-        # smaller answer (reference tolerate_failed_blocks → HTTP 206/5xx)
-        if len(errors) > self.cfg.tolerate_failed_blocks:
-            raise errors[0]
+        # group page-range jobs into batched requests — each querier
+        # stacks its share into few kernel dispatches
+        block_jobs = self._block_jobs(metas)
+        B = max(1, self.cfg.batch_jobs_per_request)
+        batches = [block_jobs[i:i + B] for i in range(0, len(block_jobs), B)]
+        jobs = [("recent", None)] + [("blocks", b) for b in batches]
 
         merged = SearchResults.for_request(req)
-        merged.metrics.skipped_blocks += len(errors)  # tolerated failures
-        for r in responses:
-            for t in r.traces:
-                merged.add(t)
-            m = merged.metrics
-            m.inspected_traces += r.metrics.inspected_traces
-            m.inspected_bytes += r.metrics.inspected_bytes
-            m.inspected_blocks += r.metrics.inspected_blocks
-            m.skipped_blocks += r.metrics.skipped_blocks
+        merge_lock = threading.Lock()
+        quit_event = threading.Event()
+        failed_blocks = [0]  # BLOCK count, not batch count — tolerance
+                             # keeps the reference's per-block semantics
+
+        def merge(r):
+            """Incremental merge so the limit can cancel remaining jobs
+            (reference results.go quit channel + searchsharding.go:219-274
+            stop-dispatch)."""
+            with merge_lock:
+                merged.merge_response(r)
+                if merged.complete:
+                    quit_event.set()
+
+        recent_failed = [False]
+
+        def run(job):
+            kind, payload = job
+            if kind == "recent":
+                try:
+                    r = self._retrying(
+                        lambda _: self._querier().search_recent(tenant, req),
+                        job,
+                    )
+                except Exception:
+                    recent_failed[0] = True  # ingester leg is not a block
+                    raise
+            else:
+                breq = tempopb.SearchBlocksRequest()
+                breq.search_req.CopyFrom(req)
+                breq.tenant_id = tenant
+                for m, sp, n in payload:
+                    j = breq.jobs.add()
+                    j.block_id = m.block_id
+                    j.start_page = sp
+                    j.pages_to_search = n
+                    j.encoding = m.encoding
+                    j.version = m.version
+                    j.data_encoding = m.data_encoding
+                try:
+                    r = self._retrying(
+                        lambda _: self._querier().search_blocks(breq), job
+                    )
+                except Exception:
+                    # one failed batch = every distinct block it carried
+                    with merge_lock:
+                        failed_blocks[0] += len({m.block_id
+                                                 for m, _, _ in payload})
+                    raise
+            merge(r)
+            return r
+
+        _, errors = run_jobs(jobs, run, workers=self.cfg.max_concurrent_jobs,
+                             stop_event=quit_event)
+        # partial failures past the tolerance are an error, not a silently
+        # smaller answer (reference tolerate_failed_blocks → HTTP 206/5xx)
+        if not quit_event.is_set() and errors and (
+            recent_failed[0]
+            or failed_blocks[0] > self.cfg.tolerate_failed_blocks
+        ):
+            raise errors[0]
+        merged.metrics.skipped_blocks += failed_blocks[0]  # tolerated
         return merged.response()

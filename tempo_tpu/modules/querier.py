@@ -109,6 +109,31 @@ class Querier:
             return self._search_external(req)
         return self.db.search_block(req).response()
 
+    def search_blocks(self, req: tempopb.SearchBlocksRequest) -> tempopb.SearchResponse:
+        """Batched job execution: one kernel dispatch per geometry group.
+        With serverless endpoints configured the batch degrades to
+        singular jobs so overflow can proxy out (the external workers
+        speak SearchBlockRequest)."""
+        if self.external_endpoints:
+            from tempo_tpu.search import SearchResults
+
+            results = SearchResults.for_request(req.search_req)
+            for j in req.jobs:
+                one = tempopb.SearchBlockRequest()
+                one.search_req.CopyFrom(req.search_req)
+                one.tenant_id = req.tenant_id
+                one.block_id = j.block_id
+                one.start_page = j.start_page
+                one.pages_to_search = j.pages_to_search
+                one.encoding = j.encoding
+                one.version = j.version
+                one.data_encoding = j.data_encoding
+                results.merge_response(self.search_block(one))
+                if results.complete:
+                    break
+            return results.response()
+        return self.db.search_blocks(req).response()
+
     def _search_external(self, req: tempopb.SearchBlockRequest) -> tempopb.SearchResponse:
         """Proxy one job to a serverless search worker, hedged (reference
         searchExternalEndpoint: up to 2 extra hedges)."""

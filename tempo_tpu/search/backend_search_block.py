@@ -53,6 +53,14 @@ def write_search_block(backend: RawBackend, meta: BlockMeta,
     backend.write(meta.tenant_id, meta.block_id, NAME_SEARCH, blob)
     backend.write(meta.tenant_id, meta.block_id, NAME_SEARCH_HEADER,
                   json.dumps(header).encode())
+    # record the container geometry on the block meta and re-commit it —
+    # meta.json written last stays the commit record, now carrying what
+    # the frontend job sharder needs (page count/bytes for range math)
+    meta.search_pages = header["n_pages"]
+    meta.search_size = len(blob)
+    meta.search_entries_per_page = header["entries_per_page"]
+    meta.search_kv_per_entry = header["kv_per_entry"]
+    backend.write_block_meta(meta)
     return header
 
 

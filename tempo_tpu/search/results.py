@@ -46,6 +46,17 @@ class SearchResults:
                 prev.root_service_name = meta.root_service_name
                 prev.root_trace_name = meta.root_trace_name
 
+    def merge_response(self, resp: tempopb.SearchResponse) -> None:
+        """Fold a sub-request's response in: dedupe traces, sum metrics
+        (the frontend/querier merge, reference searchsharding.go:70-124)."""
+        for t in resp.traces:
+            self.add(t)
+        m = self.metrics
+        m.inspected_traces += resp.metrics.inspected_traces
+        m.inspected_bytes += resp.metrics.inspected_bytes
+        m.inspected_blocks += resp.metrics.inspected_blocks
+        m.skipped_blocks += resp.metrics.skipped_blocks
+
     @property
     def complete(self) -> bool:
         return not self.no_quit and len(self._by_id) >= self.limit

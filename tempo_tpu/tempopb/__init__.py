@@ -16,6 +16,8 @@ TraceByIDResponse = tempo_pb2.TraceByIDResponse
 TraceByIDMetrics = tempo_pb2.TraceByIDMetrics
 SearchRequest = tempo_pb2.SearchRequest
 SearchBlockRequest = tempo_pb2.SearchBlockRequest
+SearchBlocksRequest = tempo_pb2.SearchBlocksRequest
+BlockSearchJob = tempo_pb2.BlockSearchJob
 SearchResponse = tempo_pb2.SearchResponse
 TraceSearchMetadata = tempo_pb2.TraceSearchMetadata
 SearchMetrics = tempo_pb2.SearchMetrics
@@ -36,7 +38,8 @@ AnyValue = trace_pb2.AnyValue
 __all__ = [
     "Trace", "PushBytesRequest", "PushResponse", "TraceByIDRequest",
     "TraceByIDResponse", "TraceByIDMetrics", "SearchRequest",
-    "SearchBlockRequest", "SearchResponse", "TraceSearchMetadata",
+    "SearchBlockRequest", "SearchBlocksRequest", "BlockSearchJob",
+    "SearchResponse", "TraceSearchMetadata",
     "SearchMetrics", "SearchTagsRequest", "SearchTagsResponse",
     "SearchTagValuesRequest", "SearchTagValuesResponse", "PartialsResponse",
     "ResourceSpans", "ScopeSpans", "Span", "Status", "Resource",

@@ -266,3 +266,153 @@ def test_complete_one_restores_on_failure(tmp_path):
     assert len(inst.completing) == 1  # restored, not lost
     app.backend.write = real_write
     assert inst.complete_one() is not None  # retried successfully
+
+
+# ---- round 2: page-range job sharding + batched dispatch + early quit ----
+
+def _frontend_db(tmp_path, n_blocks=3, per_block=200, **db_kw):
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.model.codec import codec_for
+    from tempo_tpu.search.columnar import PageGeometry
+    from tempo_tpu.search.data import extract_search_data
+
+    db = TempoDB(LocalBackend(str(tmp_path / "blocks")), str(tmp_path / "w"),
+                 TempoDBConfig(search_geometry=PageGeometry(32, 16), **db_kw))
+    codec = codec_for("v2")
+    all_sds = []
+    for b in range(n_blocks):
+        objs, sds = [], []
+        for i in range(per_block):
+            tid = random_trace_id()
+            tr = make_trace(tid, seed=b * 1000 + i)
+            sd = extract_search_data(tid, tr)
+            objs.append((tid, codec.marshal(tr, sd.start_s, sd.end_s),
+                         sd.start_s, sd.end_s))
+            sds.append(sd)
+        db.write_block_direct("t1", sorted(objs), search_entries=sds)
+        all_sds.extend(sds)
+    return db, all_sds
+
+
+def test_frontend_page_range_jobs_merge_to_whole(tmp_path):
+    """A large block splits into N page-range jobs whose merged result
+    equals the single-job result (reference searchsharding.go:323-367),
+    and the job encoding comes from the block meta, not a constant."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.search.data import search_data_matches
+
+    db, all_sds = _frontend_db(tmp_path)
+    metas = db.blocklist.metas("t1")
+    assert all(m.search_pages > 1 for m in metas)  # multi-page containers
+
+    q = Querier(db, Ring(), {})
+    # tiny job target -> one page per job
+    fe_split = QueryFrontend([q], FrontendConfig(target_bytes_per_job=1,
+                                                 batch_jobs_per_request=4))
+    jobs = fe_split._block_jobs(metas)
+    assert len(jobs) == sum(m.search_pages for m in metas)
+    assert {j[0].encoding for j in jobs} == {m.encoding for m in metas}
+
+    # huge target -> one job per block
+    fe_whole = QueryFrontend([q], FrontendConfig())
+    assert len(fe_whole._block_jobs(metas)) == len(metas)
+
+    req = _mk_req({"component": "grpc"})
+    req.limit = 10_000
+    r_split = fe_split.search("t1", req)
+    r_whole = fe_whole.search("t1", req)
+    expected = {sd.trace_id.hex() for sd in all_sds
+                if search_data_matches(sd, req)}
+    assert {t.trace_id for t in r_split.traces} == expected
+    assert {t.trace_id for t in r_whole.traces} == expected
+    assert r_split.metrics.inspected_traces == r_whole.metrics.inspected_traces
+
+
+def test_frontend_mixed_encoding_blocks(tmp_path):
+    """Blocks written with different codecs search correctly through the
+    page-range path (round-1 hardcoded 'zstd' would corrupt this)."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.search.data import search_data_matches
+
+    db, sds1 = _frontend_db(tmp_path, n_blocks=1)
+    db.cfg.block_encoding = "lz4"
+    db.cfg.search_encoding = "snappy"
+    from tempo_tpu.model.codec import codec_for
+    from tempo_tpu.search.data import extract_search_data
+    codec = codec_for("v2")
+    objs, sds2 = [], []
+    for i in range(150):
+        tid = random_trace_id()
+        tr = make_trace(tid, seed=5000 + i)
+        sd = extract_search_data(tid, tr)
+        objs.append((tid, codec.marshal(tr, sd.start_s, sd.end_s),
+                     sd.start_s, sd.end_s))
+        sds2.append(sd)
+    db.write_block_direct("t1", sorted(objs), search_entries=sds2)
+
+    metas = db.blocklist.metas("t1")
+    assert {m.encoding for m in metas} == {"zstd", "lz4"}
+
+    q = Querier(db, Ring(), {})
+    fe = QueryFrontend([q], FrontendConfig(target_bytes_per_job=1))
+    req = _mk_req({"component": "grpc"})
+    req.limit = 10_000
+    r = fe.search("t1", req)
+    expected = {sd.trace_id.hex() for sd in sds1 + sds2
+                if search_data_matches(sd, req)}
+    assert {t.trace_id for t in r.traces} == expected
+
+
+def test_frontend_early_quit_stops_dispatch(tmp_path):
+    """A limit-hit query over many batches cancels the remaining jobs:
+    inspected_blocks << total (reference results.go:38-78 quit +
+    searchsharding.go stop-dispatch)."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+
+    db, all_sds = _frontend_db(tmp_path, n_blocks=8, per_block=64)
+    q = Querier(db, Ring(), {})
+    fe = QueryFrontend([q], FrontendConfig(batch_jobs_per_request=1,
+                                           max_concurrent_jobs=1))
+    req = _mk_req({})
+    req.limit = 5
+    r = fe.search("t1", req)
+    assert len(r.traces) == 5
+    assert r.metrics.inspected_blocks < 8, r.metrics
+
+
+def test_frontend_tolerance_counts_blocks_not_batches(tmp_path):
+    """One failed SearchBlocksRequest covers all its blocks: tolerance
+    compares BLOCK counts (reference tolerate_failed_blocks semantics),
+    not batch counts."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+
+    db, _ = _frontend_db(tmp_path, n_blocks=4, per_block=40)
+    q = Querier(db, Ring(), {})
+
+    class FailingBatches:
+        """Querier facade that fails every batched block request."""
+        def search_recent(self, tenant, req):
+            return q.search_recent(tenant, req)
+
+        def search_blocks(self, breq):
+            raise RuntimeError("querier down")
+
+    req = _mk_req({})
+    req.limit = 10_000
+
+    # tolerance 3 < 4 failed blocks (one batch of 4) -> error surfaces
+    fe = QueryFrontend([FailingBatches()], FrontendConfig(
+        batch_jobs_per_request=4, retries=0, tolerate_failed_blocks=3), db=db)
+    with pytest.raises(RuntimeError):
+        fe.search("t1", req)
+
+    # tolerance 4 covers it -> partial (ingester-only) result, skipped=4
+    fe2 = QueryFrontend([FailingBatches()], FrontendConfig(
+        batch_jobs_per_request=4, retries=0, tolerate_failed_blocks=4), db=db)
+    r = fe2.search("t1", req)
+    assert r.metrics.skipped_blocks == 4
