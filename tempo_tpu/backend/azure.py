@@ -110,6 +110,34 @@ class AzureBackend(RawBackend):
                                "Content-Type": "application/octet-stream"},
                       operation="PUT")
 
+    # ---- streaming append via block blobs (reference
+    # tempodb/backend/azure: Put Block per part + Put Block List on close;
+    # block ids are base64, fixed-length per blob).
+
+    def append(self, tenant, block_id, name, tracker, data: bytes):
+        import base64
+
+        if tracker is None:
+            tracker = {"block_ids": []}
+        bid = base64.b64encode(
+            f"blk-{len(tracker['block_ids']):08d}".encode()).decode()
+        self._request("PUT", self._key(tenant, block_id, name),
+                      query={"comp": "block", "blockid": bid},
+                      body=data, operation="PUT_BLOCK", ok=(201,))
+        tracker["block_ids"].append(bid)
+        return tracker
+
+    def close_append(self, tenant, block_id, name, tracker) -> None:
+        if tracker is None:
+            return
+        blocks = "".join(f"<Latest>{b}</Latest>" for b in tracker["block_ids"])
+        body = (f"<?xml version='1.0' encoding='utf-8'?>"
+                f"<BlockList>{blocks}</BlockList>").encode()
+        self._request("PUT", self._key(tenant, block_id, name),
+                      query={"comp": "blocklist"},
+                      headers={"Content-Type": "application/xml"},
+                      body=body, operation="PUT_BLOCK_LIST", ok=(201,))
+
     def read(self, tenant, block_id, name) -> bytes:
         _, _, data = self._request("GET", self._key(tenant, block_id, name),
                                    operation="GET")

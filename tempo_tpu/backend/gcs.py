@@ -114,6 +114,61 @@ class GCSBackend(RawBackend):
                                "Content-Length": str(len(data))},
                       body=data, operation="PUT")
 
+    # ---- streaming append via resumable upload (the GCS counterpart of
+    # the reference's streaming writer): POST uploadType=resumable opens a
+    # session; each part PUTs with a Content-Range; chunks must be 256 KiB
+    # multiples except the last, so sub-multiple appends coalesce.
+
+    _CHUNK_QUANTUM = 256 << 10
+
+    def append(self, tenant, block_id, name, tracker, data: bytes):
+        if tracker is None:
+            path = (f"/upload/storage/v1/b/"
+                    f"{urllib.parse.quote(self.bucket, safe='')}/o")
+            _, headers, _ = self._request(
+                "POST", path,
+                query={"uploadType": "resumable",
+                       "name": self._key(tenant, block_id, name)},
+                headers={"Content-Type": "application/json"},
+                body=b"{}", operation="CREATE_RESUMABLE")
+            session = headers.get("Location", headers.get("location", ""))
+            if not session:
+                raise BackendError("resumable upload returned no session URI")
+            # the session URI is absolute; keep only path?query for the
+            # transport (same host)
+            u = urllib.parse.urlsplit(session)
+            tracker = {"session": u.path, "query": dict(
+                urllib.parse.parse_qsl(u.query)), "offset": 0, "pending": b""}
+        tracker["pending"] += data
+        n = len(tracker["pending"]) // self._CHUNK_QUANTUM * self._CHUNK_QUANTUM
+        if n:
+            self._put_chunk(tracker, tracker["pending"][:n], final=False)
+            tracker["pending"] = tracker["pending"][n:]
+        return tracker
+
+    def _put_chunk(self, tracker, chunk: bytes, final: bool) -> None:
+        start = tracker["offset"]
+        end = start + len(chunk)
+        total = str(end) if final else "*"
+        if chunk:
+            rng = f"bytes {start}-{end - 1}/{total}"
+        else:
+            rng = f"bytes */{total}"  # zero-byte finalize
+        # 308 = Resume Incomplete (intermediate chunk ack)
+        self._request("PUT", tracker["session"],
+                      query=tracker["query"],
+                      headers={"Content-Range": rng,
+                               "Content-Length": str(len(chunk))},
+                      body=chunk, operation="UPLOAD_CHUNK",
+                      ok=(200, 201, 308))
+        tracker["offset"] = end
+
+    def close_append(self, tenant, block_id, name, tracker) -> None:
+        if tracker is None:
+            return
+        self._put_chunk(tracker, tracker["pending"], final=True)
+        tracker["pending"] = b""
+
     def read(self, tenant, block_id, name) -> bytes:
         _, _, data = self._request(
             "GET", self._obj_path(self._key(tenant, block_id, name)),

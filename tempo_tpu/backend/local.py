@@ -39,6 +39,25 @@ class LocalBackend(RawBackend):
                 os.unlink(tmp)
             raise
 
+    def append(self, tenant, block_id, name, tracker, data: bytes):
+        """Native streaming append: parts accumulate in a hidden temp file
+        that becomes visible atomically at close_append (the write()
+        temp+rename contract, extended to incremental writers)."""
+        if tracker is None:
+            d = self._p(tenant, block_id)
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=f".{name}.append.")
+            os.close(fd)
+            tracker = tmp
+        with open(tracker, "ab") as f:
+            f.write(data)
+        return tracker
+
+    def close_append(self, tenant, block_id, name, tracker) -> None:
+        if tracker is None:
+            return
+        os.replace(tracker, self._p(tenant, block_id, name))
+
     def read(self, tenant, block_id, name) -> bytes:
         try:
             with open(self._p(tenant, block_id, name), "rb") as f:

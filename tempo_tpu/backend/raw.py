@@ -48,6 +48,31 @@ class RawBackend(abc.ABC):
     def delete(self, tenant: str, block_id: str | None, name: str) -> None:
         ...
 
+    # ---- append (reference raw.go Append/CloseAppend + AppendTracker):
+    # large objects stream out in parts so block completion and compaction
+    # never hold a whole block in memory (S3 multipart emulation etc.,
+    # reference tempodb/backend/s3/s3.go). Default implementation buffers
+    # parts and writes once on close — correct for any backend, bounded
+    # only by the object size; real backends override with native
+    # multipart/resumable/block-list uploads.
+
+    def append(self, tenant: str, block_id: str | None, name: str,
+               tracker, data: bytes):
+        """Append `data` to an object under construction. `tracker` is the
+        value returned by the previous append (None starts a new one).
+        Returns the updated tracker. The object is not visible until
+        close_append."""
+        if tracker is None:
+            tracker = []
+        tracker.append(bytes(data))
+        return tracker
+
+    def close_append(self, tenant: str, block_id: str | None, name: str,
+                     tracker) -> None:
+        """Finalize an appended object (commit point for `name`)."""
+        if tracker is not None:
+            self.write(tenant, block_id, name, b"".join(tracker))
+
     @abc.abstractmethod
     def list_tenants(self) -> list[str]:
         ...

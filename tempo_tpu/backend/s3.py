@@ -171,6 +171,54 @@ class S3Backend(RawBackend):
         self._request("DELETE", self._key(tenant, block_id, name),
                       operation="DELETE", ok=(200, 204))
 
+    # ---- streaming append via multipart upload (reference
+    # tempodb/backend/s3/s3.go append emulation: CreateMultipartUpload →
+    # UploadPart per Append → CompleteMultipartUpload on CloseAppend).
+    # Parts under 5 MiB (except the last) are rejected by real S3, so
+    # sub-minimum appends coalesce into a pending buffer.
+
+    _MIN_PART = 5 << 20
+
+    def append(self, tenant, block_id, name, tracker, data: bytes):
+        key = self._key(tenant, block_id, name)
+        if tracker is None:
+            _, _, body = self._request("POST", key, query={"uploads": ""},
+                                       operation="CREATE_MULTIPART")
+            upload_id = next(iter(self._xml_texts(
+                ET.fromstring(body), "UploadId")), "")
+            if not upload_id:
+                raise BackendError("multipart create returned no UploadId")
+            tracker = {"upload_id": upload_id, "etags": [], "pending": b""}
+        tracker["pending"] += data
+        if len(tracker["pending"]) >= self._MIN_PART:
+            self._upload_part(key, tracker)
+        return tracker
+
+    def _upload_part(self, key: str, tracker) -> None:
+        part_num = len(tracker["etags"]) + 1
+        status, headers, _ = self._request(
+            "PUT", key,
+            query={"partNumber": str(part_num),
+                   "uploadId": tracker["upload_id"]},
+            body=tracker["pending"], operation="UPLOAD_PART")
+        etag = headers.get("ETag", headers.get("Etag", ""))
+        tracker["etags"].append(etag)
+        tracker["pending"] = b""
+
+    def close_append(self, tenant, block_id, name, tracker) -> None:
+        if tracker is None:
+            return
+        key = self._key(tenant, block_id, name)
+        if tracker["pending"] or not tracker["etags"]:
+            self._upload_part(key, tracker)  # final part may be < 5 MiB
+        parts = "".join(
+            f"<Part><PartNumber>{i + 1}</PartNumber><ETag>{e}</ETag></Part>"
+            for i, e in enumerate(tracker["etags"]))
+        body = (f"<CompleteMultipartUpload>{parts}"
+                "</CompleteMultipartUpload>").encode()
+        self._request("POST", key, query={"uploadId": tracker["upload_id"]},
+                      body=body, operation="COMPLETE_MULTIPART")
+
     @staticmethod
     def _xml_texts(root: ET.Element, path: str) -> list[str]:
         """findall tolerating namespaced and bare tags (minio vs AWS vs mock):

@@ -77,9 +77,15 @@ def compact_blocks(backend: RawBackend, tenant: str, inputs: list[BlockMeta],
                    page_size: int = 1 << 20,
                    compact_search: bool = True,
                    search_geometry=None,
-                   search_encoding: str | None = None) -> BlockMeta:
+                   search_encoding: str | None = None,
+                   flush_size: int | None = None) -> BlockMeta:
     """Merge input blocks into one new block at level+1, combining
-    duplicate trace objects; mark inputs compacted."""
+    duplicate trace objects; mark inputs compacted. The output streams to
+    the backend every `flush_size` bytes (30 MB default, reference
+    compactor.go:109-115) so compaction memory is bounded by the flush
+    size + one input page per block, not the output block size."""
+    from tempo_tpu.encoding.v2.streaming_block import DEFAULT_FLUSH_SIZE
+
     codec = codec_for(inputs[0].data_encoding)
     out_meta = BlockMeta(
         tenant_id=tenant,
@@ -87,7 +93,8 @@ def compact_blocks(backend: RawBackend, tenant: str, inputs: list[BlockMeta],
         data_encoding=inputs[0].data_encoding,
         compaction_level=max(m.compaction_level for m in inputs) + 1,
     )
-    out = StreamingBlock(out_meta, page_size=page_size)
+    out = StreamingBlock(out_meta, page_size=page_size, backend=backend,
+                         flush_size=flush_size or DEFAULT_FLUSH_SIZE)
 
     iters = [BackendBlock(backend, m).iter_objects() for m in inputs]
     merged = heapq.merge(*iters, key=lambda kv: kv[0])
@@ -110,7 +117,7 @@ def compact_blocks(backend: RawBackend, tenant: str, inputs: list[BlockMeta],
             pending.append(data)  # same trace in 2+ blocks → combine
     flush()
 
-    new_meta = out.complete(backend)
+    new_meta = out.complete()
 
     if compact_search:
         _compact_search_blocks(backend, tenant, inputs, new_meta,
@@ -121,33 +128,81 @@ def compact_blocks(backend: RawBackend, tenant: str, inputs: list[BlockMeta],
     return new_meta
 
 
+def _spill_block_entries_sorted(backend: RawBackend, tenant: str,
+                                m: BlockMeta):
+    """One input block's search entries, sorted by trace id and SPILLED to
+    a temp file (u32-framed wire codec), then streamed back one entry at a
+    time. Only one input container is ever decoded in memory; during the
+    k-way merge each stream costs a single entry — the heap heads — so
+    merge memory is O(inputs), not O(total entries)."""
+    import json
+    import struct
+    import tempfile
+
+    from tempo_tpu.backend.types import NAME_SEARCH
+    from tempo_tpu.encoding.v2.compression import decompress
+    from tempo_tpu.search.columnar import ColumnarPages
+    from tempo_tpu.search.data import decode_search_data, encode_search_data
+
+    hdr = json.loads(backend.read(tenant, m.block_id, "search-header.json"))
+    raw = decompress(backend.read(tenant, m.block_id, NAME_SEARCH),
+                     hdr.get("encoding", "zstd"))
+    entries = ColumnarPages.from_bytes(raw).to_entries()
+    entries.sort(key=lambda sd: sd.trace_id)
+
+    u32 = struct.Struct("<I")
+    spill = tempfile.TemporaryFile()
+    for sd in entries:
+        payload = sd.trace_id + encode_search_data(sd)
+        spill.write(u32.pack(len(payload)) + payload)
+    del entries, raw
+    spill.seek(0)
+
+    def stream():
+        with spill:
+            while True:
+                frame = spill.read(4)
+                if len(frame) < 4:
+                    return
+                (n,) = u32.unpack(frame)
+                payload = spill.read(n)
+                yield decode_search_data(payload[16:], payload[:16])
+
+    return stream()
+
+
 def _compact_search_blocks(backend: RawBackend, tenant: str,
                            inputs: list[BlockMeta], new_meta: BlockMeta,
                            search_geometry=None,
                            search_encoding: str | None = None) -> None:
+    """K-way merge over per-block sorted entry streams spilled to disk:
+    duplicates combine as they meet at the heap head. Peak memory is one
+    input container during its spill + the heap heads + the merged OUTPUT
+    entries (the one-block floor the columnar array build requires; each
+    entry is capped at 5 KB by extraction, reference limits.go) — never
+    all inputs at once as in round 1."""
     from tempo_tpu.search.backend_search_block import write_search_block
-    from tempo_tpu.search.columnar import ColumnarPages, PageGeometry
-    from tempo_tpu.search.data import SearchData
-    from tempo_tpu.backend.types import NAME_SEARCH
-    from tempo_tpu.encoding.v2.compression import decompress
-    import json
+    from tempo_tpu.search.columnar import PageGeometry
 
-    merged: dict[bytes, SearchData] = {}
+    streams = []
     for m in inputs:
         try:
-            hdr = json.loads(backend.read(tenant, m.block_id, "search-header.json"))
-            raw = decompress(backend.read(tenant, m.block_id, NAME_SEARCH),
-                             hdr.get("encoding", "zstd"))
-            for sd in ColumnarPages.from_bytes(raw).to_entries():
-                cur = merged.get(sd.trace_id)
-                if cur is None:
-                    merged[sd.trace_id] = sd
-                else:
-                    cur.merge(sd)
+            streams.append(_spill_block_entries_sorted(backend, tenant, m))
         except (BackendError, ValueError):
             continue  # inputs without search data contribute nothing
-    if merged:
-        entries = [merged[t] for t in sorted(merged)]
+
+    entries = []
+    pending = None
+    for sd in heapq.merge(*streams, key=lambda sd: sd.trace_id):
+        if pending is not None and pending.trace_id == sd.trace_id:
+            pending.merge(sd)  # same trace across blocks
+            continue
+        if pending is not None:
+            entries.append(pending)
+        pending = sd
+    if pending is not None:
+        entries.append(pending)
+    if entries:
         write_search_block(backend, new_meta, entries,
                            geometry=search_geometry or PageGeometry(),
                            encoding=search_encoding or "zstd")

@@ -44,6 +44,7 @@ class TempoDBConfig:
     blocklist_poll_s: int = 30
     compaction_window_s: int = 3600
     compaction_max_inputs: int = 8
+    compaction_flush_bytes: int = 30 << 20   # reference FlushSizeBytes
     retention_s: int = 14 * 24 * 3600
     compacted_retention_s: int = 3600
     search_geometry: PageGeometry = field(default_factory=PageGeometry)
@@ -383,7 +384,8 @@ class TempoDB:
         new_meta = compact_blocks(self.backend, tenant, inputs,
                                   page_size=self.cfg.block_page_size,
                                   search_geometry=self.cfg.search_geometry,
-                                  search_encoding=self.cfg.search_encoding)
+                                  search_encoding=self.cfg.search_encoding,
+                                  flush_size=self.cfg.compaction_flush_bytes)
         obs.compactions.inc(tenant=tenant)
         from tempo_tpu.backend.types import CompactedBlockMeta
 

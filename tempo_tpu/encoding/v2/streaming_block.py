@@ -25,19 +25,32 @@ DEFAULT_PAGE_SIZE = 1 << 20          # 1 MiB uncompressed, cf. reference index d
 DEFAULT_RECORDS_PER_INDEX_PAGE = 1024
 DEFAULT_BLOOM_FP = 0.01
 DEFAULT_BLOOM_SHARD_SIZE = 100 << 10  # reference: 100 KiB shards
+DEFAULT_FLUSH_SIZE = 30 << 20         # reference compactor.go:17-26 FlushSizeBytes
 
 
 class StreamingBlock:
     def __init__(self, meta: BlockMeta,
                  page_size: int = DEFAULT_PAGE_SIZE,
                  records_per_index_page: int = DEFAULT_RECORDS_PER_INDEX_PAGE,
-                 bloom_fp: float = DEFAULT_BLOOM_FP):
+                 bloom_fp: float = DEFAULT_BLOOM_FP,
+                 backend: RawBackend | None = None,
+                 flush_size: int = DEFAULT_FLUSH_SIZE):
+        """With `backend`, buffered compressed pages stream out through
+        backend.append every `flush_size` bytes (the reference's 30 MB
+        flush through S3-multipart append emulation) so arbitrarily large
+        blocks build in bounded memory. Without it, pages accumulate and
+        write once at complete() — fine for WAL-sized blocks."""
         self.meta = meta
         self.page_size = page_size
         self.records_per_index_page = records_per_index_page
         self.bloom_fp = bloom_fp
+        self.backend = backend
+        self.flush_size = flush_size
 
         self._pages: list[bytes] = []
+        self._pages_bytes = 0
+        self._tracker = None
+        self._appending = False
         self._records: list[Record] = []
         self._cur = bytearray()
         self._cur_max_id = b""
@@ -66,14 +79,37 @@ class StreamingBlock:
             return
         page = compress(bytes(self._cur), self.meta.encoding)
         self._pages.append(page)
+        self._pages_bytes += len(page)
         self._records.append(Record(self._cur_max_id, self._offset, len(page)))
         self._offset += len(page)
         self._cur = bytearray()
+        if self.backend is not None and self._pages_bytes >= self.flush_size:
+            self._flush_pages()
 
-    def complete(self, backend: RawBackend) -> BlockMeta:
+    def _flush_pages(self) -> None:
+        """Stream buffered compressed pages to the backend (append part);
+        memory drops back to ~one page."""
+        if not self._pages:
+            return
+        self._tracker = self.backend.append(
+            self.meta.tenant_id, self.meta.block_id, NAME_DATA,
+            self._tracker, b"".join(self._pages))
+        self._appending = True
+        self._pages = []
+        self._pages_bytes = 0
+
+    def complete(self, backend: RawBackend | None = None) -> BlockMeta:
         """Write data, index, blooms, then meta last (commit point)."""
+        backend = backend if backend is not None else self.backend
         self._cut_page()
-        data = b"".join(self._pages)
+        if self._appending:
+            # finish the append stream (data object commits here)
+            self._flush_pages()
+            backend.close_append(self.meta.tenant_id, self.meta.block_id,
+                                 NAME_DATA, self._tracker)
+            data = None
+        else:
+            data = b"".join(self._pages)
 
         shards = max(1, -(-len(self._ids) * 16 // DEFAULT_BLOOM_SHARD_SIZE))
         bloom = ShardedBloom(
@@ -85,7 +121,7 @@ class StreamingBlock:
             bloom.add(i)
 
         m = self.meta
-        m.size = len(data)
+        m.size = self._offset
         m.total_records = len(self._records)
         m.index_page_size = self.records_per_index_page
         m.bloom_shard_count = bloom.shard_count
@@ -94,7 +130,8 @@ class StreamingBlock:
             m.min_id = self._ids[0].hex()
             m.max_id = self._ids[-1].hex()
 
-        backend.write(m.tenant_id, m.block_id, NAME_DATA, data)
+        if data is not None:
+            backend.write(m.tenant_id, m.block_id, NAME_DATA, data)
         backend.write(
             m.tenant_id, m.block_id, NAME_INDEX,
             IndexWriter(self.records_per_index_page).write(self._records),

@@ -113,6 +113,37 @@ class MockS3Handler(_Base):
             return self.reply(403, b"<Error><Code>SignatureDoesNotMatch</Code></Error>")
         bucket, _, key = path.lstrip("/").partition("/")
         full = f"{bucket}/{key}"
+        uploads = getattr(self.server, "uploads", None)
+        if uploads is None:
+            uploads = self.server.uploads = {}
+        # multipart upload protocol (CreateMultipartUpload / UploadPart /
+        # CompleteMultipartUpload), as the real service and minio speak it
+        if self.command == "POST" and "uploads" in q:
+            uid = hashlib.sha1(f"{full}{len(uploads)}".encode()).hexdigest()
+            with self.server.lock:
+                uploads[uid] = {"key": full, "parts": {}}
+            xml = (f"<InitiateMultipartUploadResult><UploadId>{uid}"
+                   "</UploadId></InitiateMultipartUploadResult>")
+            return self.reply(200, xml.encode(), "application/xml")
+        if self.command == "PUT" and "partNumber" in q and "uploadId" in q:
+            uid = q["uploadId"]
+            with self.server.lock:
+                up = uploads.get(uid)
+                if up is None or up["key"] != full:
+                    return self.reply(404, b"<Error><Code>NoSuchUpload</Code></Error>")
+                up["parts"][int(q["partNumber"])] = body
+            etag = f'"{hashlib.md5(body).hexdigest()}"'
+            return self.reply(200, extra={"ETag": etag})
+        if self.command == "POST" and "uploadId" in q:
+            uid = q["uploadId"]
+            with self.server.lock:
+                up = uploads.pop(uid, None)
+                if up is None or up["key"] != full:
+                    return self.reply(404, b"<Error><Code>NoSuchUpload</Code></Error>")
+                self.store[full] = b"".join(
+                    up["parts"][n] for n in sorted(up["parts"]))
+            return self.reply(200, b"<CompleteMultipartUploadResult/>",
+                              "application/xml")
         if self.command == "PUT":
             with self.server.lock:
                 self.store[full] = body
@@ -157,7 +188,7 @@ class MockS3Handler(_Base):
         xml.append("</ListBucketResult>")
         return self.reply(200, "".join(xml).encode(), "application/xml")
 
-    do_GET = do_PUT = do_DELETE = do_HEAD = _handle
+    do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
 
 
 # ---------------------------------------------------------------------------
@@ -177,6 +208,39 @@ class MockGCSHandler(_Base):
         body = self.body()
         if not self._authed():
             return self.reply(401, b"{}", "application/json")
+        sessions = getattr(self.server, "sessions", None)
+        if sessions is None:
+            sessions = self.server.sessions = {}
+        # resumable upload protocol: session create + Content-Range chunks
+        if (self.command == "POST" and path.startswith("/upload/storage/v1/b/")
+                and q.get("uploadType") == "resumable"):
+            sid = hashlib.sha1(f"{q['name']}{len(sessions)}".encode()).hexdigest()
+            with self.server.lock:
+                sessions[sid] = {"name": q["name"], "data": b""}
+            loc = f"http://{self.headers['Host']}/upload/session/{sid}"
+            return self.reply(200, b"{}", "application/json",
+                              extra={"Location": loc})
+        if self.command == "PUT" and path.startswith("/upload/session/"):
+            sid = path.rsplit("/", 1)[1]
+            with self.server.lock:
+                sess = sessions.get(sid)
+                if sess is None:
+                    return self.reply(404, b"{}", "application/json")
+                rng = self.headers.get("Content-Range", "")
+                # "bytes start-end/total", "bytes */total"
+                spec, _, total = rng.partition("/")
+                if not spec.startswith("bytes"):
+                    return self.reply(400, b"{}", "application/json")
+                if body:
+                    start_s = spec.split(" ", 1)[1].split("-")[0]
+                    if start_s != "*" and int(start_s) != len(sess["data"]):
+                        return self.reply(400, b"{}", "application/json")
+                    sess["data"] += body
+                if total != "*" and len(sess["data"]) == int(total):
+                    self.store[sess["name"]] = sess["data"]
+                    del sessions[sid]
+                    return self.reply(200, b"{}", "application/json")
+            return self.reply(308, b"", "application/json")
         if self.command == "POST" and path.startswith("/upload/storage/v1/b/"):
             with self.server.lock:
                 self.store[q["name"]] = body
@@ -213,7 +277,7 @@ class MockGCSHandler(_Base):
             return self.reply(200, json.dumps(doc).encode(), "application/json")
         return self.reply(400, b"{}", "application/json")
 
-    do_GET = do_POST = do_DELETE = _handle
+    do_GET = do_PUT = do_POST = do_DELETE = _handle
 
 
 # ---------------------------------------------------------------------------
@@ -238,6 +302,27 @@ class MockAzureHandler(_Base):
         full = f"{container}/{key}"
         if q.get("comp") == "list":
             return self._list(container, q)
+        blocks = getattr(self.server, "blocks", None)
+        if blocks is None:
+            blocks = self.server.blocks = {}
+        # block-blob protocol: Put Block + Put Block List
+        if self.command == "PUT" and q.get("comp") == "block":
+            with self.server.lock:
+                blocks[(full, q["blockid"])] = body
+            return self.reply(201)
+        if self.command == "PUT" and q.get("comp") == "blocklist":
+            import re
+
+            ids = re.findall(r"<Latest>([^<]+)</Latest>", body.decode())
+            with self.server.lock:
+                try:
+                    data = b"".join(blocks[(full, b)] for b in ids)
+                except KeyError:
+                    return self.reply(400, b"<Error>InvalidBlockList</Error>")
+                self.store[full] = data
+                for b in ids:
+                    blocks.pop((full, b), None)
+            return self.reply(201)
         if self.command == "PUT":
             with self.server.lock:
                 self.store[full] = body

@@ -147,3 +147,58 @@ def test_tempodb_end_to_end_on_s3(tmp_path, s3_server):
     # the mock store now holds the whole block: data+index+meta+blooms+search
     assert any(k.endswith("meta.json") for k in srv.store)
     assert any(k.endswith("/search") for k in srv.store)
+
+
+# ---- round 2: streaming append (multipart / resumable / block list) ----
+
+def test_append_roundtrip(cloud_backend):
+    """Parts of assorted sizes stream through the native append protocol
+    and read back byte-identical; the object is invisible until close."""
+    be = cloud_backend
+    parts = [b"a" * 10, b"b" * (300 << 10), b"c" * (6 << 20), b"d" * 7, b""]
+    tracker = None
+    for p in parts:
+        tracker = be.append("t1", "blk", "data", tracker, p)
+    be.close_append("t1", "blk", "data", tracker)
+    got = be.read("t1", "blk", "data")
+    want = b"".join(parts)
+    assert got == want
+    # ranged reads work over the assembled object
+    assert be.read_range("t1", "blk", "data", 5, 20) == want[5:25]
+
+
+def test_append_empty_object(cloud_backend):
+    be = cloud_backend
+    tracker = be.append("t1", "blk0", "data", None, b"")
+    be.close_append("t1", "blk0", "data", tracker)
+    assert be.read("t1", "blk0", "data") == b""
+
+
+def test_append_large_block_via_streaming_block(cloud_backend):
+    """StreamingBlock with a backend flushes every flush_size bytes and
+    produces a block identical to the buffered path."""
+    import io
+    from tempo_tpu.encoding.v2 import BackendBlock, StreamingBlock
+
+    objs = [(bytes([i]) * 16, bytes([i]) * 4096) for i in range(64)]
+
+    m1 = BlockMeta(tenant_id="t1", encoding="none")
+    sb1 = StreamingBlock(m1, page_size=4096, backend=cloud_backend,
+                         flush_size=16 << 10)  # tiny flush -> many parts
+    for oid, data in objs:
+        sb1.add_object(oid, data)
+    out1 = sb1.complete()
+
+    m2 = BlockMeta(tenant_id="t1", encoding="none")
+    sb2 = StreamingBlock(m2, page_size=4096)  # buffered path
+    for oid, data in objs:
+        sb2.add_object(oid, data)
+    out2 = sb2.complete(cloud_backend)
+
+    d1 = cloud_backend.read("t1", out1.block_id, "data")
+    d2 = cloud_backend.read("t1", out2.block_id, "data")
+    assert d1 == d2 and out1.size == out2.size == len(d1)
+    # both blocks serve identical lookups
+    for oid, data in objs[::7]:
+        assert BackendBlock(cloud_backend, out1).find_by_id(oid) == data
+        assert BackendBlock(cloud_backend, out2).find_by_id(oid) == data
