@@ -99,6 +99,9 @@ def oc_request_to_batches(req, node=None, resource=None) -> list:
         if span.parent_span_id:
             s.parent_span_id = span.parent_span_id[:8].rjust(8, b"\x00")
         s.name = span.name.value
+        if span.tracestate.entries:
+            s.trace_state = ",".join(
+                f"{e.key}={e.value}" for e in span.tracestate.entries)
         s.kind = _OC_KIND.get(span.kind, tempopb.Span.SPAN_KIND_UNSPECIFIED)
         s.start_time_unix_nano = _ts_nanos(span.start_time)
         s.end_time_unix_nano = _ts_nanos(span.end_time)

@@ -181,3 +181,72 @@ def test_config_stream_echoes():
         finally:
             server.stop(0)
             app.shutdown()
+
+
+def test_real_wire_byte_literal_roundtrip():
+    """Decode a request byte string hand-assembled from the REAL
+    opencensus-proto field spec (trace.pb.go: parent=3 name=4 start=5
+    end=6 attributes=7 time_events=9 status=11 kind=14 tracestate=15
+    resource=16) — NOT via our own pb2 — so a same-wrong-numbering bug
+    in the schema cannot self-consistently pass."""
+
+    def tag(field, wire):
+        out, key = b"", (field << 3) | wire
+        while True:
+            b, key = key & 0x7F, key >> 7
+            out += bytes([b | (0x80 if key else 0)])
+            if not key:
+                return out
+
+    def varint(v):
+        out = b""
+        while True:
+            b, v = v & 0x7F, v >> 7
+            out += bytes([b | (0x80 if v else 0)])
+            if not v:
+                return out
+
+    def ld(field, payload):  # length-delimited
+        return tag(field, 2) + varint(len(payload)) + payload
+
+    tid, sid, psid = bytes(range(16)), b"\x01" * 8, b"\x02" * 8
+    ts_start = tag(1, 0) + varint(1_700_000_000)           # Timestamp.seconds=1
+    ts_end = tag(1, 0) + varint(1_700_000_001) + tag(2, 0) + varint(250)
+    trunc_name = ld(1, b"real-oc-op")                      # TruncatableString.value
+    # Attributes.attribute_map entry: key="env", value=AttributeValue{string}
+    attr_val = ld(1, ld(1, b"prod"))                       # string_value.value
+    attr_entry = ld(1, b"env") + ld(2, attr_val)
+    attributes = ld(1, attr_entry)                         # map entry is field 1
+    status = tag(1, 0) + varint(2) + ld(2, b"boom")        # code=2, message
+    tracestate = ld(1, ld(1, b"k") + ld(2, b"v"))          # Tracestate.entries
+    span = (
+        ld(1, tid) + ld(2, sid) + ld(3, psid)              # ids, parent=3
+        + ld(4, trunc_name)                                # name=4
+        + ld(5, ts_start) + ld(6, ts_end)                  # start=5 end=6
+        + ld(7, attributes)                                # attributes=7
+        + ld(8, b"\x00")                                   # stack_trace=8 (ignored)
+        + ld(11, status)                                   # status=11
+        + tag(12, 2) + varint(2) + tag(1, 0) + varint(1)   # same_process (unknown)
+        + tag(14, 0) + varint(1)                           # kind=14 SERVER
+        + ld(15, tracestate)                               # tracestate=15
+    )
+    node = ld(3, ld(1, b"real-svc"))                       # Node.service_info.name
+    req_bytes = ld(1, node) + ld(2, span)                  # request: node=1 spans=2
+
+    req = ocpb.OCExportTraceServiceRequest.FromString(req_bytes)
+    batches = oc_request_to_batches(req)
+    assert len(batches) == 1
+    s = batches[0].scope_spans[0].spans[0]
+    assert s.trace_id == tid and s.span_id == sid and s.parent_span_id == psid
+    assert s.name == "real-oc-op"
+    assert s.kind == tempopb.Span.SPAN_KIND_SERVER
+    assert s.start_time_unix_nano == 1_700_000_000 * 10**9
+    assert s.end_time_unix_nano == 1_700_000_001 * 10**9 + 250
+    assert s.attributes[0].key == "env"
+    assert s.attributes[0].value.string_value == "prod"
+    assert s.status.code == tempopb.Status.STATUS_CODE_ERROR
+    assert s.status.message == "boom"
+    assert s.trace_state == "k=v"
+    svc = next(kv.value.string_value for kv in batches[0].resource.attributes
+               if kv.key == "service.name")
+    assert svc == "real-svc"
