@@ -59,6 +59,8 @@ class BlockMeta:
     # searchsharding.go page math)
     search_pages: int = 0
     search_size: int = 0              # compressed container bytes
+    search_entries_per_page: int = 0  # E of the page geometry
+    search_kv_per_entry: int = 0      # C of the page geometry
 
     def __post_init__(self):
         if not self.block_id:

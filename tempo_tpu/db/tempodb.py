@@ -359,8 +359,13 @@ class TempoDB:
                 data_encoding=j.data_encoding or "v2",
             )
             try:
-                jobs.append(self._scan_job(meta, j.start_page,
-                                           j.pages_to_search or None))
+                job = self._scan_job(meta, j.start_page,
+                                     j.pages_to_search or None)
+                # zero-page jobs (stale meta, start_page past the
+                # container) would stage an empty batch — drop them, as
+                # search_block does
+                if job.n_pages > 0:
+                    jobs.append(job)
             except DoesNotExist:
                 # container missing: only the 0-start job scans (whole
                 # trace block, its own page space) — see search_block

@@ -135,9 +135,15 @@ class QueryFrontend:
         """Page-range jobs per block (reference searchsharding.go:323-367
         backendRequests): pages_per_job from target_bytes_per_job and the
         block's recorded container geometry; blocks without geometry info
-        (old metas, search-less blocks) become one whole-block job."""
+        (old metas, search-less blocks) become one whole-block job.
+
+        Jobs order by (page geometry, block id) so the fixed-size batch
+        slicing downstream yields geometry-PURE SearchBlocksRequests:
+        the querier's batcher can only stack same-(E, C) pages into one
+        kernel, so a mixed batch fragments into several dispatches."""
         jobs = []
-        for m in sorted(metas, key=lambda m: m.block_id):
+        geo = lambda m: (m.search_entries_per_page, m.search_kv_per_entry)  # noqa: E731
+        for m in sorted(metas, key=lambda m: (geo(m), m.block_id)):
             if m.search_pages and m.search_size:
                 per_page = max(1, m.search_size // m.search_pages)
                 pages_per_job = max(1, self.cfg.target_bytes_per_job // per_page)
@@ -159,17 +165,27 @@ class QueryFrontend:
         ]
 
         # group page-range jobs into batched requests — each querier
-        # stacks its share into few kernel dispatches
+        # stacks its share into few kernel dispatches; batches break at
+        # geometry boundaries so every batch is geometry-pure
         block_jobs = self._block_jobs(metas)
         B = max(1, self.cfg.batch_jobs_per_request)
-        batches = [block_jobs[i:i + B] for i in range(0, len(block_jobs), B)]
+        batches = []
+        run_start = 0
+        for i in range(1, len(block_jobs) + 1):
+            geo = lambda j: (j[0].search_entries_per_page,   # noqa: E731
+                             j[0].search_kv_per_entry)
+            if i == len(block_jobs) or geo(block_jobs[i]) != geo(block_jobs[run_start]):
+                run = block_jobs[run_start:i]
+                batches.extend(run[k:k + B] for k in range(0, len(run), B))
+                run_start = i
         jobs = [("recent", None)] + [("blocks", b) for b in batches]
 
         merged = SearchResults.for_request(req)
         merge_lock = threading.Lock()
         quit_event = threading.Event()
-        failed_blocks = [0]  # BLOCK count, not batch count — tolerance
-                             # keeps the reference's per-block semantics
+        failed_block_ids: set = set()  # BLOCK identity, not batch count —
+                                       # a block whose page-range jobs span
+                                       # several failed batches counts once
 
         def merge(r):
             """Incremental merge so the limit can cancel remaining jobs
@@ -212,8 +228,8 @@ class QueryFrontend:
                 except Exception:
                     # one failed batch = every distinct block it carried
                     with merge_lock:
-                        failed_blocks[0] += len({m.block_id
-                                                 for m, _, _ in payload})
+                        failed_block_ids.update(m.block_id
+                                                for m, _, _ in payload)
                     raise
             merge(r)
             return r
@@ -224,8 +240,8 @@ class QueryFrontend:
         # smaller answer (reference tolerate_failed_blocks → HTTP 206/5xx)
         if not quit_event.is_set() and errors and (
             recent_failed[0]
-            or failed_blocks[0] > self.cfg.tolerate_failed_blocks
+            or len(failed_block_ids) > self.cfg.tolerate_failed_blocks
         ):
             raise errors[0]
-        merged.metrics.skipped_blocks += failed_blocks[0]  # tolerated
+        merged.metrics.skipped_blocks += len(failed_block_ids)  # tolerated
         return merged.response()

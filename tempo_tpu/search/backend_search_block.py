@@ -96,10 +96,18 @@ class BackendSearchBlock:
     def staged(self) -> StagedPages:
         """Device-stage this block alone (cached — HBM is the cache tier
         for hot blocks, cf. reference shouldCache heuristics). The batched
-        serving path uses the batcher's group staging instead."""
-        if self._staged is None:
-            self._staged = stage(self.pages())
-        return self._staged
+        serving path uses the batcher's group staging instead. The H2D
+        transfer runs outside the lock shared with pages() so
+        dictionary-only readers (tag lookups) never wait on it; a racing
+        duplicate stage is benign and the first publish wins."""
+        with self._lock:
+            if self._staged is not None:
+                return self._staged
+        sp = stage(self.pages())
+        with self._lock:
+            if self._staged is None:
+                self._staged = sp
+            return self._staged
 
     def search(self, req: tempopb.SearchRequest,
                results: SearchResults | None = None,

@@ -11,9 +11,14 @@ whose pages stack along the device page axis and scan in ONE kernel call
 collectives replace the Results funnel).
 
 Properties the grouping keeps:
-- **stable**: jobs sort by (block id, page range) and fill greedily, so
-  the same blocklist yields the same groups query after query and the
-  staged-batch HBM cache (LRU by bytes) hits.
+- **stable AND churn-local**: jobs sort by (block id, page range) and
+  group boundaries are content-defined — a job starts a new group based
+  only on a stable hash of its own key (like content-defined chunking in
+  dedup stores) — so the same blocklist yields the same groups query
+  after query, and a block arriving or leaving the blocklist reshapes
+  only its own neighborhood up to the next hash anchor: O(1) cached
+  batches invalidate per poll instead of every group downstream of the
+  new uuid's sort position.
 - **bucketed**: only jobs sharing page geometry (E entries/page, C kv
   slots) stack together — static shapes per bucket mean XLA compiles once
   per (bucket, n_terms, top_k).
@@ -86,11 +91,26 @@ class BlockBatcher:
         self.io_workers = io_workers
         self._cache: OrderedDict[tuple, _CachedBatch] = OrderedDict()
         self._cache_total = 0
+        self._staging: dict[tuple, threading.Event] = {}
         self._lock = threading.Lock()
         self.last_dispatches = 0  # diagnostics: kernel calls in last search
 
     # ------------------------------------------------------------------
     # planning
+
+    def _cuts(self, j: ScanJob) -> bool:
+        """Content-defined group boundary: depends ONLY on this job's key
+        and size, never on neighbors, so group composition is a local
+        property. Cut probability 1/divisor makes the expected group
+        ~max_batch_pages/2, leaving headroom so churn rarely propagates
+        through the hard page cap to the next anchor. plan() additionally
+        guards cuts behind a min group size (max_batch_pages/4, the CDC
+        min-chunk-size trick) so groups never fragment below batching
+        efficiency."""
+        import zlib
+
+        divisor = max(2, self.max_batch_pages // (2 * max(1, j.n_pages)))
+        return zlib.crc32(repr(j.key).encode()) % divisor == 0
 
     def plan(self, jobs: list[ScanJob]) -> list[list[ScanJob]]:
         buckets: dict[tuple, list[ScanJob]] = {}
@@ -100,8 +120,10 @@ class BlockBatcher:
         for _geo, js in sorted(buckets.items()):
             cur: list[ScanJob] = []
             cur_pages = 0
+            min_pages = self.max_batch_pages // 4
             for j in js:
-                if cur and cur_pages + j.n_pages > self.max_batch_pages:
+                if cur and (cur_pages + j.n_pages > self.max_batch_pages
+                            or (cur_pages >= min_pages and self._cuts(j))):
                     groups.append(cur)
                     cur, cur_pages = [], 0
                 cur.append(j)
@@ -115,36 +137,51 @@ class BlockBatcher:
 
     def _staged(self, group: list[ScanJob]) -> _CachedBatch:
         key = tuple(j.key for j in group)
-        with self._lock:
-            hit = self._cache.get(key)
-            if hit is not None:
-                self._cache.move_to_end(key)
-                obs.batch_cache_events.inc(result="hit")
-                return hit
-        # load host pages outside the lock (IO + decompress dominate)
-        import concurrent.futures
+        while True:
+            with self._lock:
+                hit = self._cache.get(key)
+                if hit is not None:
+                    self._cache.move_to_end(key)
+                    obs.batch_cache_events.inc(result="hit")
+                    return hit
+                ev = self._staging.get(key)
+                if ev is None:
+                    # we are the stager for this key
+                    ev = self._staging[key] = threading.Event()
+                    break
+            # another thread is staging this exact group: wait for it
+            # rather than duplicating the IO+decompress+H2D (and
+            # transiently doubling HBM for the batch)
+            ev.wait()
+        try:
+            # load host pages outside the lock (IO + decompress dominate)
+            import concurrent.futures
 
-        if len(group) > 1:
-            with concurrent.futures.ThreadPoolExecutor(
-                max_workers=min(self.io_workers, len(group))
-            ) as ex:
-                pages = list(ex.map(lambda j: j.pages_fn(), group))
-        else:
-            pages = [group[0].pages_fn()]
-        batch = self.engine.stage(pages)
-        nbytes = int(sum(int(a.nbytes) for a in batch.device.values()))
-        entry = _CachedBatch(batch=batch, nbytes=nbytes, jobs=list(group))
-        with self._lock:
-            obs.batch_cache_events.inc(result="miss")
-            prev = self._cache.pop(key, None)
-            if prev is not None:
-                self._cache_total -= prev.nbytes
-            self._cache[key] = entry
-            self._cache_total += nbytes
-            while self._cache_total > self.cache_bytes and len(self._cache) > 1:
-                _, old = self._cache.popitem(last=False)
-                self._cache_total -= old.nbytes
-        return entry
+            if len(group) > 1:
+                with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(self.io_workers, len(group))
+                ) as ex:
+                    pages = list(ex.map(lambda j: j.pages_fn(), group))
+            else:
+                pages = [group[0].pages_fn()]
+            batch = self.engine.stage(pages)
+            nbytes = int(sum(int(a.nbytes) for a in batch.device.values()))
+            entry = _CachedBatch(batch=batch, nbytes=nbytes, jobs=list(group))
+            with self._lock:
+                obs.batch_cache_events.inc(result="miss")
+                prev = self._cache.pop(key, None)
+                if prev is not None:
+                    self._cache_total -= prev.nbytes
+                self._cache[key] = entry
+                self._cache_total += nbytes
+                while self._cache_total > self.cache_bytes and len(self._cache) > 1:
+                    _, old = self._cache.popitem(last=False)
+                    self._cache_total -= old.nbytes
+            return entry
+        finally:
+            with self._lock:
+                self._staging.pop(key, None)
+            ev.set()
 
     def invalidate(self, live_block_ids: set[str]) -> None:
         """Drop cached batches containing blocks no longer in the

@@ -370,3 +370,154 @@ def test_search_compaction_kway_merge_identical(tmp_path):
     req.limit = 200
     res = db.search("t1", req)
     assert len(res.response().traces) == len(all_traces)
+
+
+def _synthetic_jobs(n, n_pages=64, prefix="blk"):
+    from tempo_tpu.search.batcher import ScanJob
+
+    return [
+        ScanJob(key=(f"{prefix}-{i:04d}", 0, n_pages), pages_fn=None,
+                header={"n_pages": n_pages}, n_pages=n_pages,
+                n_entries=n_pages * 16, geometry=(16, 8))
+        for i in range(n)
+    ]
+
+
+def test_batch_grouping_churn_local():
+    """Adding one block to a 64-block tenant must invalidate O(1) cached
+    groups, not every group after the new uuid's sort position (VERDICT
+    round-2 weak #3: content-defined group boundaries)."""
+    from tempo_tpu.search.batcher import BlockBatcher
+
+    b = BlockBatcher(max_batch_pages=512)  # ~8 jobs/group ceiling
+    jobs = _synthetic_jobs(64)
+    before = {tuple(j.key for j in g) for g in b.plan(jobs)}
+    assert len(before) > 4  # grouping actually splits
+
+    # insert one new block in the MIDDLE of the id ordering
+    from tempo_tpu.search.batcher import ScanJob
+    new = ScanJob(key=("blk-0031a", 0, 64), pages_fn=None,
+                  header={"n_pages": 64}, n_pages=64,
+                  n_entries=64 * 16, geometry=(16, 8))
+    after = {tuple(j.key for j in g) for g in b.plan(jobs + [new])}
+    # every group not containing the new block's neighborhood survives
+    changed = before - after
+    assert len(changed) <= 2, (
+        f"{len(changed)} of {len(before)} groups changed; boundaries "
+        "are not churn-local"
+    )
+
+    # determinism: same jobs → identical groups
+    again = {tuple(j.key for j in g) for g in b.plan(list(reversed(jobs)))}
+    assert again == before
+
+
+def test_batch_grouping_respects_page_cap_and_geometry():
+    from tempo_tpu.search.batcher import BlockBatcher
+
+    b = BlockBatcher(max_batch_pages=512)
+    jobs = _synthetic_jobs(40) + _synthetic_jobs(8, n_pages=300, prefix="big")
+    groups = b.plan(jobs)
+    for g in groups:
+        assert sum(j.n_pages for j in g) <= 512
+        assert len({j.geometry for j in g}) == 1
+    # every job appears exactly once
+    flat = [j.key for g in groups for j in g]
+    assert sorted(flat) == sorted(j.key for j in jobs)
+
+
+def test_batcher_cache_hits_survive_blocklist_churn(tmp_path):
+    """End-to-end churn test: search a cached multi-block tenant, add one
+    block, poll (which invalidates dead groups), search again — the
+    unaffected groups must HIT (VERDICT: hit-rate stays high across a
+    poll in a churn test)."""
+    from tempo_tpu.observability import metrics as obs
+
+    db = _db(tmp_path)
+    db.batcher.max_batch_pages = 8  # force multiple groups (1 page/block)
+    for b in range(12):
+        _ingest(db, "t1", 4, seed_base=b * 50)
+    db.poll()
+    req = _mk_req({})
+    req.limit = 10_000
+    db.search("t1", req)  # populate the staged cache
+
+    def counts():
+        return (obs.batch_cache_events.value(result="hit"),
+                obs.batch_cache_events.value(result="miss"))
+
+    h0, m0 = counts()
+    _ingest(db, "t1", 4, seed_base=999)  # churn: one new block
+    db.poll()
+    db.search("t1", req)
+    h1, m1 = counts()
+    assert m1 - m0 <= 2, f"churn restaged {m1 - m0} groups"
+    assert h1 - h0 >= 1
+
+
+def test_staging_concurrent_misses_deduped(tmp_path):
+    """Two threads missing on the same group must do the stage once
+    (ADVICE r2: per-key in-progress event)."""
+    import threading
+    from tempo_tpu.observability import metrics as obs
+
+    db = _db(tmp_path)
+    for b in range(3):
+        _ingest(db, "t1", 4, seed_base=b * 50)
+    db.poll()
+
+    def counts():
+        return (obs.batch_cache_events.value(result="hit"),
+                obs.batch_cache_events.value(result="miss"))
+
+    h0, m0 = counts()
+    req = _mk_req({})
+    req.limit = 10_000
+    barrier = threading.Barrier(4)
+    errs = []
+
+    def go():
+        try:
+            barrier.wait()
+            db.search("t1", req)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=go) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs
+    h1, m1 = counts()
+    assert m1 - m0 == 1, f"expected exactly one stage, got {m1 - m0} misses"
+    assert h1 - h0 >= 3
+
+
+def test_search_blocks_drops_zero_page_jobs(tmp_path):
+    """Stale metas can produce jobs whose page range is past the
+    container; they must be filtered, not staged as empty batches."""
+    db = _db(tmp_path)
+    meta, _ = _ingest(db, "t1", 4)
+    db.poll()
+    breq = tempopb.SearchBlocksRequest()
+    breq.search_req.CopyFrom(_mk_req({}))
+    breq.tenant_id = "t1"
+    j = breq.jobs.add()
+    j.block_id = meta.block_id
+    j.start_page = 10_000  # beyond the container
+    j.pages_to_search = 5
+    j.encoding = meta.encoding
+    j.version = meta.version
+    j.data_encoding = meta.data_encoding
+    r = db.search_blocks(breq)  # must not raise / stage an empty batch
+    assert r.metrics.inspected_blocks == 0
+
+
+def test_block_meta_search_geometry_survives_roundtrip(tmp_path):
+    """search_entries_per_page / search_kv_per_entry are dataclass fields
+    now — they must survive the meta.json round-trip (ADVICE r2 item 1)."""
+    db = _db(tmp_path)
+    meta, _ = _ingest(db, "t1", 4)
+    raw = db.backend.read_block_meta("t1", meta.block_id)
+    assert raw.search_entries_per_page > 0
+    assert raw.search_kv_per_entry > 0
+    assert raw.search_pages == meta.search_pages

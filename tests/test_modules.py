@@ -416,3 +416,89 @@ def test_frontend_tolerance_counts_blocks_not_batches(tmp_path):
         batch_jobs_per_request=4, retries=0, tolerate_failed_blocks=4), db=db)
     r = fe2.search("t1", req)
     assert r.metrics.skipped_blocks == 4
+
+
+def test_frontend_failed_block_spanning_batches_counts_once(tmp_path):
+    """A block whose page-range jobs land in SEVERAL failed batches is one
+    failed block, not one per batch (ADVICE r2 item 2: shared id set)."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+
+    db, _ = _frontend_db(tmp_path, n_blocks=1, per_block=120)
+    (meta,) = db.blocklist.metas("t1")
+    assert meta.search_pages >= 3  # jobs will span >1 batch
+    q = Querier(db, Ring(), {})
+
+    class FailingBatches:
+        def search_recent(self, tenant, req):
+            return q.search_recent(tenant, req)
+
+        def search_blocks(self, breq):
+            raise RuntimeError("querier down")
+
+    req = _mk_req({})
+    req.limit = 10_000
+    # one page per job, one job per batch -> the single block spans
+    # search_pages failed batches; tolerance 1 must still cover it
+    fe = QueryFrontend([FailingBatches()], FrontendConfig(
+        target_bytes_per_job=1, batch_jobs_per_request=1, retries=0,
+        tolerate_failed_blocks=1), db=db)
+    r = fe.search("t1", req)
+    assert r.metrics.skipped_blocks == 1
+
+
+def test_frontend_batches_are_geometry_pure(tmp_path):
+    """Blocks with different page geometries must not share a
+    SearchBlocksRequest: the querier's batcher can only stack same-(E,C)
+    pages into one kernel, so a mixed batch fragments into extra
+    dispatches. The meta now carries the geometry for exactly this."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.search.columnar import PageGeometry
+
+    db, sds_a = _frontend_db(tmp_path, n_blocks=3)
+    # second geometry, same tenant/db: write blocks with (16, 8) pages
+    from tempo_tpu.model import codec_for
+    from tempo_tpu.search import extract_search_data
+    from tempo_tpu.utils.ids import random_trace_id
+    from tempo_tpu.utils.test_data import make_trace
+
+    codec = codec_for("v2")
+    db.cfg.search_geometry = PageGeometry(16, 8)
+    for b in range(3):
+        objs, sds = [], []
+        for i in range(20):
+            tid = random_trace_id()
+            tr = make_trace(tid, seed=9000 + b * 100 + i)
+            sd = extract_search_data(tid, tr)
+            objs.append((tid, codec.marshal(tr, sd.start_s, sd.end_s),
+                         sd.start_s, sd.end_s))
+            sds.append(sd)
+        db.write_block_direct("t1", sorted(objs), search_entries=sds)
+    db.poll()
+    metas = db.blocklist.metas("t1")
+    geos = {(m.search_entries_per_page, m.search_kv_per_entry) for m in metas}
+    assert len(geos) == 2
+
+    q = Querier(db, Ring(), {})
+    seen_batches = []
+    orig = Querier.search_blocks
+
+    def spy(self, breq):
+        by_block = {m.block_id: m for m in metas}
+        seen_batches.append([by_block[j.block_id] for j in breq.jobs])
+        return orig(self, breq)
+
+    Querier.search_blocks = spy
+    try:
+        fe = QueryFrontend([q], FrontendConfig(batch_jobs_per_request=4))
+        req = _mk_req({})
+        req.limit = 10_000
+        fe.search("t1", req)
+    finally:
+        Querier.search_blocks = orig
+    assert seen_batches
+    for batch in seen_batches:
+        batch_geos = {(m.search_entries_per_page, m.search_kv_per_entry)
+                      for m in batch}
+        assert len(batch_geos) == 1, "mixed-geometry batch"
