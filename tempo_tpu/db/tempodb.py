@@ -45,6 +45,7 @@ class TempoDBConfig:
     compaction_window_s: int = 3600
     compaction_max_inputs: int = 8
     compaction_flush_bytes: int = 30 << 20   # reference FlushSizeBytes
+    complete_flush_bytes: int = 30 << 20     # completion streams at the same cadence
     retention_s: int = 14 * 24 * 3600
     compacted_retention_s: int = 3600
     search_geometry: PageGeometry = field(default_factory=PageGeometry)
@@ -124,7 +125,12 @@ class TempoDB:
             encoding=self.cfg.block_encoding,
             data_encoding=block.meta.data_encoding,
         )
-        sb = StreamingBlock(meta, page_size=self.cfg.block_page_size)
+        # stream through backend.append every complete_flush_bytes so a
+        # max_block_bytes-sized completion never holds the whole compressed
+        # block in RAM (reference streaming_block.go:27-155 flushes 30 MB)
+        sb = StreamingBlock(meta, page_size=self.cfg.block_page_size,
+                            backend=self.backend,
+                            flush_size=self.cfg.complete_flush_bytes)
         for oid, obj in block.iterator():
             r = codec.fast_range(obj) or (0, 0)
             sb.add_object(oid, obj, r[0], r[1])
@@ -142,7 +148,9 @@ class TempoDB:
         used by tests/benchmarks and the compactor path."""
         meta = BlockMeta(tenant_id=tenant, encoding=self.cfg.block_encoding,
                          data_encoding=data_encoding)
-        sb = StreamingBlock(meta, page_size=self.cfg.block_page_size)
+        sb = StreamingBlock(meta, page_size=self.cfg.block_page_size,
+                            backend=self.backend,
+                            flush_size=self.cfg.complete_flush_bytes)
         for oid, obj, s, e in objects:
             sb.add_object(oid, obj, s, e)
         out = sb.complete(self.backend)

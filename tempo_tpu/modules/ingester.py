@@ -43,11 +43,26 @@ class _LiveTrace:
     search: SearchData | None = None
 
 
+@dataclass
+class _Completing:
+    """A block awaiting completion, with its per-block retry state
+    (reference flush.go:359-389 — each failed op is requeued with its own
+    exponential backoff rather than stalling the queue)."""
+    blk: object
+    search: object
+    retry_at: float = 0.0   # monotonic time before which we skip it
+    backoff_s: float = 0.0
+
+
 class TenantInstance:
     # completed blocks stay queryable on the ingester until readers have
     # had time to poll the new block into their blocklists (reference
     # complete_block_timeout, instance.ClearFlushedBlocks :373)
     COMPLETE_BLOCK_TIMEOUT_S = 300.0
+    # flush retry backoff envelope (reference flush.go:62-67: 30s initial,
+    # exponential, capped)
+    FLUSH_BACKOFF_S = 30.0
+    FLUSH_BACKOFF_MAX_S = 120.0
 
     def __init__(self, tenant: str, db: TempoDB, overrides: Overrides):
         self.tenant = tenant
@@ -57,7 +72,7 @@ class TenantInstance:
         self.live: dict[bytes, _LiveTrace] = {}
         self.codec = segment_codec_for(CURRENT_ENCODING)
         self._new_head()
-        self.completing = []  # [(AppendBlock, StreamingSearchBlock)]
+        self.completing: list[_Completing] = []
         self.recent = []      # [(BlockMeta, completed_at)]
 
     def _new_head(self):
@@ -123,33 +138,43 @@ class TenantInstance:
             if not (force or self.head.data_length >= max_block_bytes
                     or age >= max_block_age_s):
                 return False
-            self.completing.append((self.head, self.head_search))
+            self.completing.append(_Completing(self.head, self.head_search))
             self._new_head()
             return True
 
     def complete_one(self) -> "tempopb.Trace | None":
-        """Complete the oldest completing block to the backend and clear
-        its WAL files (reference handleComplete flush.go:235-281). On a
-        backend failure the block is RESTORED to the completing queue so a
-        later sweep retries it (reference flush backoff :359-389)."""
+        """Complete the oldest ELIGIBLE completing block to the backend and
+        clear its WAL files (reference handleComplete flush.go:235-281).
+        On a backend failure the block is restored with a per-block
+        exponential backoff (30s→120s cap, flush.go:359-389) so a flaky
+        backend neither hot-loops one block nor starves its siblings —
+        the next call skips backed-off blocks and completes the rest."""
+        now = time.monotonic()
         with self.lock:
-            if not self.completing:
+            idx = next((i for i, c in enumerate(self.completing)
+                        if c.retry_at <= now), None)
+            if idx is None:
                 return None
-            blk, search = self.completing.pop(0)
+            c = self.completing.pop(idx)
         from tempo_tpu.observability import tracing
         with tracing.start_span("ingester.CompleteBlock",
                                 tenant=self.tenant) as span:
             try:
-                meta = self.db.complete_block(blk, search.entries())
+                meta = self.db.complete_block(c.blk, c.search.entries())
                 span.set_attributes(block_id=meta.block_id,
                                     objects=meta.total_objects)
             except Exception:
                 # span.__exit__ records the propagating exception
+                c.backoff_s = (self.FLUSH_BACKOFF_S if not c.backoff_s
+                               else min(c.backoff_s * 2,
+                                        self.FLUSH_BACKOFF_MAX_S))
+                c.retry_at = time.monotonic() + c.backoff_s
+                obs.flush_failures.inc(tenant=self.tenant)
                 with self.lock:
-                    self.completing.insert(0, (blk, search))
+                    self.completing.insert(idx, c)
                 raise
-        blk.clear()
-        search.clear()
+        c.blk.clear()
+        c.search.clear()
         with self.lock:
             self.recent.append((meta, time.monotonic()))
         obs.blocks_completed.inc(tenant=self.tenant)
@@ -171,7 +196,7 @@ class TenantInstance:
             t = self.live.get(tid)
             if t is not None and t.segments:
                 partials.append(self.codec.to_object(list(t.segments)))
-            heads = [self.head] + [b for b, _ in self.completing]
+            heads = [self.head] + [c.blk for c in self.completing]
             recent = [m for m, _ in self.recent]
         for blk in heads:
             obj = blk.find(tid)
@@ -192,7 +217,7 @@ class TenantInstance:
     def search(self, req, results: SearchResults) -> None:
         with self.lock:
             live_sds = [t.search for t in self.live.values() if t.search]
-            searches = [self.head_search] + [s for _, s in self.completing]
+            searches = [self.head_search] + [c.search for c in self.completing]
             recent = [m for m, _ in self.recent]
         for sd in live_sds:
             results.metrics.inspected_traces += 1
@@ -218,7 +243,7 @@ class TenantInstance:
             for t in self.live.values():
                 if t.search:
                     tags.update(t.search.kvs)
-            for ssb in [self.head_search] + [s for _, s in self.completing]:
+            for ssb in [self.head_search] + [c.search for c in self.completing]:
                 for sd in ssb.entries():
                     tags.update(sd.kvs)
         return tags
@@ -228,7 +253,7 @@ class TenantInstance:
         size = 0
         with self.lock:
             sds = [t.search for t in self.live.values() if t.search]
-            for ssb in [self.head_search] + [s for _, s in self.completing]:
+            for ssb in [self.head_search] + [c.search for c in self.completing]:
                 sds.extend(ssb.entries())
         for sd in sds:
             for v in sd.kvs.get(tag, ()):
@@ -312,8 +337,8 @@ class Ingester:
             while True:
                 try:
                     meta = inst.complete_one()
-                except Exception:  # noqa: BLE001 — block restored, retried next tick
-                    break
+                except Exception:  # noqa: BLE001 — block backed off; its
+                    continue       # siblings must still land this tick
                 if meta is None:
                     break
                 completed.append(meta)
@@ -342,5 +367,5 @@ class Ingester:
             # replayed head blocks go straight to completing: they will be
             # completed by the next sweep (reference re-enqueues completion
             # ops for replayed blocks)
-            inst.completing.append((blk, ssb))
+            inst.completing.append(_Completing(blk, ssb))
             self.replayed_blocks += 1

@@ -179,6 +179,8 @@ ingest_bytes = Counter("tempo_distributor_bytes_received_total",
 push_failures = Counter("tempo_distributor_push_failures_total",
                         "failed pushes")
 live_traces = Gauge("tempo_ingester_live_traces", "live traces per tenant")
+flush_failures = Counter("tempo_ingester_failed_flushes_total",
+                         "block completions that failed and were backed off")
 blocks_completed = Counter("tempo_ingester_blocks_completed_total",
                            "blocks completed to the backend")
 query_seconds = Histogram("tempo_query_seconds", "query latency")

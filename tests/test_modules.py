@@ -265,6 +265,7 @@ def test_complete_one_restores_on_failure(tmp_path):
         inst.complete_one()
     assert len(inst.completing) == 1  # restored, not lost
     app.backend.write = real_write
+    inst.completing[0].retry_at = 0.0  # elapse the flush backoff window
     assert inst.complete_one() is not None  # retried successfully
 
 
@@ -502,3 +503,55 @@ def test_frontend_batches_are_geometry_pure(tmp_path):
         batch_geos = {(m.search_entries_per_page, m.search_kv_per_entry)
                       for m in batch}
         assert len(batch_geos) == 1, "mixed-geometry batch"
+
+
+def test_flush_backoff_and_sibling_isolation(tmp_path):
+    """A failing completion backs off exponentially (30s→120s envelope,
+    reference flush.go:359-389) and must not stop the same tenant's other
+    ready completions in that sweep (VERDICT r2 #7)."""
+    app = _app(tmp_path)
+    ing = app.ingesters["ingester-0"]
+    inst = ing.instance("t1")
+    inst.FLUSH_BACKOFF_S = 0.05
+    inst.FLUSH_BACKOFF_MAX_S = 0.2
+
+    # two completing blocks for one tenant
+    _push_traces(app, "t1", 5)
+    inst.cut_complete_traces(force=True)
+    inst.cut_block_if_ready(force=True)
+    _push_traces(app, "t1", 5, seed_base=100)
+    inst.cut_complete_traces(force=True)
+    inst.cut_block_if_ready(force=True)
+    assert len(inst.completing) == 2
+    poisoned = inst.completing[0].blk.meta.block_id
+
+    real_write = app.backend.write
+    def flaky(tenant, block_id, name, data):
+        if block_id == poisoned:
+            raise OSError("flake")
+        return real_write(tenant, block_id, name, data)
+    app.backend.write = flaky
+
+    # one sweep: the poisoned block fails + backs off, the sibling lands
+    completed = ing.sweep(force=False, max_idle_s=0)
+    assert len(completed) == 1 and completed[0].block_id != poisoned
+    assert len(inst.completing) == 1
+    c = inst.completing[0]
+    assert c.backoff_s == inst.FLUSH_BACKOFF_S and c.retry_at > 0
+
+    # within the backoff window the block is skipped, not hot-looped
+    assert inst.complete_one() is None
+
+    # repeated failures double the backoff up to the cap
+    import pytest as _pytest
+    for expect in (0.1, 0.2, 0.2):
+        c.retry_at = 0.0  # simulate the window elapsing
+        with _pytest.raises(OSError):
+            inst.complete_one()
+        assert inst.completing[0].backoff_s == expect
+
+    # backend heals → the block completes on the next eligible sweep
+    app.backend.write = real_write
+    inst.completing[0].retry_at = 0.0
+    assert inst.complete_one() is not None
+    assert not inst.completing
