@@ -17,6 +17,7 @@ from urllib.parse import urlparse, parse_qs
 
 from google.protobuf import json_format
 
+from tempo_tpu.modules.queue import TooManyRequests
 from tempo_tpu.utils.ids import hex_to_trace_id
 from .params import (
     DEFAULT_TENANT,
@@ -76,6 +77,10 @@ class HTTPApi:
                     code, resp = self._route(method, path, query, headers)
             except ValueError as e:
                 code, resp = 400, {"error": str(e)}
+            except TooManyRequests as e:
+                # tenant's fair-queue is full (reference frontend v1
+                # max-outstanding → HTTP 429)
+                code, resp = 429, {"error": f"too many outstanding requests: {e}"}
             except Exception as e:  # noqa: BLE001 — surface as 500
                 span.record_exception(e)
                 code, resp = 500, {"error": f"{type(e).__name__}: {e}"}

@@ -138,6 +138,10 @@ class AzureBackend(RawBackend):
                       headers={"Content-Type": "application/xml"},
                       body=body, operation="PUT_BLOCK_LIST", ok=(201,))
 
+    def abort_append(self, tenant, block_id, name, tracker) -> None:
+        """Azure garbage-collects uncommitted blocks after 7 days; there is
+        no explicit abort API for block uploads — nothing to do."""
+
     def read(self, tenant, block_id, name) -> bytes:
         _, _, data = self._request("GET", self._key(tenant, block_id, name),
                                    operation="GET")

@@ -92,6 +92,18 @@ class CachedBackend(RawBackend):
         if self.should_cache(name):
             self.cache.store(self._key(tenant, block_id, name), data)
 
+    def append(self, tenant, block_id, name, tracker, data: bytes):
+        # forward so the inner backend's native streaming (S3 multipart,
+        # GCS resumable…) is reached — the RawBackend default would
+        # silently buffer the whole object in memory instead
+        return self.inner.append(tenant, block_id, name, tracker, data)
+
+    def close_append(self, tenant, block_id, name, tracker) -> None:
+        self.inner.close_append(tenant, block_id, name, tracker)
+
+    def abort_append(self, tenant, block_id, name, tracker) -> None:
+        self.inner.abort_append(tenant, block_id, name, tracker)
+
     def read_range(self, tenant, block_id, name, offset, length) -> bytes:
         return self.inner.read_range(tenant, block_id, name, offset, length)
 

@@ -169,6 +169,15 @@ class GCSBackend(RawBackend):
         self._put_chunk(tracker, tracker["pending"], final=True)
         tracker["pending"] = b""
 
+    def abort_append(self, tenant, block_id, name, tracker) -> None:
+        """Cancel the resumable session (GCS answers 499 Client Closed
+        Request for a successful cancel) so failed completions don't leave
+        week-long pending sessions behind."""
+        if tracker is None:
+            return
+        self._request("DELETE", tracker["session"], query=tracker["query"],
+                      operation="CANCEL_RESUMABLE", ok=(200, 204, 499))
+
     def read(self, tenant, block_id, name) -> bytes:
         _, _, data = self._request(
             "GET", self._obj_path(self._key(tenant, block_id, name)),

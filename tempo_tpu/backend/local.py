@@ -58,6 +58,14 @@ class LocalBackend(RawBackend):
             return
         os.replace(tracker, self._p(tenant, block_id, name))
 
+    def abort_append(self, tenant, block_id, name, tracker) -> None:
+        if tracker is None:
+            return
+        try:
+            os.unlink(tracker)
+        except OSError:
+            pass
+
     def read(self, tenant, block_id, name) -> bytes:
         try:
             with open(self._p(tenant, block_id, name), "rb") as f:

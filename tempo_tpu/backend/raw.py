@@ -73,6 +73,14 @@ class RawBackend(abc.ABC):
         if tracker is not None:
             self.write(tenant, block_id, name, b"".join(tracker))
 
+    def abort_append(self, tenant: str, block_id: str | None, name: str,
+                     tracker) -> None:
+        """Discard an in-progress append (failed completion/compaction):
+        release whatever the tracker holds server-side so retries don't
+        accumulate orphans (S3 pending multipart uploads bill until a
+        lifecycle rule reaps them; local temp files fill the block dir).
+        Default: tracker is an in-memory buffer — nothing to release."""
+
     @abc.abstractmethod
     def list_tenants(self) -> list[str]:
         ...

@@ -219,6 +219,15 @@ class S3Backend(RawBackend):
         self._request("POST", key, query={"uploadId": tracker["upload_id"]},
                       body=body, operation="COMPLETE_MULTIPART")
 
+    def abort_append(self, tenant, block_id, name, tracker) -> None:
+        """AbortMultipartUpload — a failed completion must release the
+        pending upload (S3 bills its parts until aborted)."""
+        if tracker is None:
+            return
+        self._request("DELETE", self._key(tenant, block_id, name),
+                      query={"uploadId": tracker["upload_id"]},
+                      operation="ABORT_MULTIPART", ok=(200, 204))
+
     @staticmethod
     def _xml_texts(root: ET.Element, path: str) -> list[str]:
         """findall tolerating namespaced and bare tags (minio vs AWS vs mock):

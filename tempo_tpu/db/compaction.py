@@ -109,15 +109,19 @@ def compact_blocks(backend: RawBackend, tenant: str, inputs: list[BlockMeta],
         r = codec.fast_range(obj) or (0, 0)
         out.add_object(pending_id, obj, r[0], r[1])
 
-    for oid, data in merged:
-        if oid != pending_id:
-            flush()
-            pending_id, pending = oid, [data]
-        else:
-            pending.append(data)  # same trace in 2+ blocks → combine
-    flush()
+    try:
+        for oid, data in merged:
+            if oid != pending_id:
+                flush()
+                pending_id, pending = oid, [data]
+            else:
+                pending.append(data)  # same trace in 2+ blocks → combine
+        flush()
 
-    new_meta = out.complete()
+        new_meta = out.complete()
+    except BaseException:
+        out.abort()  # release the in-progress append (next cycle retries)
+        raise
 
     if compact_search:
         _compact_search_blocks(backend, tenant, inputs, new_meta,

@@ -131,10 +131,14 @@ class TempoDB:
         sb = StreamingBlock(meta, page_size=self.cfg.block_page_size,
                             backend=self.backend,
                             flush_size=self.cfg.complete_flush_bytes)
-        for oid, obj in block.iterator():
-            r = codec.fast_range(obj) or (0, 0)
-            sb.add_object(oid, obj, r[0], r[1])
-        out = sb.complete(self.backend)
+        try:
+            for oid, obj in block.iterator():
+                r = codec.fast_range(obj) or (0, 0)
+                sb.add_object(oid, obj, r[0], r[1])
+            out = sb.complete(self.backend)
+        except BaseException:
+            sb.abort()  # release the in-progress append before the retry
+            raise
         if search_entries:
             write_search_block(self.backend, out, search_entries,
                                geometry=self.cfg.search_geometry,
@@ -151,9 +155,13 @@ class TempoDB:
         sb = StreamingBlock(meta, page_size=self.cfg.block_page_size,
                             backend=self.backend,
                             flush_size=self.cfg.complete_flush_bytes)
-        for oid, obj, s, e in objects:
-            sb.add_object(oid, obj, s, e)
-        out = sb.complete(self.backend)
+        try:
+            for oid, obj, s, e in objects:
+                sb.add_object(oid, obj, s, e)
+            out = sb.complete(self.backend)
+        except BaseException:
+            sb.abort()
+            raise
         if search_entries:
             write_search_block(self.backend, out, search_entries,
                                geometry=self.cfg.search_geometry,

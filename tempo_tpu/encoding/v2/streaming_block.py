@@ -57,6 +57,11 @@ class StreamingBlock:
         self._offset = 0
         self._last_id = b""
         self._ids: list[bytes] = []
+        # objects already committed to the backend (for abort cleanup:
+        # meta.json is written LAST, so anything here without a meta is
+        # invisible to the blocklist and retention would never reclaim it)
+        self._written: list[str] = []
+        self._write_backend: RawBackend | None = None
 
     def add_object(self, obj_id: bytes, data: bytes,
                    start: int = 0, end: int = 0) -> None:
@@ -101,12 +106,15 @@ class StreamingBlock:
     def complete(self, backend: RawBackend | None = None) -> BlockMeta:
         """Write data, index, blooms, then meta last (commit point)."""
         backend = backend if backend is not None else self.backend
+        self._write_backend = backend
         self._cut_page()
         if self._appending:
             # finish the append stream (data object commits here)
             self._flush_pages()
             backend.close_append(self.meta.tenant_id, self.meta.block_id,
                                  NAME_DATA, self._tracker)
+            self._appending = False
+            self._written.append(NAME_DATA)
             data = None
         else:
             data = b"".join(self._pages)
@@ -132,14 +140,46 @@ class StreamingBlock:
 
         if data is not None:
             backend.write(m.tenant_id, m.block_id, NAME_DATA, data)
+            self._written.append(NAME_DATA)
         backend.write(
             m.tenant_id, m.block_id, NAME_INDEX,
             IndexWriter(self.records_per_index_page).write(self._records),
         )
+        self._written.append(NAME_INDEX)
         for s in range(bloom.shard_count):
             backend.write(m.tenant_id, m.block_id, bloom_name(s), bloom.marshal_shard(s))
+            self._written.append(bloom_name(s))
         backend.write_block_meta(m)
         return m
+
+    def abort(self) -> None:
+        """Discard the block under construction: release the in-progress
+        backend append (S3 multipart / GCS session / local temp file) AND
+        delete any objects complete() already committed. meta.json never
+        got written, so those objects are invisible to the blocklist —
+        retention would never reclaim them, and callers that mint a fresh
+        block id per attempt (compaction, write_block_direct) would leak
+        one metaless data object per failed try."""
+        if self._appending and self.backend is not None:
+            try:
+                self.backend.abort_append(self.meta.tenant_id,
+                                          self.meta.block_id, NAME_DATA,
+                                          self._tracker)
+            except Exception:  # noqa: BLE001 — abort is best-effort cleanup
+                pass
+        be = self._write_backend or self.backend
+        if be is not None:
+            for name in self._written:
+                try:
+                    be.delete(self.meta.tenant_id, self.meta.block_id, name)
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+        self._written = []
+        self._tracker = None
+        self._appending = False
+        self._pages = []
+        self._pages_bytes = 0
+        self._cur = bytearray()
 
     @property
     def current_buffer_size(self) -> int:

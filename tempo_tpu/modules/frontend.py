@@ -8,9 +8,12 @@ jobs plus one recent/ingester request; sub-requests run with bounded
 concurrency, retry on failure, and merge (trace combine / result dedupe +
 metrics sum).
 
-In-process the "queue" is a worker pool; the same job protocol maps onto
-the reference's queue + querier-worker pull model for multi-process
-deployments.
+Every sub-request routes through the per-tenant fair RequestQueue drained
+by a bounded worker pool (QueueWorkerPool): tenants are served
+round-robin, and a tenant with more than max_outstanding_per_tenant
+queued sub-requests gets the whole request rejected with TooManyRequests
+(HTTP 429) — the reference's frontend-v1 queue semantics
+(v1/frontend.go:33-60) collapsed in-process.
 """
 
 from __future__ import annotations
@@ -19,11 +22,12 @@ import uuid
 from dataclasses import dataclass
 
 from tempo_tpu import tempopb
-from tempo_tpu.db.pool import run_jobs
 from tempo_tpu.model.codec import codec_for, CURRENT_ENCODING
 from tempo_tpu.model.combine import combine_trace_protos
 from tempo_tpu.observability import tracing
 from tempo_tpu.search import SearchResults
+
+from .queue import QueueWorkerPool
 
 
 @dataclass
@@ -32,6 +36,9 @@ class FrontendConfig:
     max_concurrent_jobs: int = 50    # reference: bounded fan-out 50
     retries: int = 2                 # reference retry ware
     tolerate_failed_blocks: int = 0
+    # per-tenant queue cap; beyond it the request 429s (reference
+    # max_outstanding_per_tenant, v1/frontend.go:46-48)
+    max_outstanding_per_tenant: int = 2000
     # page-range job sizing (reference searchsharding.go:26-27
     # target_bytes_per_job default 10 MiB): a block whose search container
     # exceeds this splits into multiple page-range jobs
@@ -66,6 +73,9 @@ class QueryFrontend:
         self.cfg = cfg or FrontendConfig()
         self.db = db if db is not None else getattr(queriers[0], "db", None)
         self._rr = 0
+        self.pool = QueueWorkerPool(
+            workers=self.cfg.max_concurrent_jobs,
+            max_outstanding_per_tenant=self.cfg.max_outstanding_per_tenant)
 
     def _querier(self):
         q = self.queriers[self._rr % len(self.queriers)]
@@ -106,8 +116,7 @@ class QueryFrontend:
                 job,
             )
 
-        responses, errors = run_jobs(jobs, run,
-                                     workers=self.cfg.max_concurrent_jobs)
+        responses, errors = self.pool.run_jobs(tenant, jobs, run)
         failed = sum(r.metrics.failed_blocks for r in responses) + len(errors)
         if errors and failed > self.cfg.tolerate_failed_blocks:
             raise errors[0]
@@ -234,8 +243,8 @@ class QueryFrontend:
             merge(r)
             return r
 
-        _, errors = run_jobs(jobs, run, workers=self.cfg.max_concurrent_jobs,
-                             stop_event=quit_event)
+        _, errors = self.pool.run_jobs(tenant, jobs, run,
+                                       stop_event=quit_event)
         # partial failures past the tolerance are an error, not a silently
         # smaller answer (reference tolerate_failed_blocks → HTTP 206/5xx)
         if not quit_event.is_set() and errors and (

@@ -29,6 +29,7 @@ from tempo_tpu.search.streaming import StreamingSearchBlock, _meta_from_sd
 from tempo_tpu.observability import metrics as obs
 from tempo_tpu.utils.ids import pad_trace_id
 from .overrides import Overrides
+from .queue import ExclusiveQueue
 
 
 class LimitError(Exception):
@@ -52,6 +53,7 @@ class _Completing:
     search: object
     retry_at: float = 0.0   # monotonic time before which we skip it
     backoff_s: float = 0.0
+    in_flight: bool = False  # being completed right now (still queryable)
 
 
 class TenantInstance:
@@ -142,20 +144,32 @@ class TenantInstance:
             self._new_head()
             return True
 
-    def complete_one(self) -> "tempopb.Trace | None":
-        """Complete the oldest ELIGIBLE completing block to the backend and
-        clear its WAL files (reference handleComplete flush.go:235-281).
-        On a backend failure the block is restored with a per-block
-        exponential backoff (30s→120s cap, flush.go:359-389) so a flaky
-        backend neither hot-loops one block nor starves its siblings —
-        the next call skips backed-off blocks and completes the rest."""
+    def complete_one(self, block_id: str | None = None,
+                     ignore_backoff: bool = False) -> "tempopb.Trace | None":
+        """Complete the oldest ELIGIBLE completing block (or the specific
+        `block_id`) to the backend and clear its WAL files (reference
+        handleComplete flush.go:235-281). On a backend failure the block
+        is restored with a per-block exponential backoff (30s→120s cap,
+        flush.go:359-389) so a flaky backend neither hot-loops one block
+        nor starves its siblings — the next call skips backed-off blocks
+        and completes the rest. `ignore_backoff` is the forced-flush path
+        (shutdown/scale-down must not skip a backed-off block).
+
+        The block stays IN `completing` (marked in_flight) until the
+        backend write succeeds: a streaming completion can take seconds
+        to minutes, and queries arriving meanwhile must still see its
+        traces — the reference swaps the block out only after
+        CompleteBlock returns."""
         now = time.monotonic()
         with self.lock:
-            idx = next((i for i, c in enumerate(self.completing)
-                        if c.retry_at <= now), None)
-            if idx is None:
+            c = next((c for c in self.completing
+                      if not c.in_flight
+                      and (ignore_backoff or c.retry_at <= now)
+                      and (block_id is None
+                           or c.blk.meta.block_id == block_id)), None)
+            if c is None:
                 return None
-            c = self.completing.pop(idx)
+            c.in_flight = True
         from tempo_tpu.observability import tracing
         with tracing.start_span("ingester.CompleteBlock",
                                 tenant=self.tenant) as span:
@@ -171,12 +185,15 @@ class TenantInstance:
                 c.retry_at = time.monotonic() + c.backoff_s
                 obs.flush_failures.inc(tenant=self.tenant)
                 with self.lock:
-                    self.completing.insert(idx, c)
+                    c.in_flight = False
                 raise
+        with self.lock:
+            # atomic hand-off: queryable via `recent` (backend) the same
+            # instant it leaves `completing` (WAL)
+            self.completing.remove(c)
+            self.recent.append((meta, time.monotonic()))
         c.blk.clear()
         c.search.clear()
-        with self.lock:
-            self.recent.append((meta, time.monotonic()))
         obs.blocks_completed.inc(tenant=self.tenant)
         obs.live_traces.set(len(self.live), tenant=self.tenant)
         return meta
@@ -197,12 +214,16 @@ class TenantInstance:
             if t is not None and t.segments:
                 partials.append(self.codec.to_object(list(t.segments)))
             heads = [self.head] + [c.blk for c in self.completing]
-            recent = [m for m, _ in self.recent]
         for blk in heads:
             obj = blk.find(tid)
             if obj is not None:
                 partials.append(obj)
-        # recently completed blocks: cover the reader's blocklist-poll gap
+        # recently completed blocks: cover the reader's blocklist-poll gap.
+        # Snapshot AFTER the WAL pass — a block whose completion handed off
+        # mid-iteration (its WAL find returned None on the cleared file) is
+        # in `recent` by now, so the re-read closes the visibility gap.
+        with self.lock:
+            recent = [m for m, _ in self.recent]
         from tempo_tpu.encoding.v2 import BackendBlock
 
         for meta in recent:
@@ -269,10 +290,17 @@ class Ingester:
     """One ingester process: tenant instances + flush machinery + replay."""
 
     def __init__(self, db: TempoDB, overrides: Overrides | None = None,
-                 instance_id: str = "ingester-0"):
+                 instance_id: str = "ingester-0",
+                 concurrent_flushes: int = 4):
         self.db = db
         self.overrides = overrides or Overrides()
         self.id = instance_id
+        self.concurrent_flushes = concurrent_flushes
+        # keyed-exclusive completion ops: a block already queued or in
+        # flight is never enqueued twice, so overlapping sweeps (periodic
+        # tick racing /flush or shutdown) cannot double-complete it
+        # (reference pkg/flushqueues exclusivequeues.go:10-83 + flush.go:185)
+        self.flush_ops = ExclusiveQueue()
         self._instances: dict[str, TenantInstance] = {}
         self._lock = threading.Lock()
         self.replayed_blocks = 0
@@ -325,30 +353,86 @@ class Ingester:
     def sweep(self, max_idle_s: float = 10.0, force: bool = False,
               max_block_bytes: int = 500 << 20,
               max_block_age_s: float = 1800.0) -> list:
-        """One flush-loop tick: cut idle traces, cut ready blocks, complete
-        them. Returns completed block metas."""
-        completed = []
+        """One flush-loop tick: cut idle traces, cut ready blocks, then
+        enqueue one keyed-exclusive completion op per eligible block and
+        drain the op queue with concurrent_flushes workers (reference
+        flush.go:144-218). Returns completed block metas."""
+        completed: list = []
+        now = time.monotonic()
         for tenant in self.tenants():
             inst = self.instance(tenant)
             inst.cut_complete_traces(max_idle_s=max_idle_s, force=force)
             inst.cut_block_if_ready(max_block_bytes=max_block_bytes,
                                     max_block_age_s=max_block_age_s,
                                     force=force)
-            while True:
-                try:
-                    meta = inst.complete_one()
-                except Exception:  # noqa: BLE001 — block backed off; its
-                    continue       # siblings must still land this tick
-                if meta is None:
-                    break
-                completed.append(meta)
+            with inst.lock:
+                # force (shutdown, /flush) overrides retry backoff: a
+                # scale-down must attempt every block, not strand the
+                # backed-off ones in the local WAL
+                eligible = [(c.blk.meta.block_id, c.retry_at)
+                            for c in inst.completing
+                            if force or c.retry_at <= now]
+            for bid, prio in eligible:
+                # False (already queued/in flight from a racing sweep) is
+                # exactly the dedupe the exclusive queue exists for. The
+                # op carries ITS OWN force flag: the queue is shared, so a
+                # racing non-force drain may execute an op the force sweep
+                # enqueued — it must still bypass the backoff.
+                self.flush_ops.enqueue((tenant, bid), prio,
+                                       (tenant, bid, force))
             inst.clear_flushed()
+
+        done_lock = threading.Lock()
+
+        def drain():
+            while True:
+                op = self.flush_ops.dequeue()
+                if op is None:
+                    return
+                key, (tenant, bid, op_force) = op
+                try:
+                    meta = self.instance(tenant).complete_one(
+                        block_id=bid, ignore_backoff=op_force)
+                    if meta is not None:
+                        with done_lock:
+                            completed.append(meta)
+                except Exception:  # noqa: BLE001 — block backed off in
+                    pass           # completing; a later sweep re-enqueues
+                finally:
+                    self.flush_ops.done(key)
+
+        n = min(self.concurrent_flushes, len(self.flush_ops))
+        if n <= 1:
+            drain()
+        else:
+            threads = [threading.Thread(target=drain, name=f"flush-{i}")
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
         return completed
 
     def flush_all(self) -> list:
         """Graceful shutdown / scale-down: force everything to the backend
-        (reference /shutdown handler flush.go:91-115)."""
-        return self.sweep(force=True)
+        (reference /shutdown handler flush.go:91-115). Loops until no
+        completing blocks remain or a pass makes no progress (a racing
+        periodic sweep may consume our force-enqueued ops with its own
+        non-force semantics — the next pass re-enqueues them; a
+        persistently failing backend must not hang shutdown forever)."""
+        completed: list = []
+        stalled = 0
+        while stalled < 2:
+            before = len(completed)
+            completed += self.sweep(force=True)
+            with self._lock:
+                insts = list(self._instances.values())
+            if not any(i.completing for i in insts):
+                break
+            # one stalled pass may just mean a racer consumed our ops —
+            # retry; two in a row means the backend is down, give up
+            stalled = stalled + 1 if len(completed) == before else 0
+        return completed
 
     # ---- replay (reference replayWal ingester.go:327-416) ----
 

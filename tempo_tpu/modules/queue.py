@@ -8,6 +8,8 @@ exclusivequeues.go:10-83).
 
 from __future__ import annotations
 
+import concurrent.futures
+import contextvars
 import heapq
 import itertools
 import threading
@@ -62,10 +64,119 @@ class RequestQueue:
         with self._cv:
             return {t: len(q) for t, q in self._queues.items()}
 
+    def purge(self, tenant: str, match) -> int:
+        """Remove queued requests for which match(request) is true —
+        a rejected caller withdraws its already-enqueued sub-requests so
+        they stop counting against the tenant's outstanding cap."""
+        with self._cv:
+            q = self._queues.get(tenant)
+            if not q:
+                return 0
+            kept = deque(r for r in q if not match(r))
+            removed = len(q) - len(kept)
+            if kept:
+                self._queues[tenant] = kept
+            else:
+                self._queues.pop(tenant, None)
+            return removed
+
     def stop(self) -> None:
         with self._cv:
             self._stopped = True
             self._cv.notify_all()
+
+
+class QueueWorkerPool:
+    """N workers draining a RequestQueue — the in-process collapse of the
+    reference's frontend-v1 fair queue + querier worker fleet
+    (v1/frontend.go:33-60, querier/worker): every frontend sub-request
+    enqueues under its tenant, workers serve tenants round-robin so a
+    noisy tenant cannot starve the rest, and a full tenant queue rejects
+    with TooManyRequests (HTTP 429) instead of growing without bound."""
+
+    def __init__(self, workers: int = 50,
+                 max_outstanding_per_tenant: int = 2000):
+        self.queue = RequestQueue(max_outstanding_per_tenant)
+        self._n = max(1, workers)
+        self._threads: list[threading.Thread] = []
+        self._start_lock = threading.Lock()
+
+    def _ensure_started(self) -> None:
+        with self._start_lock:
+            if self._threads:
+                return
+            for i in range(self._n):
+                t = threading.Thread(target=self._worker, daemon=True,
+                                     name=f"query-worker-{i}")
+                t.start()
+                self._threads.append(t)
+
+    def _worker(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is None:
+                return  # stopped
+            _tenant, (fut, fn, ctx, stop_event) = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            if stop_event is not None and stop_event.is_set():
+                fut.set_result(None)  # request already satisfied (early quit)
+                continue
+            try:
+                fut.set_result(ctx.copy().run(fn))
+            except BaseException as e:  # noqa: BLE001 — delivered via future
+                fut.set_exception(e)
+
+    def submit(self, tenant: str, fn, stop_event=None,
+               ctx: contextvars.Context | None = None) -> concurrent.futures.Future:
+        """Raises TooManyRequests when the tenant's queue is full."""
+        self._ensure_started()
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        ctx = ctx if ctx is not None else contextvars.copy_context()
+        self.queue.enqueue(tenant, (fut, fn, ctx, stop_event))
+        return fut
+
+    def run_jobs(self, tenant: str, jobs, fn, stop_event=None):
+        """Fan `jobs` through the fair queue and gather like db.pool
+        run_jobs: (non-None results, errors). A full tenant queue fails
+        the WHOLE request with TooManyRequests — the reference returns
+        429 for the request rather than silently dropping sub-queries.
+        Jobs run under a copy of the caller's contextvars context so the
+        active tracing span parents the per-job spans."""
+        ctx = contextvars.copy_context()
+        futs = []
+        try:
+            for j in jobs:
+                futs.append(self.submit(
+                    tenant, (lambda j=j: fn(j)), stop_event=stop_event,
+                    ctx=ctx))
+        except TooManyRequests:
+            # withdraw what we already enqueued: left in place it would
+            # keep occupying the tenant's outstanding slots (and a racing
+            # retry would 429 again) until a worker drained the corpses
+            mine = set(map(id, futs))
+            self.queue.purge(tenant, lambda item: id(item[0]) in mine)
+            for f in futs:
+                f.cancel()
+            raise
+        results, errors = [], []
+        for f in futs:
+            try:
+                r = f.result()
+            except concurrent.futures.CancelledError:
+                continue
+            except Exception as e:  # noqa: BLE001 — partial results
+                errors.append(e)
+                continue
+            if r is not None:
+                results.append(r)
+        return results, errors
+
+    def lengths(self) -> dict[str, int]:
+        return self.queue.lengths()
+
+    def stop(self) -> None:
+        self.queue.stop()
 
 
 class ExclusiveQueue:

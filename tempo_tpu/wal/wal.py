@@ -105,11 +105,19 @@ class AppendBlock:
         raise ValueError("corrupt wal entry")
 
     def find(self, obj_id: bytes) -> bytes | None:
-        """Combined object bytes for an id, or None."""
+        """Combined object bytes for an id, or None. Tolerates a
+        concurrent clear(): completing blocks stay queryable while their
+        completion streams to the backend, so a reader may hold this block
+        right as the successful hand-off closes the file — by then the
+        trace is served from the completed block (`recent`), and the
+        correct answer HERE is 'not found', not a crash."""
         idxs = self._by_id.get(pad_trace_id(obj_id))
         if not idxs:
             return None
-        segs = [self._read_entry(self._entries[i]) for i in idxs]
+        try:
+            segs = [self._read_entry(self._entries[i]) for i in idxs]
+        except (AttributeError, ValueError, OSError):
+            return None  # cleared/closed underneath us
         return self._codec.to_object(segs)
 
     def iterator(self):

@@ -157,6 +157,11 @@ class MockS3Handler(_Base):
                 return self.reply(404, b"<Error><Code>NoSuchKey</Code></Error>")
             status, sliced = self.range_slice(data)
             return self.reply(status, sliced)
+        if self.command == "DELETE" and "uploadId" in q:
+            # AbortMultipartUpload: discard pending parts
+            with self.server.lock:
+                uploads.pop(q["uploadId"], None)
+            return self.reply(204)
         if self.command == "DELETE":
             with self.server.lock:
                 self.store.pop(full, None)
@@ -241,6 +246,12 @@ class MockGCSHandler(_Base):
                     del sessions[sid]
                     return self.reply(200, b"{}", "application/json")
             return self.reply(308, b"", "application/json")
+        if self.command == "DELETE" and path.startswith("/upload/session/"):
+            # cancel resumable upload: real GCS answers 499
+            sid = path.rsplit("/", 1)[1]
+            with self.server.lock:
+                sessions.pop(sid, None)
+            return self.reply(499, b"", "application/json")
         if self.command == "POST" and path.startswith("/upload/storage/v1/b/"):
             with self.server.lock:
                 self.store[q["name"]] = body

@@ -202,3 +202,70 @@ def test_append_large_block_via_streaming_block(cloud_backend):
     for oid, data in objs[::7]:
         assert BackendBlock(cloud_backend, out1).find_by_id(oid) == data
         assert BackendBlock(cloud_backend, out2).find_by_id(oid) == data
+
+
+def test_abort_append_releases_pending_upload(s3_server, gcs_server):
+    """abort_append must release server-side upload state (S3 pending
+    multipart uploads bill until aborted; GCS sessions linger a week) and
+    leave the object invisible (ADVICE r3: failed completions previously
+    orphaned one upload per retry attempt)."""
+    srv, ep = s3_server
+    be = S3Backend(bucket="tempo", endpoint=ep, access_key="AKIATEST",
+                   secret_key="s3cr3t", prefix="traces", retries=1)
+    tracker = be.append("t1", "blka", "data", None, b"x" * (6 << 20))
+    assert getattr(srv, "uploads", {})  # pending multipart exists
+    be.abort_append("t1", "blka", "data", tracker)
+    assert not srv.uploads
+    with pytest.raises(DoesNotExist):
+        be.read("t1", "blka", "data")
+
+    srv, ep = gcs_server
+    be = GCSBackend(bucket="tempo", endpoint=ep, token="tok-123",
+                    prefix="traces", retries=1)
+    tracker = be.append("t1", "blka", "data", None, b"y" * (512 << 10))
+    assert getattr(srv, "sessions", {})
+    be.abort_append("t1", "blka", "data", tracker)
+    assert not srv.sessions
+    with pytest.raises(DoesNotExist):
+        be.read("t1", "blka", "data")
+
+
+def test_failed_streaming_completion_aborts_append(tmp_path):
+    """A completion that dies after streaming began must abort its append:
+    no hidden temp files accumulate in the block dir across retries."""
+    import os
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.backend.types import NAME_INDEX
+
+    be = LocalBackend(str(tmp_path / "blocks"))
+    db = TempoDB(be, str(tmp_path / "wal"),
+                 TempoDBConfig(block_encoding="none",
+                               block_page_size=8 << 10,
+                               complete_flush_bytes=16 << 10))
+    real_write = be.write
+
+    def poisoned(tenant, block_id, name, data):
+        if name == NAME_INDEX:
+            raise OSError("flake")  # dies AFTER the data stream finished
+        return real_write(tenant, block_id, name, data)
+
+    be.write = poisoned
+    objects = [(bytes([i]) * 16, os.urandom(16 << 10), 0, 0)
+               for i in range(12)]
+    for attempt in range(3):
+        with pytest.raises(OSError):
+            db.write_block_direct("t1", objects)
+    be.write = real_write
+    # no orphaned append temp files anywhere under the tenant dir
+    stray = [os.path.join(r, f)
+             for r, _, fs in os.walk(str(tmp_path / "blocks"))
+             for f in fs if ".append." in f]
+    assert stray == [], stray
+    # and no committed-but-metaless objects either: each attempt minted a
+    # fresh block id whose streamed `data` object committed before the
+    # index write failed — abort() must have deleted it, or retention
+    # (blocklist-driven) would never reclaim it
+    leftovers = [os.path.join(r, f)
+                 for r, _, fs in os.walk(str(tmp_path / "blocks"))
+                 for f in fs]
+    assert leftovers == [], leftovers
