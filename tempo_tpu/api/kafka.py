@@ -194,7 +194,16 @@ class Reader:
 
 class CorruptBatchError(ValueError):
     """A record batch failed its CRC32C check — distinct from protocol
-    desync errors so poison-skip logic never misfires on those."""
+    desync errors so poison-skip logic never misfires on those.
+
+    `next_offset` is the first offset after the corrupt batch (from the
+    batch header's lastOffsetDelta, sanity-bounded) so the consumer can
+    skip the WHOLE batch in one step instead of grinding through one
+    fetch+CRC cycle per record (ADVICE r1 #3)."""
+
+    def __init__(self, msg: str, next_offset: int | None = None):
+        super().__init__(msg)
+        self.next_offset = next_offset
 
 
 def encode_record_batch(
@@ -244,10 +253,14 @@ def encode_record_batch(
     return batch.getvalue()
 
 
-def decode_record_batches(data: bytes) -> list[tuple[int, bytes | None, bytes]]:
+def decode_record_batches(data: bytes, expect_base: int | None = None
+                          ) -> list[tuple[int, bytes | None, bytes]]:
     """record set (possibly several batches, possibly truncated tail) →
     [(offset, key, value)]. A truncated final batch — normal in Kafka
-    fetch responses — is silently dropped.
+    fetch responses — is silently dropped. `expect_base` is the offset
+    the caller fetched at: batch-skip math is only trusted when the
+    corrupt batch's baseOffset is plausibly anchored to it (baseOffset
+    lives OUTSIDE the CRC'd region, so it can itself be the garbage).
 
     A CRC-corrupt batch raises CorruptBatchError ONLY when no records
     were decoded before it; otherwise the good prefix is returned so the
@@ -273,7 +286,35 @@ def decode_record_batches(data: bytes) -> list[tuple[int, bytes | None, bytes]]:
             if crc32c(crc_body) != crc:
                 if out:
                     return out  # deliver the good prefix first
-                raise CorruptBatchError("kafka: record batch crc32c mismatch")
+                # lastOffsetDelta and the record count both live in the
+                # corrupt body, so either could itself be the flipped
+                # bits. Trust the delta only when it is SELF-CONSISTENT
+                # (delta == count-1, the invariant producers write) and
+                # within byte bounds; otherwise skip a single offset —
+                # over-skipping would silently drop valid batches.
+                # the header prefix (baseOffset, batchLen) is NOT CRC'd
+                # either: anchor it to the offset the caller requested (a
+                # broker answers with the batch containing that offset)
+                # before trusting any skip math derived from it
+                anchored = (expect_base is None
+                            or base_offset <= expect_base < base_offset + batch_len)
+                next_off = None
+                if anchored:
+                    next_off = base_offset + 1
+                    try:
+                        rr = Reader(crc_body)
+                        rr.i16()  # attributes
+                        delta = rr.i32()
+                        rr.i64(); rr.i64(); rr.i64()  # ts, ts, producerId
+                        rr.i16(); rr.i32()  # producerEpoch, baseSequence
+                        count = rr.i32()
+                        if 0 <= delta < batch_len and delta == count - 1:
+                            next_off = base_offset + delta + 1
+                    except EOFError:
+                        pass
+                raise CorruptBatchError(
+                    "kafka: record batch crc32c mismatch",
+                    next_offset=next_off)
             r.i16()  # attributes
             r.i32()  # lastOffsetDelta
             r.i64()  # firstTimestamp
@@ -580,7 +621,9 @@ class KafkaClient:
                 # brokers return whole batches; drop records below the
                 # requested offset (standard client behavior)
                 records = [
-                    rec for rec in decode_record_batches(record_set) if rec[0] >= offset
+                    rec for rec in decode_record_batches(
+                        record_set, expect_base=offset)
+                    if rec[0] >= offset
                 ]
         return records, hw
 
@@ -790,12 +833,15 @@ class KafkaReceiver:
                     self.offset_resets += 1
                     continue
                 raise
-            except CorruptBatchError:
-                # corrupt batch (CRC mismatch): poison-skip one offset so
-                # the partition doesn't wedge; surfaced in decode metrics
+            except CorruptBatchError as e:
+                # corrupt batch (CRC mismatch): poison-skip past the whole
+                # batch when its header's offset delta is self-consistent
+                # (delta == count-1), so an N-record batch costs one
+                # fetch instead of N; inconsistent headers skip one offset
                 self.decode_errors += 1
                 _decode_errors_total.inc()
-                self._offsets[partition] = offset + 1
+                self._offsets[partition] = max(
+                    offset + 1, e.next_offset or 0)
                 continue
             if not records:
                 continue

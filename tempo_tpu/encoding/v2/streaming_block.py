@@ -62,6 +62,7 @@ class StreamingBlock:
         # invisible to the blocklist and retention would never reclaim it)
         self._written: list[str] = []
         self._write_backend: RawBackend | None = None
+        self._meta_attempted = False
 
     def add_object(self, obj_id: bytes, data: bytes,
                    start: int = 0, end: int = 0) -> None:
@@ -149,6 +150,7 @@ class StreamingBlock:
         for s in range(bloom.shard_count):
             backend.write(m.tenant_id, m.block_id, bloom_name(s), bloom.marshal_shard(s))
             self._written.append(bloom_name(s))
+        self._meta_attempted = True
         backend.write_block_meta(m)
         return m
 
@@ -169,12 +171,30 @@ class StreamingBlock:
                 pass
         be = self._write_backend or self.backend
         if be is not None:
-            for name in self._written:
+            safe = True
+            if self._meta_attempted:
+                # an ambiguous meta-write failure (client timeout after the
+                # server durably stored meta.json) would otherwise leave a
+                # VISIBLE meta pointing at deleted objects — worse than
+                # orphaned garbage. Remove the meta first; only if that
+                # delete is known-good may the rest be reclaimed.
+                from tempo_tpu.backend.raw import DoesNotExist
+                from tempo_tpu.backend.types import NAME_META
                 try:
-                    be.delete(self.meta.tenant_id, self.meta.block_id, name)
-                except Exception:  # noqa: BLE001 — best-effort cleanup
-                    pass
-        self._written = []
+                    be.delete(self.meta.tenant_id, self.meta.block_id,
+                              NAME_META)
+                except DoesNotExist:
+                    pass  # meta never committed — the common case
+                except Exception:  # noqa: BLE001 — meta state unknown:
+                    safe = False   # keep data/index so the block stays whole
+            if safe:
+                for name in self._written:
+                    try:
+                        be.delete(self.meta.tenant_id, self.meta.block_id,
+                                  name)
+                    except Exception:  # noqa: BLE001 — best-effort cleanup
+                        pass
+                self._written = []
         self._tracker = None
         self._appending = False
         self._pages = []

@@ -36,7 +36,8 @@ class FrontendConfig:
     max_concurrent_jobs: int = 50    # reference: bounded fan-out 50
     retries: int = 2                 # reference retry ware
     tolerate_failed_blocks: int = 0
-    # per-tenant queue cap; beyond it the request 429s (reference
+    # per-tenant cap on concurrently-outstanding REQUESTS (not
+    # sub-requests); beyond it the whole request 429s (reference
     # max_outstanding_per_tenant, v1/frontend.go:46-48)
     max_outstanding_per_tenant: int = 2000
     # page-range job sizing (reference searchsharding.go:26-27

@@ -22,13 +22,45 @@ class TooManyRequests(Exception):
 
 class RequestQueue:
     """Round-robin across tenants, FIFO within a tenant. `get` blocks until
-    a request is available or the queue stops."""
+    a request is available or the queue stops.
 
-    def __init__(self, max_outstanding_per_tenant: int = 2000):
+    The outstanding cap counts top-level REQUESTS (begin_request /
+    end_request brackets), not queued sub-requests — the reference v1
+    queue does the same (v1/frontend.go:46-48); a cap on sub-requests
+    would make any single search whose own fan-out exceeds the cap
+    deterministically 429 itself even on an idle system."""
+
+    def __init__(self, max_outstanding_per_tenant: int = 2000,
+                 max_queued_per_tenant: int = 100_000):
         self.max_outstanding = max_outstanding_per_tenant
+        # memory backpressure, complementary to the request cap: many
+        # outstanding requests × many sub-requests each must not grow the
+        # queue without bound
+        self.max_queued = max_queued_per_tenant
         self._queues: OrderedDict[str, deque] = OrderedDict()
+        self._outstanding: dict[str, int] = {}
         self._cv = threading.Condition()
         self._stopped = False
+
+    def begin_request(self, tenant: str) -> None:
+        """Claim an outstanding-request slot; raises TooManyRequests when
+        the tenant is at its cap."""
+        with self._cv:
+            if self._outstanding.get(tenant, 0) >= self.max_outstanding:
+                raise TooManyRequests(tenant)
+            self._outstanding[tenant] = self._outstanding.get(tenant, 0) + 1
+
+    def end_request(self, tenant: str) -> None:
+        with self._cv:
+            n = self._outstanding.get(tenant, 1) - 1
+            if n > 0:
+                self._outstanding[tenant] = n
+            else:
+                self._outstanding.pop(tenant, None)
+
+    def outstanding(self, tenant: str) -> int:
+        with self._cv:
+            return self._outstanding.get(tenant, 0)
 
     def enqueue(self, tenant: str, request) -> None:
         with self._cv:
@@ -37,8 +69,8 @@ class RequestQueue:
             q = self._queues.get(tenant)
             if q is None:
                 q = self._queues[tenant] = deque()
-            if len(q) >= self.max_outstanding:
-                raise TooManyRequests(tenant)
+            if len(q) >= self.max_queued:
+                raise TooManyRequests(f"{tenant}: sub-request queue full")
             q.append(request)
             self._cv.notify()
 
@@ -64,22 +96,6 @@ class RequestQueue:
         with self._cv:
             return {t: len(q) for t, q in self._queues.items()}
 
-    def purge(self, tenant: str, match) -> int:
-        """Remove queued requests for which match(request) is true —
-        a rejected caller withdraws its already-enqueued sub-requests so
-        they stop counting against the tenant's outstanding cap."""
-        with self._cv:
-            q = self._queues.get(tenant)
-            if not q:
-                return 0
-            kept = deque(r for r in q if not match(r))
-            removed = len(q) - len(kept)
-            if kept:
-                self._queues[tenant] = kept
-            else:
-                self._queues.pop(tenant, None)
-            return removed
-
     def stop(self) -> None:
         with self._cv:
             self._stopped = True
@@ -91,8 +107,9 @@ class QueueWorkerPool:
     reference's frontend-v1 fair queue + querier worker fleet
     (v1/frontend.go:33-60, querier/worker): every frontend sub-request
     enqueues under its tenant, workers serve tenants round-robin so a
-    noisy tenant cannot starve the rest, and a full tenant queue rejects
-    with TooManyRequests (HTTP 429) instead of growing without bound."""
+    noisy tenant cannot starve the rest, and a tenant at its
+    outstanding-REQUEST cap (or the sub-request memory bound) is
+    rejected with TooManyRequests (HTTP 429)."""
 
     def __init__(self, workers: int = 50,
                  max_outstanding_per_tenant: int = 2000):
@@ -129,7 +146,9 @@ class QueueWorkerPool:
 
     def submit(self, tenant: str, fn, stop_event=None,
                ctx: contextvars.Context | None = None) -> concurrent.futures.Future:
-        """Raises TooManyRequests when the tenant's queue is full."""
+        """Enqueue one sub-request. Admission control is request-level
+        (begin_request, used by run_jobs); this only rejects —
+        TooManyRequests — at the sub-request memory bound."""
         self._ensure_started()
         fut: concurrent.futures.Future = concurrent.futures.Future()
         ctx = ctx if ctx is not None else contextvars.copy_context()
@@ -137,40 +156,42 @@ class QueueWorkerPool:
         return fut
 
     def run_jobs(self, tenant: str, jobs, fn, stop_event=None):
-        """Fan `jobs` through the fair queue and gather like db.pool
-        run_jobs: (non-None results, errors). A full tenant queue fails
-        the WHOLE request with TooManyRequests — the reference returns
-        429 for the request rather than silently dropping sub-queries.
+        """Fan `jobs` through the fair queue as ONE outstanding request
+        and gather like db.pool run_jobs: (non-None results, errors). A
+        tenant at max_outstanding REQUESTS fails whole with
+        TooManyRequests (HTTP 429), before any sub-request enqueues.
         Jobs run under a copy of the caller's contextvars context so the
         active tracing span parents the per-job spans."""
-        ctx = contextvars.copy_context()
-        futs = []
+        self.queue.begin_request(tenant)  # raises TooManyRequests at cap
         try:
-            for j in jobs:
-                futs.append(self.submit(
-                    tenant, (lambda j=j: fn(j)), stop_event=stop_event,
-                    ctx=ctx))
-        except TooManyRequests:
-            # withdraw what we already enqueued: left in place it would
-            # keep occupying the tenant's outstanding slots (and a racing
-            # retry would 429 again) until a worker drained the corpses
-            mine = set(map(id, futs))
-            self.queue.purge(tenant, lambda item: id(item[0]) in mine)
-            for f in futs:
-                f.cancel()
-            raise
-        results, errors = [], []
-        for f in futs:
+            ctx = contextvars.copy_context()
+            futs = []
             try:
-                r = f.result()
-            except concurrent.futures.CancelledError:
-                continue
-            except Exception as e:  # noqa: BLE001 — partial results
-                errors.append(e)
-                continue
-            if r is not None:
-                results.append(r)
-        return results, errors
+                for j in jobs:
+                    futs.append(self.submit(
+                        tenant, (lambda j=j: fn(j)),
+                        stop_event=stop_event, ctx=ctx))
+            except TooManyRequests:
+                # sub-request memory bound mid-request: withdraw and fail
+                # whole (cancelled corpses drain fast; the bound already
+                # capped their memory)
+                for f in futs:
+                    f.cancel()
+                raise
+            results, errors = [], []
+            for f in futs:
+                try:
+                    r = f.result()
+                except concurrent.futures.CancelledError:
+                    continue
+                except Exception as e:  # noqa: BLE001 — partial results
+                    errors.append(e)
+                    continue
+                if r is not None:
+                    results.append(r)
+            return results, errors
+        finally:
+            self.queue.end_request(tenant)
 
     def lengths(self) -> dict[str, int]:
         return self.queue.lengths()

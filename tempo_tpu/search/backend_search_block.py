@@ -141,6 +141,8 @@ class BackendSearchBlock:
         results.metrics.inspected_bytes += int(
             self.header().get("compressed_size", 0)
         )
+        results.metrics.truncated_entries += int(
+            self.header().get("truncated_entries", 0) or 0)
         for m in engine.results(sp, cq, scores, idx):
             results.add(m)
         return results

@@ -217,6 +217,12 @@ class BlockBatcher:
                     continue
                 results.metrics.inspected_blocks += 1
                 results.metrics.inspected_bytes += j.bytes_est
+                if j.key[1] == 0:
+                    # write-time kv-slot truncation surfaces on the query
+                    # it may have falsified; attributed to the page-0 job
+                    # so a block split across range jobs counts once
+                    results.metrics.truncated_entries += int(
+                        j.header.get("truncated_entries", 0) or 0)
             results.metrics.inspected_traces += max(0, inspected)
             for m in self.engine.results(cached.batch, mq,
                                          np.asarray(scores), np.asarray(idx)):

@@ -144,8 +144,15 @@ def masked_topk(mask, entry_start, top_k: int):
 
     Two-stage for large inputs: lax.top_k over 1M elements costs ~2ms on
     v5e (it partial-sorts the full array); chunked per-group top-k then a
-    global pass over G*k candidates is ~4x cheaper and bit-identical
-    (every global winner is a winner of its chunk)."""
+    global pass over G*k candidates is ~4x cheaper. The SCORES returned
+    are identical to single-stage top_k (every global winner wins its
+    chunk), but tie-breaking among equal start seconds differs: lax.top_k
+    breaks ties by lowest flat index, while the two-stage pass orders
+    candidates by (chunk, rank) — so at the k boundary a tie may resolve
+    to a different entry than the single-stage path would pick. Callers
+    treat equal-start results as unordered (the reference sorts results
+    by start time only, search/util.go), so this is semantically
+    invisible; do not rely on index-level equality between the paths."""
     score = jnp.where(
         mask, jnp.minimum(entry_start, jnp.uint32(2**31 - 1)).astype(jnp.int32),
         jnp.int32(-1),

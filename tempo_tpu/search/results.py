@@ -56,6 +56,7 @@ class SearchResults:
         m.inspected_bytes += resp.metrics.inspected_bytes
         m.inspected_blocks += resp.metrics.inspected_blocks
         m.skipped_blocks += resp.metrics.skipped_blocks
+        m.truncated_entries += resp.metrics.truncated_entries
 
     @property
     def complete(self) -> bool:

@@ -243,12 +243,39 @@ class Resolver:
                 sock.connect(self.nameserver)
                 sock.send(pkt)
                 resp = sock.recv(4096)
+                if len(resp) >= 4 and struct.unpack_from(">H", resp, 2)[0] & 0x0200:
+                    # TC bit: the answer didn't fit in UDP (a large
+                    # cluster's SRV set easily passes 512 bytes) — without
+                    # this, discovery silently shrinks to whatever the
+                    # server squeezed in. RFC 7766: retry over TCP.
+                    return self._query_tcp(pkt, txid)
                 return parse_response(resp, txid)
             except (OSError, ValueError, struct.error) as e:
                 last = e
             finally:
                 sock.close()
         raise last if last else OSError("dns: query failed")
+
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise OSError("dns: tcp connection closed mid-response")
+            buf += chunk
+        return buf
+
+    def _query_tcp(self, pkt: bytes, txid: int):
+        """RFC 7766 fallback for truncated UDP answers: same query over
+        TCP with 2-byte length framing."""
+        with socket.create_connection(self.nameserver,
+                                      timeout=self.timeout_s) as s:
+            s.settimeout(self.timeout_s)
+            s.sendall(struct.pack(">H", len(pkt)) + pkt)
+            (ln,) = struct.unpack(">H", self._recv_exact(s, 2))
+            resp = self._recv_exact(s, ln)
+        return parse_response(resp, txid)
 
     # -- spec resolution ----------------------------------------------------
 

@@ -70,6 +70,7 @@ class AppendBlock:
             self._fh = open(self.path, "wb")
         self._rfh = open(self.path, "rb")
         self._offset = os.path.getsize(self.path)
+        self._closed = False
 
     # ---- write path ----
 
@@ -117,7 +118,9 @@ class AppendBlock:
         try:
             segs = [self._read_entry(self._entries[i]) for i in idxs]
         except (AttributeError, ValueError, OSError):
-            return None  # cleared/closed underneath us
+            if self._closed:
+                return None  # cleared/closed underneath us
+            raise  # genuine on-disk corruption must surface, not 404
         return self._codec.to_object(segs)
 
     def iterator(self):
@@ -130,6 +133,9 @@ class AppendBlock:
     # ---- lifecycle ----
 
     def close(self) -> None:
+        # flag FIRST: a racing find() that hits the closing file must see
+        # _closed and answer None rather than re-raise (see find())
+        self._closed = True
         if self._fh:
             self._fh.close()
             self._fh = None

@@ -36,30 +36,76 @@ class FakeDNSServer:
     """zone: {("name", TYPE): [rdata, ...]} where rdata is "1.2.3.4" for
     A and (prio, weight, port, "target.name") for SRV."""
 
-    def __init__(self, zone: dict):
+    def __init__(self, zone: dict, udp_limit: int | None = None):
+        """udp_limit: UDP responses longer than this are truncated (TC
+        bit set, empty answer section) like a real 512-byte-era server;
+        the full answer is served over TCP on the same port."""
         self.zone = {(n.lower().rstrip("."), t): v for (n, t), v in zone.items()}
         self.queries: list[tuple[str, int]] = []
+        self.tcp_queries = 0
+        self.udp_limit = udp_limit
         fake = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 data, sock = self.request
                 resp = fake.answer(data)
+                if resp and fake.udp_limit and len(resp) > fake.udp_limit:
+                    resp = fake.truncated(data)
                 if resp:
                     sock.sendto(resp, self.client_address)
 
-        self.server = socketserver.ThreadingUDPServer(("127.0.0.1", 0), Handler)
-        self.server.daemon_threads = True
-        self.addr = self.server.server_address  # (host, port)
-        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        class TCPHandler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    (ln,) = struct.unpack(">H", self.rfile.read(2))
+                    q = self.rfile.read(ln)
+                    resp = fake.answer(q)
+                    fake.tcp_queries += 1
+                    self.wfile.write(struct.pack(">H", len(resp)) + resp)
+                except Exception:  # noqa: BLE001 — fake server
+                    pass
+
+        # UDP and TCP must share one port (real DNS); the kernel-picked
+        # UDP port may have a live TCP listener — retry on a fresh port
+        class _TCPServer(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True  # scoped: don't mutate the stdlib class
+
+        for _ in range(20):
+            self.server = socketserver.ThreadingUDPServer(
+                ("127.0.0.1", 0), Handler)
+            self.server.daemon_threads = True
+            self.addr = self.server.server_address  # (host, port)
+            try:
+                self.tcp_server = _TCPServer(self.addr, TCPHandler)
+                break
+            except OSError:
+                self.server.server_close()
+        else:
+            raise OSError("fake dns: no port with both UDP and TCP free")
+        self.tcp_server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self._tcp_thread = threading.Thread(
+            target=self.tcp_server.serve_forever, daemon=True)
 
     def start(self):
         self._thread.start()
+        self._tcp_thread.start()
         return self
 
     def stop(self):
         self.server.shutdown()
         self.server.server_close()
+        self.tcp_server.shutdown()
+        self.tcp_server.server_close()
+
+    def truncated(self, query: bytes) -> bytes:
+        """TC response: original question echoed, no answers, TC bit."""
+        txid = struct.unpack_from(">H", query, 0)[0]
+        qname, pos = _read_name(query, 12)
+        question = query[12:pos + 4]
+        return struct.pack(">HHHHHH", txid, 0x8180 | 0x0200, 1, 0, 0, 0) + question
 
     def answer(self, query: bytes) -> bytes:
         txid, _flags, qd, *_ = struct.unpack_from(">HHHHHH", query, 0)

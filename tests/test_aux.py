@@ -61,11 +61,16 @@ def test_request_queue_tenant_fairness():
 
 
 def test_request_queue_max_outstanding():
+    # the cap counts top-level request brackets, not queued sub-requests
+    # (reference v1/frontend.go:46-48)
     q = RequestQueue(max_outstanding_per_tenant=2)
-    q.enqueue("t", 1)
-    q.enqueue("t", 2)
+    q.begin_request("t")
+    q.begin_request("t")
     with pytest.raises(TooManyRequests):
-        q.enqueue("t", 3)
+        q.begin_request("t")
+    q.end_request("t")
+    q.begin_request("t")  # slot released -> admitted again
+    assert q.outstanding("t") == 2
 
 
 def test_exclusive_queue_dedupes_inflight():
@@ -363,3 +368,13 @@ def test_otlp_http_receiver(tmp_path):
     assert code == 200
     resp = app.find_trace("t1", tid)
     assert len(resp.trace.batches) == len(tr.batches)
+
+
+def test_request_queue_sub_request_memory_bound():
+    """Complementary to the request cap: queued sub-requests are bounded
+    per tenant so frontend memory cannot grow without limit."""
+    q = RequestQueue(max_outstanding_per_tenant=10, max_queued_per_tenant=3)
+    for i in range(3):
+        q.enqueue("t", i)
+    with pytest.raises(TooManyRequests):
+        q.enqueue("t", 3)

@@ -269,3 +269,49 @@ def test_failed_streaming_completion_aborts_append(tmp_path):
                  for r, _, fs in os.walk(str(tmp_path / "blocks"))
                  for f in fs]
     assert leftovers == [], leftovers
+
+
+def test_ambiguous_meta_failure_keeps_block_objects(tmp_path):
+    """If the meta write fails AMBIGUOUSLY (meta may be durably stored
+    server-side) and the meta delete also fails, abort must NOT delete
+    data/index — a visible meta pointing at deleted objects is worse than
+    orphaned garbage (code-review r3 finding)."""
+    import os
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.backend.types import NAME_META, BlockMeta
+    from tempo_tpu.encoding.v2 import StreamingBlock
+
+    be = LocalBackend(str(tmp_path / "blocks"))
+    real_write = be.write
+    real_delete = be.delete
+
+    def meta_write_times_out(tenant, block_id, name, data):
+        real_write(tenant, block_id, name, data)  # server stored it...
+        if name == NAME_META:
+            raise OSError("client timeout")  # ...but the client never knew
+
+    be.write = meta_write_times_out
+    be.delete = lambda *a: (_ for _ in ()).throw(OSError("down"))
+    m = BlockMeta(tenant_id="t1", encoding="none")
+    sb = StreamingBlock(m, page_size=4096)
+    sb.add_object(b"\x01" * 16, b"x" * 8192)
+    with pytest.raises(OSError):
+        sb.complete(be)
+    sb.abort()
+    be.write, be.delete = real_write, real_delete
+    # data/index survived: the (durably stored) meta still points at a
+    # whole block
+    names = set(os.listdir(str(tmp_path / "blocks" / "t1" / m.block_id)))
+    assert "data" in names and "meta.json" in names
+
+    # when the meta delete WORKS, abort reclaims everything
+    be2 = LocalBackend(str(tmp_path / "blocks2"))
+    be2.write = lambda t, b, n, d, w=be2.write: (
+        (_ for _ in ()).throw(OSError("boom")) if n == NAME_META else w(t, b, n, d))
+    m2 = BlockMeta(tenant_id="t1", encoding="none")
+    sb2 = StreamingBlock(m2, page_size=4096)
+    sb2.add_object(b"\x02" * 16, b"y" * 8192)
+    with pytest.raises(OSError):
+        sb2.complete(be2)
+    sb2.abort()
+    assert not os.path.exists(str(tmp_path / "blocks2" / "t1" / m2.block_id))

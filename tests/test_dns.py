@@ -222,3 +222,19 @@ def test_memberlist_dns_join(dns):
     finally:
         a.shutdown()
         b.shutdown()
+
+
+def test_truncated_udp_falls_back_to_tcp():
+    """A TC-flagged UDP answer (large SRV sets pass 512 bytes in real
+    clusters) must retry over TCP and return the FULL record set —
+    previously discovery silently shrank to the truncated answer
+    (ADVICE r1 #4)."""
+    zone = {("big.example.org", TYPE_A): [f"10.9.{i}.1" for i in range(40)]}
+    s = FakeDNSServer(zone, udp_limit=100).start()
+    try:
+        r = Resolver(nameserver=s.addr, timeout_s=2.0, retries=0)
+        got = r.resolve_spec("dns+big.example.org:7946")
+        assert len(got) == 40, got
+        assert s.tcp_queries >= 1  # served via the TCP fallback
+    finally:
+        s.stop()

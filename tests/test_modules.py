@@ -588,9 +588,10 @@ def test_queue_pool_fair_interleaving():
 
 
 def test_frontend_queue_429_and_http_mapping(tmp_path):
-    """A tenant with more queued sub-requests than max_outstanding gets
-    TooManyRequests, surfaced as HTTP 429 (reference frontend v1
-    max-outstanding)."""
+    """A tenant at max outstanding REQUESTS gets TooManyRequests,
+    surfaced as HTTP 429 (reference frontend v1 max-outstanding counts
+    requests, not sub-requests — a single large fan-out must not 429
+    itself on an idle system)."""
     import threading
     from tempo_tpu.api.http import HTTPApi
     from tempo_tpu.modules.frontend import QueryFrontend, FrontendConfig
@@ -599,22 +600,38 @@ def test_frontend_queue_429_and_http_mapping(tmp_path):
     app = _app(tmp_path)
     fe = QueryFrontend(app.queriers, FrontendConfig(
         query_shards=8, max_concurrent_jobs=1,
-        max_outstanding_per_tenant=2))
+        max_outstanding_per_tenant=1))
     gate = threading.Event()
     blocker = fe.pool.submit("warm", gate.wait)  # saturate the one worker
+
+    # first request occupies t1's single outstanding slot (jobs queued
+    # behind the blocker)
+    t = threading.Thread(target=lambda: fe.find_trace_by_id(
+        "t1", random_trace_id()))
+    t.start()
+    while fe.pool.queue.outstanding("t1") < 1:
+        time.sleep(0.001)
 
     with pytest.raises(TooManyRequests):
         fe.find_trace_by_id("t1", random_trace_id())
 
-    # same condition through the HTTP layer → 429, not 500
+    # same condition through the HTTP layer -> 429, not 500
     app.frontend = fe
     api = HTTPApi(app)
     code, body = api.handle(
         "GET", "/api/traces/" + random_trace_id().hex(), {},
         {"X-Scope-OrgID": "t1"})
     assert code == 429, (code, body)
+
     gate.set()
     blocker.result(timeout=10)
+    t.join(timeout=10)
+    # slot released: the same tenant serves again (8 sub-requests fit in
+    # ONE outstanding request even though the cap is 1)
+    code, body = api.handle(
+        "GET", "/api/traces/" + random_trace_id().hex(), {},
+        {"X-Scope-OrgID": "t1"})
+    assert code == 404, (code, body)  # served (unknown id), NOT 429
     fe.pool.stop()
 
 
