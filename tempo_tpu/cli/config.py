@@ -106,6 +106,12 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         # gossip_interval_s, suspect_timeout_s} — multi-process gossip
         "memberlist": doc.get("memberlist", {}),
         "instance_id": doc.get("instance_id", ""),
+        # multi-host mesh: {coordinator: "host:port", num_processes,
+        # process_id, cpu_devices_per_host} — env-substitutable
+        # (${TEMPO_PROCESS_ID}); empty/absent = single host. A v5e-64
+        # (BASELINE config 5) is coordinator + num_processes: 16 (4 chips
+        # per host), the scan mesh axis spanning all 64 chips.
+        "distributed": doc.get("distributed", {}),
         "warnings": check_config(cfg, doc),
     }
     return cfg, runtime

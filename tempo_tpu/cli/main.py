@@ -47,6 +47,22 @@ def main(argv=None) -> int:
     http_port = args.http_port or runtime["http_port"]
     grpc_port = args.grpc_port or runtime["grpc_port"]
 
+    dist = runtime.get("distributed") or {}
+    if dist.get("coordinator") or "TEMPO_COORDINATOR" in __import__("os").environ:
+        # must run before anything touches jax devices: the scan mesh
+        # then spans every host's chips (SURVEY §2.6 TPU note)
+        from tempo_tpu.parallel.multihost import init_distributed
+
+        if init_distributed(
+            coordinator=dist.get("coordinator"),
+            num_processes=dist.get("num_processes"),
+            process_id=dist.get("process_id"),
+            cpu_devices_per_host=dist.get("cpu_devices_per_host"),
+        ):
+            log.info("joined distributed runtime")
+        else:
+            log.info("no coordinator configured; running single-host")
+
     stop = threading.Event()
 
     def on_signal(signum, frame):

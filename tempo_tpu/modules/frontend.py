@@ -40,6 +40,8 @@ class FrontendConfig:
     # sub-requests); beyond it the whole request 429s (reference
     # max_outstanding_per_tenant, v1/frontend.go:46-48)
     max_outstanding_per_tenant: int = 2000
+    # complementary memory bound on QUEUED sub-requests per tenant
+    max_queued_per_tenant: int = 100_000
     # page-range job sizing (reference searchsharding.go:26-27
     # target_bytes_per_job default 10 MiB): a block whose search container
     # exceeds this splits into multiple page-range jobs
@@ -76,7 +78,8 @@ class QueryFrontend:
         self._rr = 0
         self.pool = QueueWorkerPool(
             workers=self.cfg.max_concurrent_jobs,
-            max_outstanding_per_tenant=self.cfg.max_outstanding_per_tenant)
+            max_outstanding_per_tenant=self.cfg.max_outstanding_per_tenant,
+            max_queued_per_tenant=self.cfg.max_queued_per_tenant)
 
     def _querier(self):
         q = self.queriers[self._rr % len(self.queriers)]

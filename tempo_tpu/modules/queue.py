@@ -112,8 +112,10 @@ class QueueWorkerPool:
     rejected with TooManyRequests (HTTP 429)."""
 
     def __init__(self, workers: int = 50,
-                 max_outstanding_per_tenant: int = 2000):
-        self.queue = RequestQueue(max_outstanding_per_tenant)
+                 max_outstanding_per_tenant: int = 2000,
+                 max_queued_per_tenant: int = 100_000):
+        self.queue = RequestQueue(max_outstanding_per_tenant,
+                                  max_queued_per_tenant)
         self._n = max(1, workers)
         self._threads: list[threading.Thread] = []
         self._start_lock = threading.Lock()

@@ -1,0 +1,187 @@
+"""Localhost multi-process dryrun of the multi-host serving path.
+
+Validates BASELINE config 5's shape without TPU hardware: N OS processes
+join one JAX distributed runtime (gloo collectives over loopback — the
+DCN stand-in), each simulating a host with M CPU "chips"; the scan mesh
+spans all N*M devices; every process drives the production
+`TempoDB.search` over the same backend corpus; per-host staging places
+only the process-local page shards (multiblock.stack_blocks
+make_array_from_callback path); and the launcher asserts every process
+returns the identical answer, equal to the host oracle.
+
+Run directly (`python -m tempo_tpu.parallel.multihost_dryrun`) or via
+`__graft_entry__.dryrun_multihost(n)`. Reference analog: the querier
+worker fleet joining the frontend over gRPC
+(/root/reference/modules/querier/worker/worker.go:23-51) — here the
+"join" is jax.distributed and the result merge is on-device collectives.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+
+
+def _corpus(n=32, seed=0):
+    from tempo_tpu.search.data import SearchData
+
+    rng = random.Random(seed)
+    entries = []
+    for i in range(n):
+        sd = SearchData(trace_id=rng.randbytes(16))
+        sd.start_s = 1_600_000_000 + seed * 1000 + i
+        sd.end_s = sd.start_s + 5
+        sd.dur_ms = rng.randint(1, 10_000)
+        sd.root_service = rng.choice(["frontend", "checkout"])
+        sd.root_name = "GET /"
+        sd.kvs = {
+            "service.name": {sd.root_service},
+            "http.status_code": {str(rng.choice([200, 500]))},
+        }
+        entries.append(sd)
+    return entries
+
+
+def _query():
+    from tempo_tpu import tempopb
+
+    req = tempopb.SearchRequest()
+    req.tags["service.name"] = "frontend"
+    req.min_duration_ms = 100
+    req.limit = 1000  # no early quit: every process scans everything
+    return req
+
+
+def _build_corpus(root: str) -> int:
+    """Write 4 deterministic blocks; returns the host-oracle match count."""
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.search.columnar import PageGeometry
+    from tempo_tpu.search.data import search_data_matches
+
+    db = TempoDB(LocalBackend(os.path.join(root, "blocks")),
+                 os.path.join(root, "wal-writer"),
+                 TempoDBConfig(search_geometry=PageGeometry(8, 8),
+                               auto_mesh=False))
+    req = _query()
+    expected = 0
+    for b in range(4):
+        entries = _corpus(32, seed=b)
+        expected += sum(1 for sd in entries if search_data_matches(sd, req))
+        db.write_block_direct(
+            "t1",
+            sorted((sd.trace_id, b"\x00", sd.start_s, sd.end_s)
+                   for sd in entries),
+            search_entries=entries,
+        )
+    return expected
+
+
+def worker_main(process_id: int, num_processes: int, port: int,
+                root: str, devices_per_proc: int) -> None:
+    """One simulated host: join the runtime, mesh over ALL global
+    devices, drive TempoDB.search, dump a result digest."""
+    from tempo_tpu.parallel.multihost import init_distributed
+
+    ok = init_distributed(coordinator=f"127.0.0.1:{port}",
+                          num_processes=num_processes,
+                          process_id=process_id,
+                          cpu_devices_per_host=devices_per_proc)
+    assert ok
+    import jax
+
+    assert jax.process_count() == num_processes, jax.process_count()
+
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.parallel.mesh import make_mesh
+    from tempo_tpu.search.columnar import PageGeometry
+
+    mesh = make_mesh()  # global: spans every process's devices
+    assert mesh.devices.size == num_processes * jax.local_device_count()
+    db = TempoDB(LocalBackend(os.path.join(root, "blocks")),
+                 os.path.join(root, f"wal-{process_id}"),
+                 TempoDBConfig(search_geometry=PageGeometry(8, 8)),
+                 mesh=mesh)
+    db.poll()
+    results = db.search("t1", _query())
+    resp = results.response()
+    digest = {
+        "process_id": process_id,
+        "global_devices": int(mesh.devices.size),
+        "trace_ids": sorted(t.trace_id for t in resp.traces),
+        "inspected_traces": results.metrics.inspected_traces,
+        "inspected_blocks": results.metrics.inspected_blocks,
+    }
+    with open(os.path.join(root, f"digest-{process_id}.json"), "w") as f:
+        json.dump(digest, f)
+
+
+def run(n_processes: int = 2, devices_per_proc: int = 2,
+        timeout_s: float = 300.0) -> dict:
+    """Launcher: build corpus, spawn the workers, assert all digests are
+    identical and match the host oracle."""
+    import socket
+
+    with tempfile.TemporaryDirectory() as root:
+        expected = _build_corpus(root)
+        with socket.socket() as s:  # free port for the coordinator
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "tempo_tpu.parallel.multihost_dryrun",
+                 "--worker", str(pid), str(n_processes), str(port), root,
+                 str(devices_per_proc)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))),
+            )
+            for pid in range(n_processes)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out.decode(errors="replace"))
+        for p, out in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"worker failed rc={p.returncode}:\n{out[-4000:]}")
+        digests = []
+        for pid in range(n_processes):
+            with open(os.path.join(root, f"digest-{pid}.json")) as f:
+                digests.append(json.load(f))
+        base = {k: v for k, v in digests[0].items() if k != "process_id"}
+        for d in digests[1:]:
+            got = {k: v for k, v in d.items() if k != "process_id"}
+            assert got == base, (
+                f"process {d['process_id']} diverged:\n{got}\nvs\n{base}")
+        assert len(base["trace_ids"]) == expected, (
+            len(base["trace_ids"]), expected)
+        assert base["inspected_blocks"] == 4
+        return {
+            "n_processes": n_processes,
+            "global_devices": base["global_devices"],
+            "matches": len(base["trace_ids"]),
+            "expected": expected,
+        }
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker_main(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+                    sys.argv[5], int(sys.argv[6]))
+    else:
+        out = run()
+        print(f"dryrun_multihost: {out['matches']} matches "
+              f"(expected {out['expected']}) identical across "
+              f"{out['n_processes']} processes / {out['global_devices']} "
+              f"global devices — OK")

@@ -93,7 +93,18 @@ def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None,
 
     cat["page_block"] = page_block
     if sharding is not None:
-        dev = {k: jax.device_put(v, sharding) for k, v in cat.items()}
+        if jax.process_count() > 1:
+            # multi-host: each process transfers ONLY its devices' page
+            # slices (the callback runs per addressable shard) — the
+            # per-host staging of the local shard; device_put of a global
+            # array would require every device to be addressable
+            dev = {
+                k: jax.make_array_from_callback(
+                    v.shape, sharding, lambda idx, v=v: v[idx])
+                for k, v in cat.items()
+            }
+        else:
+            dev = {k: jax.device_put(v, sharding) for k, v in cat.items()}
     else:
         dev = {k: jnp.asarray(v) for k, v in cat.items()}
     return BlockBatch(device=dev, page_block=page_block, blocks=blocks,

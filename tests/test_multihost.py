@@ -1,0 +1,43 @@
+"""Multi-host mesh path (VERDICT r2 #2): jax.distributed wiring, per-host
+staging, and the multi-process localhost dryrun."""
+
+import os
+
+import pytest
+
+
+def test_v5e64_config_expressible():
+    """BASELINE config 5's topology loads through the production config
+    parser with env substitution for the per-host process id."""
+    os.environ["TEMPO_PROCESS_ID"] = "7"
+    try:
+        from tempo_tpu.cli.config import load_config
+
+        with open(os.path.join(os.path.dirname(__file__), "..",
+                               "operations", "multihost-v5e-64.yaml")) as f:
+            cfg, runtime = load_config(text=f.read())
+        dist = runtime["distributed"]
+        assert dist["coordinator"] == "tempo-host-0.cluster.local:8476"
+        assert int(dist["num_processes"]) == 16
+        assert int(dist["process_id"]) == 7  # from ${TEMPO_PROCESS_ID}
+        assert cfg.backend["backend"] == "s3"
+    finally:
+        del os.environ["TEMPO_PROCESS_ID"]
+
+
+def test_init_distributed_noop_without_coordinator():
+    from tempo_tpu.parallel.multihost import init_distributed
+
+    assert init_distributed() is False  # single-host: nothing to join
+
+
+def test_multiprocess_dryrun_matches_single_process():
+    """2 OS processes x 2 CPU devices join one distributed runtime and
+    drive the production TempoDB.search over a 4-device global mesh with
+    per-host shard staging; results must be identical on every process
+    and equal to the host oracle (VERDICT r2 #2 'done when')."""
+    from tempo_tpu.parallel.multihost_dryrun import run
+
+    out = run(n_processes=2, devices_per_proc=2)
+    assert out["matches"] == out["expected"] > 0
+    assert out["global_devices"] == 4
