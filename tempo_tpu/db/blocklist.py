@@ -18,6 +18,14 @@ class Blocklist:
         self._lock = threading.Lock()
         self._metas: dict[str, list[BlockMeta]] = {}
         self._compacted: dict[str, list[CompactedBlockMeta]] = {}
+        # bumped on every membership change: readers key derived caches
+        # (job lists, group plans) on (tenant, epoch) so a 10K-block
+        # tenant doesn't rebuild O(blocks) plumbing per query
+        self._epoch = 0
+
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
 
     def tenants(self) -> list[str]:
         with self._lock:
@@ -35,6 +43,7 @@ class Blocklist:
         with self._lock:
             self._metas = {t: list(ms) for t, ms in metas.items()}
             self._compacted = {t: list(cs) for t, cs in compacted.items()}
+            self._epoch += 1
 
     def update(self, tenant: str, add=None, remove=None, add_compacted=None) -> None:
         """Staged update between polls (compaction results)."""
@@ -45,3 +54,4 @@ class Blocklist:
             ms.extend(add or [])
             if add_compacted:
                 self._compacted.setdefault(tenant, []).extend(add_compacted)
+            self._epoch += 1

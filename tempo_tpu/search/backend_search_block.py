@@ -128,7 +128,7 @@ class BackendSearchBlock:
                   if req.tags and native.available()
                   and len(sp.pages.val_dict) >= NATIVE_SCAN_THRESHOLD else None)
         cq = compile_query(sp.pages.key_dict, sp.pages.val_dict, req,
-                           packed_vals=packed)
+                           packed_vals=packed, cache_on=sp.pages)
         if cq is None:  # dictionary prefilter pruned the block
             results.metrics.skipped_blocks += 1
             return results

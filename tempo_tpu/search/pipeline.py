@@ -13,6 +13,9 @@ MatchesBlock header rollup, backend_search_block.go:202-210).
 from __future__ import annotations
 
 import bisect
+import hashlib
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
@@ -120,13 +123,108 @@ def pack_val_dict(val_dict: list) -> tuple:
     return b"".join(blobs), offsets
 
 
+_PRUNED = "pruned"  # cache sentinel: block provably cannot match these tags
+_COMPILE_CACHE_MAX = 128     # distinct tag-sets kept per dictionary
+_COMPILE_CACHE_DICTS = 4096  # distinct dictionaries tracked
+_COMPILE_CACHE: OrderedDict = OrderedDict()
+_compile_cache_lock = threading.Lock()
+
+
+def _dict_fingerprint(cache_on, key_dict: list, val_dict: list) -> bytes:
+    """Content digest of the dictionaries, computed once per container
+    OUTSIDE the cache lock (a 1M-value dictionary hashes for ~100ms — it
+    must not serialize every other thread's compiles). sha256, not
+    hash(): a 64-bit collision would silently serve another dictionary's
+    compiled term ids, an undetectable wrong-results failure."""
+    fp = getattr(cache_on, "_dict_fingerprint", None)
+    if fp is None:
+        h = hashlib.sha256()
+        for part in key_dict:
+            h.update(part.encode("utf-8", "surrogatepass"))
+            h.update(b"\x00")
+        h.update(b"\x01")
+        for part in val_dict:
+            h.update(part.encode("utf-8", "surrogatepass"))
+            h.update(b"\x00")
+        fp = cache_on._dict_fingerprint = h.digest()
+    return fp
+
+
+def _tags_sig(req) -> tuple:
+    """Cache key for the dictionary-probe part of query compilation: only
+    the tag terms (and the exhaustive flag) touch the dictionaries —
+    duration/window/limit are scalar passthroughs."""
+    return (tuple(sorted((k, v) for k, v in req.tags.items()
+                         if k != EXHAUSTIVE_SEARCH_TAG)),
+            is_exhaustive(req))
+
+
 def compile_query(key_dict: list, val_dict: list,
                   req: tempopb.SearchRequest,
-                  packed_vals: tuple | None = None) -> CompiledQuery | None:
+                  packed_vals: tuple | None = None,
+                  cache_on=None) -> CompiledQuery | None:
     """Returns None when the block provably cannot match (key absent from
     the key dictionary, or no dictionary value satisfies a term). Under the
     exhaustive debug flag blocks are never pruned: an unsatisfiable term
-    compiles to an empty value-range set (scanned, matches nothing)."""
+    compiles to an empty value-range set (scanned, matches nothing).
+
+    `cache_on`: a host container object (the block's ColumnarPages) to
+    memoize the dictionary-probe product on, keyed by (dictionary
+    CONTENT, tag terms) — the serving path compiles every query against
+    every block's dictionaries (O(blocks) per query, VERDICT r2 #1);
+    blocks are immutable, so repeated tag-sets hit, and blocks that
+    SHARE dictionaries (the common production shape: the same services/
+    status codes tenant-wide) share one probe. Bounded LRU per
+    dictionary; the fingerprint is computed once per container."""
+    sig = None
+    if cache_on is not None:
+        sig = _tags_sig(req)
+        fp = _dict_fingerprint(cache_on, key_dict, val_dict)
+        with _compile_cache_lock:
+            cache = _COMPILE_CACHE.get(fp)
+            if cache is None:
+                cache = _COMPILE_CACHE[fp] = OrderedDict()
+                _COMPILE_CACHE.move_to_end(fp)
+                while len(_COMPILE_CACHE) > _COMPILE_CACHE_DICTS:
+                    _COMPILE_CACHE.popitem(last=False)
+            hit = cache.get(sig)
+            if hit is not None:
+                cache.move_to_end(sig)
+        if hit is not None:
+            # _PRUNED can only come from a non-exhaustive probe (the
+            # exhaustive flag is part of the signature)
+            return None if isinstance(hit, str) else _from_probe(hit, req)
+
+    out = _probe_tags(key_dict, val_dict, req, packed_vals)
+    if sig is not None:
+        with _compile_cache_lock:
+            cache = _COMPILE_CACHE.get(fp)
+            if cache is not None:
+                cache[sig] = _PRUNED if out is None else out
+                while len(cache) > _COMPILE_CACHE_MAX:
+                    cache.popitem(last=False)
+    return None if out is None else _from_probe(out, req)
+
+
+def _from_probe(probe, req) -> CompiledQuery:
+    term_keys, term_vals, val_ranges = probe
+    return CompiledQuery(
+        term_keys=term_keys,
+        term_vals=term_vals,
+        val_ranges=val_ranges,
+        dur_lo=req.min_duration_ms or 0,
+        dur_hi=req.max_duration_ms or UINT32_MAX,
+        win_start=req.start or 0,
+        win_end=req.end or UINT32_MAX,
+        limit=req.limit or 20,
+    )
+
+
+def _probe_tags(key_dict: list, val_dict: list, req,
+                packed_vals: tuple | None):
+    """The expensive, tags-only part of compilation: binary-search keys,
+    substring-scan the value dictionary, fold ids to range sets. Returns
+    (term_keys, term_vals, val_ranges) or None (pruned)."""
     exhaustive = is_exhaustive(req)
     term_key_ids = []
     term_val_sets = []
@@ -169,13 +267,4 @@ def compile_query(key_dict: list, val_dict: list,
         term_vals = np.zeros((0, 1), dtype=np.int32)
         val_ranges = np.zeros((0, 1, 2), dtype=np.int32)
 
-    return CompiledQuery(
-        term_keys=term_keys,
-        term_vals=term_vals,
-        val_ranges=val_ranges,
-        dur_lo=req.min_duration_ms or 0,
-        dur_hi=req.max_duration_ms or UINT32_MAX,
-        win_start=req.start or 0,
-        win_end=req.end or UINT32_MAX,
-        limit=req.limit or 20,
-    )
+    return term_keys, term_vals, val_ranges

@@ -289,7 +289,7 @@ memberlist:
             except Exception:
                 return False
 
-        wait_for(found, timeout_s=60, interval_s=0.5,
+        wait_for(found, timeout_s=120, interval_s=0.5,
                  what="trace via frontend")
 
         # flush + backend search
@@ -307,7 +307,9 @@ memberlist:
             except Exception:
                 return False
 
-        wait_for(searched, timeout_s=60, interval_s=0.5,
+        # generous: four subprocesses cold-compile their JAX kernels
+        # while the rest of the suite loads the machine
+        wait_for(searched, timeout_s=180, interval_s=0.5,
                  what="backend search via frontend")
     finally:
         for p in procs:

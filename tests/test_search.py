@@ -323,3 +323,48 @@ def test_multiblock_per_block_dictionaries_differ():
     assert count == 2
     got = {m.trace_id for m in eng.results(batch, mq, scores, idx)}
     assert got == {(b"\x01" * 16).hex(), (b"\x02" * 16).hex()}
+
+
+def test_compile_cache_skips_dictionary_probe():
+    """Per-(block, tag-set) compile cache (VERDICT r2 #1): the second
+    compilation of the same tags against the same block skips the
+    dictionary probe entirely; different scalars (window/duration/limit)
+    reuse the cached probe; different tags or the prune result are
+    cached separately."""
+    from unittest import mock
+
+    from tempo_tpu.search import pipeline
+    from tempo_tpu.search.columnar import ColumnarPages, PageGeometry
+    pages = ColumnarPages.build(_corpus(50), PageGeometry(16, 8))
+    req = _mk_req({"service.name": "front"})
+    req.limit = 5
+
+    with mock.patch.object(pipeline, "substring_value_ids",
+                           wraps=pipeline.substring_value_ids) as probe:
+        cq1 = pipeline.compile_query(pages.key_dict, pages.val_dict, req,
+                                     cache_on=pages)
+        n_cold = probe.call_count
+        assert n_cold >= 1
+        # same tags, different scalars -> cache hit, fresh scalars
+        req2 = _mk_req({"service.name": "front"})
+        req2.limit = 99
+        req2.min_duration_ms = 123
+        cq2 = pipeline.compile_query(pages.key_dict, pages.val_dict, req2,
+                                     cache_on=pages)
+        assert probe.call_count == n_cold  # no new probes
+        assert cq2.limit == 99 and cq2.dur_lo == 123
+        assert (cq1.term_keys == cq2.term_keys).all()
+        assert (cq1.val_ranges == cq2.val_ranges).all()
+
+        # pruned result cached too
+        miss = _mk_req({"no.such.key": "x"})
+        assert pipeline.compile_query(pages.key_dict, pages.val_dict, miss,
+                                      cache_on=pages) is None
+        n_after_miss = probe.call_count
+        assert pipeline.compile_query(pages.key_dict, pages.val_dict, miss,
+                                      cache_on=pages) is None
+        assert probe.call_count == n_after_miss
+
+    # uncached path still works (no cache_on)
+    cq3 = pipeline.compile_query(pages.key_dict, pages.val_dict, req)
+    assert (cq3.term_keys == cq1.term_keys).all()
