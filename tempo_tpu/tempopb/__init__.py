@@ -26,6 +26,8 @@ SearchTagsResponse = tempo_pb2.SearchTagsResponse
 SearchTagValuesRequest = tempo_pb2.SearchTagValuesRequest
 SearchTagValuesResponse = tempo_pb2.SearchTagValuesResponse
 PartialsResponse = tempo_pb2.PartialsResponse
+ProcessJob = tempo_pb2.ProcessJob
+ProcessResult = tempo_pb2.ProcessResult
 
 ResourceSpans = trace_pb2.ResourceSpans
 ScopeSpans = trace_pb2.ScopeSpans
@@ -42,6 +44,7 @@ __all__ = [
     "SearchResponse", "TraceSearchMetadata",
     "SearchMetrics", "SearchTagsRequest", "SearchTagsResponse",
     "SearchTagValuesRequest", "SearchTagValuesResponse", "PartialsResponse",
+    "ProcessJob", "ProcessResult",
     "ResourceSpans", "ScopeSpans", "Span", "Status", "Resource",
     "KeyValue", "AnyValue", "trace_pb2", "tempo_pb2",
 ]
