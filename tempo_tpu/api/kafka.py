@@ -290,14 +290,29 @@ def decode_record_batches(data: bytes, expect_base: int | None = None
                 # corrupt body, so either could itself be the flipped
                 # bits. Trust the delta only when it is SELF-CONSISTENT
                 # (delta == count-1, the invariant producers write) and
-                # within byte bounds; otherwise skip a single offset —
-                # over-skipping would silently drop valid batches.
+                # within OFFSET-domain bounds; otherwise skip a single
+                # offset — over-skipping would silently drop valid
+                # batches. The bound is how many records this batch
+                # could plausibly hold: an uncompressed record encodes
+                # to >= 7 bytes, so batchLen/7 records. (batchLen itself
+                # is a BYTE count — comparing offsets against it, as a
+                # naive guard would, is far too permissive since
+                # bytes >> records.) Compression can pack tighter than
+                # 7 B/record, but a too-TIGHT bound only degrades to the
+                # safe single-offset skip; a too-loose one loses data.
                 # the header prefix (baseOffset, batchLen) is NOT CRC'd
                 # either: anchor it to the offset the caller requested (a
                 # broker answers with the batch containing that offset)
                 # before trusting any skip math derived from it
+                # 49 = the non-record bytes batchLen covers (leaderEpoch
+                # i32 + magic + crc u32 + the 40-byte CRC'd header before
+                # the records array) — including them would loosen the
+                # bound by up to 7 offsets, enough for a self-consistent
+                # corrupt delta to land inside the NEXT valid batch
+                max_records = max(1, (batch_len - 49) // 7)
                 anchored = (expect_base is None
-                            or base_offset <= expect_base < base_offset + batch_len)
+                            or base_offset <= expect_base
+                            < base_offset + max_records)
                 next_off = None
                 if anchored:
                     next_off = base_offset + 1
@@ -308,7 +323,7 @@ def decode_record_batches(data: bytes, expect_base: int | None = None
                         rr.i64(); rr.i64(); rr.i64()  # ts, ts, producerId
                         rr.i16(); rr.i32()  # producerEpoch, baseSequence
                         count = rr.i32()
-                        if 0 <= delta < batch_len and delta == count - 1:
+                        if 0 <= delta < max_records and delta == count - 1:
                             next_off = base_offset + delta + 1
                     except EOFError:
                         pass

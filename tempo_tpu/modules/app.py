@@ -17,13 +17,17 @@ from dataclasses import dataclass, field
 
 from tempo_tpu.backend import open_backend
 from tempo_tpu.db import TempoDB, TempoDBConfig
+from tempo_tpu.observability.log import get_logger
 from .distributor import Distributor
 from .frontend import QueryFrontend, FrontendConfig
 from .generator import MetricsGenerator
-from .ingester import Ingester
+from .ingester import FlushIncompleteError, Ingester
 from .overrides import Overrides, Limits
 from .querier import Querier
 from .ring import Ring
+
+
+log = get_logger("tempo_tpu.app")
 
 
 @dataclass
@@ -199,7 +203,12 @@ class App:
             if tracing.get_tracer() is self.tracer:
                 tracing.set_tracer(None)
         for ing in self.ingesters.values():
-            ing.flush_all()
+            try:
+                ing.flush_all()
+            except FlushIncompleteError as e:
+                # keep draining the rest of the process — but the WAL on
+                # disk still holds data; a scale-down must not remove it
+                log.error("shutdown flush incomplete: %s", e)
         if self.remote_write is not None:
             self.remote_write.stop(final_ship=True)
         self.poll_tick()

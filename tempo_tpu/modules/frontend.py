@@ -36,10 +36,11 @@ class FrontendConfig:
     max_concurrent_jobs: int = 50    # reference: bounded fan-out 50
     retries: int = 2                 # reference retry ware
     tolerate_failed_blocks: int = 0
-    # per-tenant cap on concurrently-outstanding REQUESTS (not
-    # sub-requests); beyond it the whole request 429s (reference
-    # max_outstanding_per_tenant, v1/frontend.go:46-48)
-    max_outstanding_per_tenant: int = 2000
+    # per-tenant cap on concurrently-outstanding REQUESTS — deliberately
+    # NOT the reference's sub-request-counting semantics (its 2000,
+    # v1/frontend.go:46-48, bounds queued items); whole requests need a
+    # far lower cap to mean anything as admission control
+    max_outstanding_per_tenant: int = 64
     # complementary memory bound on QUEUED sub-requests per tenant
     max_queued_per_tenant: int = 100_000
     # page-range job sizing (reference searchsharding.go:26-27

@@ -36,6 +36,18 @@ class LimitError(Exception):
     pass
 
 
+class FlushIncompleteError(Exception):
+    """flush_all could not get every completing block to the backend.
+    Carries what DID flush so shutdown callers can log it; the local WAL
+    still holds the rest and must not be deleted."""
+
+    def __init__(self, left_behind: int, completed: list):
+        super().__init__(
+            f"{left_behind} block(s) could not be flushed to the backend")
+        self.left_behind = left_behind
+        self.completed = completed
+
+
 @dataclass
 class _LiveTrace:
     segments: list = field(default_factory=list)
@@ -413,26 +425,64 @@ class Ingester:
                 t.join()
         return completed
 
-    def flush_all(self) -> list:
+    def flush_all(self, settle_timeout_s: float = 60.0) -> list:
         """Graceful shutdown / scale-down: force everything to the backend
         (reference /shutdown handler flush.go:91-115). Loops until no
-        completing blocks remain or a pass makes no progress (a racing
-        periodic sweep may consume our force-enqueued ops with its own
-        non-force semantics — the next pass re-enqueues them; a
-        persistently failing backend must not hang shutdown forever)."""
+        completing blocks remain. A pass that completes nothing is only
+        counted as stalled after all in-flight completions have settled —
+        a racing periodic sweep's drain thread may hold the op for a
+        streaming completion that takes minutes, during which our own
+        passes are no-ops by design (ExclusiveQueue dedupe). Two settled
+        no-progress passes mean the backend is genuinely down; then we
+        raise FlushIncompleteError so the caller cannot mistake a partial
+        flush for success and delete the node's WAL disk.
+
+        settle_timeout_s bounds the wait for RACING in-flight completions
+        (a periodic sweep's drain thread holding the op) so they cannot
+        pin shutdown indefinitely; a false stall only raises — the WAL
+        stays on disk and the racing completion, if any, still finishes.
+        It does NOT bound the backend writes our own passes issue: those
+        rely on the backend transport's request timeouts (a local/memory
+        backend cannot blackhole; cloud backends go through the
+        timeout-carrying instrumented transport)."""
         completed: list = []
         stalled = 0
         while stalled < 2:
             before = len(completed)
             completed += self.sweep(force=True)
+            if not self._blocks_left():
+                return completed
+            if len(completed) == before:
+                self._wait_inflight_settled(settle_timeout_s)
+                if not self._blocks_left():
+                    return completed
+                stalled += 1
+            else:
+                stalled = 0
+        # raise only — callers own the logging (double error lines per
+        # ingester otherwise)
+        raise FlushIncompleteError(left_behind=self._blocks_left(),
+                                   completed=completed)
+
+    def _blocks_left(self) -> int:
+        with self._lock:
+            insts = list(self._instances.values())
+        return sum(len(i.completing) for i in insts)
+
+    def _wait_inflight_settled(self, timeout_s: float) -> None:
+        """Block until no completion op is executing anywhere — neither a
+        block marked in_flight nor a claimed-but-unreleased flush-op key
+        (the window between dequeue() and complete_one picking the
+        block)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
             with self._lock:
                 insts = list(self._instances.values())
-            if not any(i.completing for i in insts):
-                break
-            # one stalled pass may just mean a racer consumed our ops —
-            # retry; two in a row means the backend is down, give up
-            stalled = stalled + 1 if len(completed) == before else 0
-        return completed
+            busy = self.flush_ops.in_flight() > 0 or any(
+                c.in_flight for i in insts for c in i.completing)
+            if not busy:
+                return
+            time.sleep(0.05)
 
     # ---- replay (reference replayWal ingester.go:327-416) ----
 

@@ -32,7 +32,7 @@ from tempo_tpu.observability import get_logger
 from .app import AppConfig
 from .distributor import Distributor
 from .frontend import QueryFrontend
-from .ingester import Ingester
+from .ingester import FlushIncompleteError, Ingester
 from .membership import Memberlist
 from .overrides import Overrides
 from .querier import Querier
@@ -276,7 +276,10 @@ class ModuleProcess:
             if tracing.get_tracer() is self.tracer:
                 tracing.set_tracer(None)
         if self.ingester is not None:
-            self.ingester.flush_all()
+            try:
+                self.ingester.flush_all()
+            except FlushIncompleteError as e:
+                self.log.error("shutdown flush incomplete: %s", e)
         self.ml.leave()
         if self.grpc_server is not None:
             self.grpc_server.stop(grace=1)

@@ -25,12 +25,18 @@ class RequestQueue:
     a request is available or the queue stops.
 
     The outstanding cap counts top-level REQUESTS (begin_request /
-    end_request brackets), not queued sub-requests — the reference v1
-    queue does the same (v1/frontend.go:46-48); a cap on sub-requests
-    would make any single search whose own fan-out exceeds the cap
-    deterministically 429 itself even on an idle system."""
+    end_request brackets). This is a DELIBERATE divergence from the
+    reference v1 queue, whose MaxOutstandingPerTenant bounds queued
+    queue ITEMS — each sharded sub-request individually, which is why
+    Tempo's default is as high as 2000 (v1/frontend.go:46-48). Counting
+    sub-requests here would make any single search whose own fan-out
+    exceeds the cap deterministically 429 itself even on an idle
+    system; counting whole requests keeps admission meaningful, so the
+    default is 64 concurrent requests per tenant (each fanning out to
+    hundreds of sub-requests), with max_queued_per_tenant as the
+    complementary memory bound on total queued sub-requests."""
 
-    def __init__(self, max_outstanding_per_tenant: int = 2000,
+    def __init__(self, max_outstanding_per_tenant: int = 64,
                  max_queued_per_tenant: int = 100_000):
         self.max_outstanding = max_outstanding_per_tenant
         # memory backpressure, complementary to the request cap: many
@@ -112,7 +118,7 @@ class QueueWorkerPool:
     rejected with TooManyRequests (HTTP 429)."""
 
     def __init__(self, workers: int = 50,
-                 max_outstanding_per_tenant: int = 2000,
+                 max_outstanding_per_tenant: int = 64,
                  max_queued_per_tenant: int = 100_000):
         self.queue = RequestQueue(max_outstanding_per_tenant,
                                   max_queued_per_tenant)
@@ -234,6 +240,13 @@ class ExclusiveQueue:
         backoff)."""
         with self._lock:
             self._keys.discard(key)
+
+    def in_flight(self) -> int:
+        """Keys claimed by a dequeue() but not yet released via done() —
+        ops some drain thread is executing right now. Shutdown waits on
+        this before concluding a flush pass made no progress."""
+        with self._lock:
+            return len(self._keys) - len(self._heap)
 
     def __len__(self) -> int:
         with self._lock:

@@ -337,7 +337,13 @@ class BlockBatcher:
                             _, old = cached.query_cache.popitem(last=False)
                             dpb = old.get("device_params_bytes", 0)
                             cached.nbytes -= dpb
-                            self._cache_total -= dpb
+                            # the shared budget only tracks batches still
+                            # resident: a concurrent eviction already
+                            # removed cached.nbytes (dp bytes included)
+                            # wholesale, so adjusting again would
+                            # double-subtract and drift the budget
+                            if self._cache.get(gkey) is cached:
+                                self._cache_total -= dpb
                 if pre["all_skip"]:
                     results.metrics.skipped_blocks += pre["skipped"]
                     continue
@@ -366,11 +372,16 @@ class BlockBatcher:
                         pre["device_params"] = new_dp
                         pre["device_params_bytes"] = dpb
                         cached.nbytes += dpb
-                        self._cache_total += dpb
-                        while (self._cache_total > self.cache_bytes
-                               and len(self._cache) > 1):
-                            _, old = self._cache.popitem(last=False)
-                            self._cache_total -= old.nbytes
+                        # same residency guard as the memo eviction above:
+                        # dp bytes charged to an already-evicted batch
+                        # would inflate the budget with memory the next
+                        # eviction can never reclaim
+                        if self._cache.get(gkey) is cached:
+                            self._cache_total += dpb
+                            while (self._cache_total > self.cache_bytes
+                                   and len(self._cache) > 1):
+                                _, old = self._cache.popitem(last=False)
+                                self._cache_total -= old.nbytes
                 start_fetch(fut)  # D2H begins now, overlapping next groups
                 dispatches += 1
                 inflight.append((cached, mq, pre, fut))

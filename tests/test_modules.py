@@ -827,3 +827,63 @@ def test_wal_find_tolerates_concurrent_clear(tmp_path):
     blk = inst.head
     blk.clear()
     assert blk.find(pad_trace_id(tid)) is None  # no AttributeError
+
+
+def test_flush_all_raises_when_backend_down(tmp_path):
+    """A shutdown caller must be able to distinguish 'all flushed' from
+    'gave up': when the backend stays down, flush_all raises
+    FlushIncompleteError (with the successfully-flushed list attached)
+    instead of returning as if the WAL were safe to delete (advisor r3)."""
+    from tempo_tpu.modules.ingester import FlushIncompleteError
+
+    app = _app(tmp_path)
+    ing = app.ingesters["ingester-0"]
+    inst = ing.instance("t1")
+    _push_traces(app, "t1", 3)
+    inst.cut_complete_traces(force=True)
+    inst.cut_block_if_ready(force=True)
+
+    app.backend.write = lambda *a, **k: (_ for _ in ()).throw(OSError("down"))
+    with pytest.raises(FlushIncompleteError) as ei:
+        ing.flush_all(settle_timeout_s=2.0)
+    assert ei.value.left_behind == 1
+    assert ei.value.completed == []
+    assert len(inst.completing) == 1  # block still in the local WAL
+
+
+def test_flush_all_waits_for_inflight_completion(tmp_path):
+    """flush_all must not conclude 'stalled' while a racing periodic
+    sweep's drain thread holds the completion op — a streaming completion
+    can take a long time, during which flush_all's own passes are no-ops
+    by ExclusiveQueue dedupe (advisor r3 medium)."""
+    import threading
+
+    app = _app(tmp_path)
+    ing = app.ingesters["ingester-0"]
+    inst = ing.instance("t1")
+    _push_traces(app, "t1", 3)
+    inst.cut_complete_traces(force=True)
+    inst.cut_block_if_ready(force=True)
+
+    db = ing.db
+    real_complete = db.complete_block
+    started, release = threading.Event(), threading.Event()
+
+    def slow_complete(blk, entries):
+        started.set()
+        assert release.wait(10)
+        return real_complete(blk, entries)
+
+    db.complete_block = slow_complete
+    racer = threading.Thread(
+        target=lambda: ing.sweep(force=False, max_idle_s=0))
+    racer.start()
+    assert started.wait(5)
+    # release the slow completion shortly after flush_all starts waiting
+    threading.Timer(0.3, release.set).start()
+    done = ing.flush_all(settle_timeout_s=30.0)
+    racer.join()
+    db.complete_block = real_complete
+    # the racer's completion counts as flushed state: nothing left behind
+    assert not inst.completing
+    assert inst.recent  # completed exactly once, queryable via recent
