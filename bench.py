@@ -84,6 +84,13 @@ def build_corpus(n_entries: int, E: int = 1024, C: int = 4, seed: int = 7):
     return pages
 
 
+def _dispatch_count() -> float:
+    """Total device kernel dispatches so far (batched serving path)."""
+    from tempo_tpu.observability import metrics as obs
+
+    return obs.scan_dispatches.value(mode="batched")
+
+
 def cpu_scan(pages, cq):
     """Vectorized numpy reference scan — the CPU baseline. Same dense
     layout, same bitmap membership test as the device kernel."""
@@ -364,9 +371,10 @@ def bench_scale(n_blocks, entries_per_block, iters):
         app = App(AppConfig(
             backend={"backend": "local", "local": {"path": td + "/blocks"}},
             wal_dir=td + "/wal-app",
-            # one in-process querier serves all jobs: batch bigger than
-            # the multi-querier default so 10K blocks -> ~40 requests
-            frontend=FrontendConfig(batch_jobs_per_request=256)))
+            # default auto batch sizing: one batched SearchBlocksRequest
+            # per querier -> one kernel dispatch + one device sync per
+            # HTTP request (VERDICT r3 #1)
+            frontend=FrontendConfig()))
         app.reader_db = db  # share the staged/blocklist state
         for q in app.queriers:
             q.db = db
@@ -378,6 +386,7 @@ def bench_scale(n_blocks, entries_per_block, iters):
                    {"tags": "service.name=svc-001 http.status_code=500",
                     "limit": "20"}, {"X-Scope-OrgID": "bench"})
         http_lat = []
+        d0 = _dispatch_count()
         for i in range(n):
             t0 = time.perf_counter()
             code, doc = api.handle(
@@ -387,6 +396,7 @@ def bench_scale(n_blocks, entries_per_block, iters):
                 {"X-Scope-OrgID": "bench"})
             http_lat.append(time.perf_counter() - t0)
             assert code == 200, (code, doc)
+        http_dispatches_per_req = (_dispatch_count() - d0) / n
         http_lat.sort()
         http_p50 = http_lat[len(http_lat) // 2] * 1e3
         http_p95 = http_lat[min(len(http_lat) - 1,
@@ -408,6 +418,9 @@ def bench_scale(n_blocks, entries_per_block, iters):
             "distinct_dicts": 16,
             "http_path_p50_ms": round(http_p50, 1),
             "http_path_p95_ms": round(http_p95, 1),
+            # VERDICT r3 #1 "done when": ~1 kernel dispatch per HTTP
+            # request, residual latency = the relay sync floor
+            "http_dispatches_per_request": round(http_dispatches_per_req, 2),
         }
 
 

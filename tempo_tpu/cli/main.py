@@ -93,7 +93,12 @@ def main(argv=None) -> int:
         http_server.shutdown()
         if jaeger_agent is not None:
             jaeger_agent.close()
-        app.shutdown()  # flush everything (reference /shutdown drain)
+        try:
+            app.shutdown()  # flush everything (reference /shutdown drain)
+        except Exception as e:  # noqa: BLE001 — flush incomplete
+            log.error("shutdown finished with unflushed WAL data: %s — "
+                      "do NOT delete this node's WAL directory", e)
+            return 1
         log.info("shutdown complete")
         return 0
 
@@ -126,7 +131,12 @@ def main(argv=None) -> int:
     http_server.shutdown()
     if jaeger_agent is not None:
         jaeger_agent.close()
-    proc.shutdown()
+    try:
+        proc.shutdown()
+    except Exception as e:  # noqa: BLE001 — flush incomplete
+        log.error("shutdown finished with unflushed WAL data: %s — "
+                  "do NOT delete this node's WAL directory", e)
+        return 1
     log.info("shutdown complete")
     return 0
 

@@ -202,6 +202,7 @@ class App:
             self.tracer.shutdown()
             if tracing.get_tracer() is self.tracer:
                 tracing.set_tracer(None)
+        flush_left = 0
         for ing in self.ingesters.values():
             try:
                 ing.flush_all()
@@ -209,9 +210,15 @@ class App:
                 # keep draining the rest of the process — but the WAL on
                 # disk still holds data; a scale-down must not remove it
                 log.error("shutdown flush incomplete: %s", e)
+                flush_left += e.left_behind
         if self.remote_write is not None:
             self.remote_write.stop(final_ship=True)
         self.poll_tick()
+        if flush_left:
+            # re-raised AFTER the full drain so an orchestrator driving
+            # shutdown() programmatically cannot mistake a partial flush
+            # for success and delete the node's WAL volume
+            raise FlushIncompleteError(left_behind=flush_left, completed=[])
 
     def ready(self) -> bool:
         return self.ring.healthy_count() >= self.cfg.replication_factor

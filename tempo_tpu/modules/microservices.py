@@ -275,14 +275,20 @@ class ModuleProcess:
             self.tracer.shutdown()
             if tracing.get_tracer() is self.tracer:
                 tracing.set_tracer(None)
+        flush_err = None
         if self.ingester is not None:
             try:
                 self.ingester.flush_all()
             except FlushIncompleteError as e:
                 self.log.error("shutdown flush incomplete: %s", e)
+                flush_err = e
         self.ml.leave()
         if self.grpc_server is not None:
             self.grpc_server.stop(grace=1)
+        if flush_err is not None:
+            # after the full drain: the caller must see that WAL data
+            # remains on disk (do not tear down the volume)
+            raise flush_err
 
     # ---- maintenance ----
 

@@ -128,6 +128,8 @@ class Querier:
                 one.encoding = j.encoding
                 one.version = j.version
                 one.data_encoding = j.data_encoding
+                one.start_time = j.start_time
+                one.end_time = j.end_time
                 results.merge_response(self.search_block(one))
                 if results.complete:
                     break

@@ -218,18 +218,18 @@ class BlockBatcher:
 
     def search(self, jobs: list[ScanJob], req,
                results: SearchResults | None = None,
-               plan_key=None) -> SearchResults:
+               plan_key=None, groups: list | None = None) -> SearchResults:
         """Run the request over all jobs: group → stage → compile →
         dispatch (pipelined, early-quitting) → merge. `plan_key` (e.g.
         (tenant, blocklist-epoch)) memoizes the grouping — the plan is a
         pure function of the job list, and re-sorting 10K jobs per query
-        is measurable host overhead."""
+        is measurable host overhead. Callers that already hold the plan
+        (tempodb's protocol-path job cache) pass `groups` directly."""
         from .pipeline import is_exhaustive
 
         results = results or SearchResults.for_request(req)
         exhaustive = is_exhaustive(req)
-        groups = None
-        if plan_key is not None:
+        if groups is None and plan_key is not None:
             # one entry per plan_key[0] (tenant): a stale generation is
             # never hittable again (the epoch only moves forward), so
             # keeping it would just pin 10K dead ScanJobs

@@ -1,0 +1,45 @@
+"""Small bounded LRU map shared by the serving-path memo caches.
+
+The same "OrderedDict + lock + cap" idiom kept getting re-written inline
+(batcher plan/prune memos, frontend batch shards, tempodb job lists) with
+subtly divergent eviction/locking each time; this is the one shared
+implementation. Values are opaque; callers needing compound invalidation
+(epoch checks, promotion) do it on the value they get back.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+class BoundedCache:
+    """Thread-safe LRU: `get` refreshes recency, `put` evicts the least
+    recently used entry past `cap`."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key, default=None):
+        with self._lock:
+            v = self._d.get(key, default)
+            if key in self._d:
+                self._d.move_to_end(key)
+            return v
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.cap:
+                self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def values(self):
+        with self._lock:
+            return list(self._d.values())

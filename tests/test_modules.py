@@ -887,3 +887,227 @@ def test_flush_all_waits_for_inflight_completion(tmp_path):
     # the racer's completion counts as flushed state: nothing left behind
     assert not inst.completing
     assert inst.recent  # completed exactly once, queryable via recent
+
+
+def test_frontend_batch_cache_sees_new_blocks(tmp_path):
+    """The frontend's memoized job sharding must not serve a stale plan
+    after the blocklist changes: a block added (and polled) after the
+    first query must be searched by the next one (r4: _search_batches is
+    cached per blocklist epoch)."""
+    from tempo_tpu.model.codec import codec_for
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.search.data import extract_search_data
+
+    db, all_sds = _frontend_db(tmp_path, n_blocks=2, per_block=50)
+    q = Querier(db, Ring(), {})
+    fe = QueryFrontend([q], FrontendConfig())
+    req = _mk_req({})
+    req.limit = 10_000
+    r1 = fe.search("t1", req)
+    assert r1.metrics.inspected_traces == 100
+
+    codec = codec_for("v2")
+    objs, sds = [], []
+    for i in range(30):
+        tid = random_trace_id()
+        tr = make_trace(tid, seed=9000 + i)
+        sd = extract_search_data(tid, tr)
+        objs.append((tid, codec.marshal(tr, sd.start_s, sd.end_s),
+                     sd.start_s, sd.end_s))
+        sds.append(sd)
+    db.write_block_direct("t1", sorted(objs), search_entries=sds)
+
+    r2 = fe.search("t1", req)
+    assert r2.metrics.inspected_traces == 130  # new block included
+    new_ids = {sd.trace_id.hex() for sd in sds}
+    assert new_ids <= {t.trace_id for t in r2.traces}
+
+
+def test_frontend_auto_batch_one_request_per_querier(tmp_path):
+    """Default (auto) batch sizing spreads the job list over the querier
+    pool — with one querier a whole-tenant search is ONE batched
+    SearchBlocksRequest, not a fixed-size fan-out (r4: one request ~ one
+    device sync on TPU)."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+
+    db, _ = _frontend_db(tmp_path, n_blocks=4, per_block=40)
+    q = Querier(db, Ring(), {})
+    calls = []
+    real = q.search_blocks
+    q.search_blocks = lambda breq: (calls.append(len(breq.jobs)),
+                                    real(breq))[1]
+    fe = QueryFrontend([q], FrontendConfig())
+    req = _mk_req({})
+    req.limit = 10_000
+    fe.search("t1", req)
+    assert len(calls) == 1  # one request carried every job
+    assert calls[0] == len(fe._block_jobs(db.blocklist.metas("t1")))
+
+
+def test_search_blocks_jobs_cache_consistent(tmp_path):
+    """Repeated identical SearchBlocksRequests hit the memoized job list
+    and return identical results; a blocklist epoch bump invalidates the
+    memo (r4: search_blocks O(blocks) host work must not repeat per
+    query)."""
+    from tempo_tpu import tempopb
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+
+    db, all_sds = _frontend_db(tmp_path, n_blocks=3, per_block=40)
+    metas = db.blocklist.metas("t1")
+    breq = tempopb.SearchBlocksRequest()
+    breq.tenant_id = "t1"
+    req = _mk_req({})
+    req.limit = 10_000
+    breq.search_req.CopyFrom(req)
+    for m in metas:
+        j = breq.jobs.add()
+        j.block_id = m.block_id
+        j.encoding = m.encoding
+        j.version = m.version
+        j.data_encoding = m.data_encoding
+    r1 = db.search_blocks(breq).response()
+    r2 = db.search_blocks(breq).response()
+    assert ({t.trace_id for t in r1.traces}
+            == {t.trace_id for t in r2.traces})
+    assert r1.metrics.inspected_traces == r2.metrics.inspected_traces == 120
+    assert len(db._breq_jobs_cache) == 1
+    epoch0, jobs0 = db._breq_jobs_cache.values()[0][:2]
+    assert len(jobs0) == 3
+    # epoch bump -> rebuild on next request
+    db.blocklist.update("t1", add=[])
+    db.search_blocks(breq)
+    epoch1 = db._breq_jobs_cache.values()[0][0]
+    assert epoch1 > epoch0
+
+
+def test_search_blocks_cache_promotes_late_container(tmp_path):
+    """A transient DoesNotExist (read-after-write lag: meta visible
+    before the search container) must not pin a block to the slow proto
+    fallback for the whole epoch — the cached entry re-probes and
+    promotes on the next request (code-review r4)."""
+    from tempo_tpu import tempopb
+    from tempo_tpu.backend.raw import DoesNotExist
+    from tempo_tpu.backend.types import NAME_SEARCH
+
+    db, all_sds = _frontend_db(tmp_path, n_blocks=1, per_block=40)
+    m = db.blocklist.metas("t1")[0]
+
+    # hide the container: first request classifies the block as fallback
+    real_read = db.backend.read
+    def read_no_container(tenant, bid, name, **kw):
+        if name == NAME_SEARCH:
+            raise DoesNotExist(f"{bid}/{name}")
+        return real_read(tenant, bid, name, **kw)
+    # the header read decides _scan_job; hide it too
+    from tempo_tpu.backend.types import NAME_SEARCH_HEADER
+    def read_hidden(tenant, bid, name, **kw):
+        if name in (NAME_SEARCH, NAME_SEARCH_HEADER):
+            raise DoesNotExist(f"{bid}/{name}")
+        return real_read(tenant, bid, name, **kw)
+    db.backend.read = read_hidden
+
+    breq = tempopb.SearchBlocksRequest()
+    breq.tenant_id = "t1"
+    req = _mk_req({})
+    req.limit = 10_000
+    breq.search_req.CopyFrom(req)
+    j = breq.jobs.add()
+    j.block_id = m.block_id
+    j.encoding = m.encoding
+    j.version = m.version
+    j.data_encoding = m.data_encoding
+
+    r1 = db.search_blocks(breq)
+    assert db._breq_jobs_cache.values()[0][2]  # cached as fallback
+    # container appears; the SAME cached request must promote it
+    db.backend.read = real_read
+    r2 = db.search_blocks(breq)
+    entry = db._breq_jobs_cache.values()[0]
+    assert not entry[2] and len(entry[1]) == 1  # promoted to a ScanJob
+    assert r2.metrics.inspected_traces == 40
+
+
+def test_search_blocks_cache_keyed_by_encoding(tmp_path):
+    """Requests differing only in job encoding/version must not alias to
+    one cached job list (code-review r4: the key carries every field
+    that shapes the ScanJob)."""
+    from tempo_tpu import tempopb
+
+    db, _ = _frontend_db(tmp_path, n_blocks=1, per_block=20)
+    m = db.blocklist.metas("t1")[0]
+
+    def mk(encoding):
+        breq = tempopb.SearchBlocksRequest()
+        breq.tenant_id = "t1"
+        req = _mk_req({})
+        req.limit = 100
+        breq.search_req.CopyFrom(req)
+        j = breq.jobs.add()
+        j.block_id = m.block_id
+        j.encoding = encoding
+        j.version = m.version
+        j.data_encoding = m.data_encoding
+        return breq
+
+    db.search_blocks(mk(m.encoding))
+    db.search_blocks(mk("gzip"))
+    assert len(db._breq_jobs_cache) == 2  # distinct cache entries
+
+
+def test_shutdown_surfaces_incomplete_flush(tmp_path):
+    """App.shutdown must not return success while WAL data remains: the
+    FlushIncompleteError re-raises AFTER the full drain so an
+    orchestrator cannot tear down the WAL volume on a clean-looking
+    return (code-review r4)."""
+    from tempo_tpu.modules.ingester import FlushIncompleteError
+
+    app = _app(tmp_path)
+    inst = app.ingesters["ingester-0"].instance("t1")
+    _push_traces(app, "t1", 3)
+    inst.cut_complete_traces(force=True)
+    inst.cut_block_if_ready(force=True)
+    app.backend.write = lambda *a, **k: (_ for _ in ()).throw(OSError("down"))
+    for ing in app.ingesters.values():
+        ing.flush_all = lambda _f=ing.flush_all: _f(settle_timeout_s=1.0)
+    with pytest.raises(FlushIncompleteError):
+        app.shutdown()
+    assert len(inst.completing) == 1
+
+
+def test_windowed_search_skips_containerless_block(tmp_path):
+    """A container-less block entirely outside the request window must be
+    window-pruned via the meta times carried in the job — not fully
+    proto-scanned — now that the frontend ships all blocks and defers
+    window pruning to the executor (code-review r4)."""
+    from tempo_tpu.model.codec import codec_for
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.observability import metrics as obs
+
+    db, all_sds = _frontend_db(tmp_path, n_blocks=1, per_block=20)
+    in_window = db.blocklist.metas("t1")[0]
+
+    # a second block WITHOUT search entries (no container -> proto
+    # fallback path), far outside the window
+    codec = codec_for("v2")
+    objs = []
+    for i in range(10):
+        tid = random_trace_id()
+        tr = make_trace(tid, seed=7000 + i)
+        objs.append((tid, codec.marshal(tr, 100, 200), 100, 200))
+    db.write_block_direct("t1", sorted(objs), search_entries=None)
+
+    q = Querier(db, Ring(), {})
+    fe = QueryFrontend([q], FrontendConfig())
+    req = _mk_req({})
+    req.limit = 10_000
+    req.start = in_window.start_time
+    req.end = in_window.end_time
+    f0 = obs.fallback_scans.value(tenant="t1")
+    r = fe.search("t1", req)
+    assert obs.fallback_scans.value(tenant="t1") == f0  # no proto scan
+    assert r.metrics.inspected_traces == 20  # container block only
+    assert r.metrics.skipped_blocks >= 1  # the out-of-window block
