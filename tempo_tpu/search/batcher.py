@@ -101,19 +101,33 @@ class BlockBatcher:
     def __init__(self, mesh=None, top_k: int = DEFAULT_TOP_K,
                  max_batch_pages: int = 4096,
                  cache_bytes: int = 4 << 30,
+                 host_cache_bytes: int = 32 << 30,
                  pipeline_depth: int = 2,
                  io_workers: int = 8):
         self.engine = MultiBlockEngine(top_k=top_k, mesh=mesh)
         self.max_batch_pages = max_batch_pages
         self.cache_bytes = cache_bytes
+        self.host_cache_bytes = host_cache_bytes
         self.pipeline_depth = max(1, pipeline_depth)
         self.io_workers = io_workers
         self._cache: OrderedDict[tuple, _CachedBatch] = OrderedDict()
         self._cache_total = 0
+        # host-RAM tier between the object store and HBM: stacked numpy
+        # batches, byte-budgeted separately. An HBM eviction leaves the
+        # host copy, so re-staging an evicted batch is one H2D copy, not
+        # IO + decompress + restack (VERDICT r3 #2)
+        self._host_cache: OrderedDict[tuple, object] = OrderedDict()
+        self._host_total = 0
         self._staging: dict[tuple, threading.Event] = {}
+        self._warmed_shapes: set = set()  # compile-warm dedupe
         self._prune_cache: OrderedDict = OrderedDict()
         self._plan_cache: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
+        # one-slot staging lookahead: stages group i+1 while group i's
+        # kernel runs, overlapping H2D with compute (double-buffering)
+        import concurrent.futures
+        self._prefetcher = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="stage-prefetch")
         self.last_dispatches = 0  # diagnostics: kernel calls in last search
 
     # ------------------------------------------------------------------
@@ -175,17 +189,34 @@ class BlockBatcher:
             # transiently doubling HBM for the batch)
             ev.wait()
         try:
-            # load host pages outside the lock (IO + decompress dominate)
-            import concurrent.futures
+            with self._lock:
+                host = self._host_cache.get(key)
+                if host is not None:
+                    self._host_cache.move_to_end(key)
+            if host is None:
+                # load host pages outside the lock (IO + decompress
+                # dominate)
+                import concurrent.futures
 
-            if len(group) > 1:
-                with concurrent.futures.ThreadPoolExecutor(
-                    max_workers=min(self.io_workers, len(group))
-                ) as ex:
-                    pages = list(ex.map(lambda j: j.pages_fn(), group))
+                if len(group) > 1:
+                    with concurrent.futures.ThreadPoolExecutor(
+                        max_workers=min(self.io_workers, len(group))
+                    ) as ex:
+                        pages = list(ex.map(lambda j: j.pages_fn(), group))
+                else:
+                    pages = [group[0].pages_fn()]
+                host = self.engine.stage_host(pages)
+                with self._lock:
+                    self._host_cache[key] = host
+                    self._host_total += host.nbytes
+                    while (self._host_total > self.host_cache_bytes
+                           and len(self._host_cache) > 1):
+                        _, oldh = self._host_cache.popitem(last=False)
+                        self._host_total -= oldh.nbytes
+                obs.batch_cache_events.inc(result="host_miss")
             else:
-                pages = [group[0].pages_fn()]
-            batch = self.engine.stage(pages)
+                obs.batch_cache_events.inc(result="host_hit")
+            batch = self.engine.place(host)  # H2D only on the hot path
             nbytes = int(sum(int(a.nbytes) for a in batch.device.values()))
             entry = _CachedBatch(batch=batch, nbytes=nbytes, jobs=list(group))
             with self._lock:
@@ -206,12 +237,75 @@ class BlockBatcher:
 
     def invalidate(self, live_block_ids: set[str]) -> None:
         """Drop cached batches containing blocks no longer in the
-        blocklist (called from the poll loop)."""
+        blocklist (called from the poll loop) — both HBM and host tiers."""
         with self._lock:
             dead = [k for k in self._cache
                     if any(jk[0] not in live_block_ids for jk in k)]
             for k in dead:
                 self._cache_total -= self._cache.pop(k).nbytes
+            dead_h = [k for k in self._host_cache
+                      if any(jk[0] not in live_block_ids for jk in k)]
+            for k in dead_h:
+                self._host_total -= self._host_cache.pop(k).nbytes
+
+    def prewarm(self, groups: list[list[ScanJob]],
+                warm_compile: bool = True,
+                stop: threading.Event | None = None) -> int:
+        """Stage groups ahead of queries (called in the background after
+        a poll): fills the host tier + HBM up to their budgets in plan
+        order, and optionally warms the XLA compile cache for the
+        staged shapes with a throwaway dispatch, so the first real query
+        pays neither staging nor compile. Returns groups staged."""
+        staged = 0
+        budget = self.cache_bytes
+        for group in groups:
+            if stop is not None and stop.is_set():
+                break
+            if budget <= 0:
+                break
+            try:
+                cached = self._staged(group)
+            except Exception:  # noqa: BLE001 — prewarm is best-effort
+                continue
+            budget -= cached.nbytes
+            staged += 1
+            if stop is not None and stop.is_set():
+                break
+            if warm_compile:
+                try:
+                    self._warm_compile(cached)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+        return staged
+
+    def _warm_compile(self, cached: _CachedBatch) -> None:
+        """Throwaway dispatches to populate the jit cache for this
+        batch's shape at the common term counts (0 = duration/window
+        only, 2 = the typical tag AND). The jit cache keys on the PADDED
+        shape (pow2-bucketed) — warming is deduped per shape signature,
+        or a 100-group tenant would device-scan the whole corpus ~200x
+        for ~log2 distinct compiles (code-review r4)."""
+        import numpy as np
+
+        from .multiblock import MultiQuery
+
+        shape_sig = (cached.batch.device["entry_valid"].shape,
+                     cached.batch.device["kv_key"].shape,
+                     len(cached.batch.blocks))
+        with self._lock:
+            if shape_sig in self._warmed_shapes:
+                return
+            self._warmed_shapes.add(shape_sig)
+        B = len(cached.batch.blocks)
+        for n_terms in (0, 2):
+            mq = MultiQuery(
+                term_keys=np.full((B, max(1, n_terms)), -1, dtype=np.int32),
+                val_ranges=np.tile(np.array([1, 0], dtype=np.int32),
+                                   (B, max(1, n_terms), 1, 1)),
+                dur_lo=1, dur_hi=0,  # empty range: matches nothing
+                win_start=1, win_end=0,
+                limit=20, n_terms=n_terms)
+            self.engine.scan(cached.batch, mq)
 
     # ------------------------------------------------------------------
     # search
@@ -300,31 +394,56 @@ class BlockBatcher:
 
         sig = _predicate_sig(req)
 
+        def hdr_skip_for(group):
+            """Header-only prune BEFORE staging: a decidably-dead group
+            (time window, tag rollup) costs no IO and no HBM; the skip
+            list is memoized so repeats are O(1)."""
+            gkey = tuple(j.key for j in group)
+            with self._lock:
+                skip = self._prune_cache.get((gkey, sig))
+                if skip is not None:
+                    self._prune_cache.move_to_end((gkey, sig))
+                    return skip
+            skip = [not matches_block_header(j.header, req) for j in group]
+            with self._lock:
+                self._prune_cache[(gkey, sig)] = skip
+                while len(self._prune_cache) > _PRUNE_CACHE_MAX:
+                    self._prune_cache.popitem(last=False)
+            return skip
+
+        prefetched: dict = {}
+
+        def submit_prefetch(from_idx):
+            """One-slot staging lookahead: stage the NEXT live group in a
+            background thread while this group's kernel runs — H2D
+            overlaps compute (double-buffering; _staged's dedupe makes a
+            racing inline stage safe)."""
+            for gi in range(from_idx, len(groups)):
+                g = groups[gi]
+                if all(hdr_skip_for(g)):
+                    continue
+                k = tuple(j.key for j in g)
+                with self._lock:
+                    resident = k in self._cache
+                if not resident and k not in prefetched:
+                    prefetched[k] = self._prefetcher.submit(self._staged, g)
+                return
+
         with tracing.start_span("batcher.Search") as span:
-            for group in groups:
+            for gi, group in enumerate(groups):
                 if results.complete:
                     break
                 gkey = tuple(j.key for j in group)
-                # header-only prune BEFORE staging: a decidably-dead group
-                # (time window, tag rollup) costs no IO and no HBM; the
-                # skip list is memoized alongside so repeats are O(1)
-                with self._lock:
-                    hdr_skip = self._prune_cache.get((gkey, sig))
-                    if hdr_skip is not None:
-                        self._prune_cache.move_to_end((gkey, sig))
-                if hdr_skip is None:
-                    hdr_skip = [not matches_block_header(j.header, req)
-                                for j in group]
-                    with self._lock:
-                        self._prune_cache[(gkey, sig)] = hdr_skip
-                        while len(self._prune_cache) > _PRUNE_CACHE_MAX:
-                            self._prune_cache.popitem(last=False)
+                hdr_skip = hdr_skip_for(group)
                 if all(hdr_skip):
                     results.metrics.skipped_blocks += len(group)
                     continue
                 # memo lookup needs the staged batch's identity; the memo
                 # itself lives on the cached batch so it dies with it
-                cached = self._staged(group)
+                fut_staged = prefetched.pop(gkey, None)
+                cached = (fut_staged.result() if fut_staged is not None
+                          else self._staged(group))
+                submit_prefetch(gi + 1)
                 with self._lock:
                     pre = cached.query_cache.get(sig)
                     if pre is not None:

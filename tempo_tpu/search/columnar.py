@@ -78,6 +78,14 @@ class ColumnarPages:
     def n_pages(self) -> int:
         return self.kv_key.shape[0]
 
+    @property
+    def nbytes(self) -> int:
+        """Host RAM pinned by this container's arrays (page-range views
+        over-count toward the parent's full buffers — conservative for a
+        byte budget)."""
+        return int(sum(getattr(self, name).nbytes
+                       for name, _ in self._ARRAYS))
+
     def slice_pages(self, start: int, count: int) -> "ColumnarPages":
         """A view over pages [start, start+count) — the unit of the
         reference's page-range search jobs (SearchBlockRequest

@@ -52,11 +52,30 @@ class BlockBatch:
         return int(self.page_block.shape[0])
 
 
-def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None,
-                 sharding=None) -> BlockBatch:
-    """Concatenate uniform-geometry blocks along the page axis. With
-    `sharding` (a NamedSharding over the page axis) the stacked arrays are
-    placed sharded across the mesh instead of on the default device."""
+@dataclass
+class HostBatch:
+    """The host-RAM half of a staged batch: stacked (padded) numpy arrays
+    ready for a device put. This is the overflow tier between the object
+    store and HBM — an HBM-evicted batch re-stages from here with ONE
+    H2D copy, skipping IO + decompress + restack (VERDICT r3 #2)."""
+    cat: dict                       # stacked host arrays incl. page_block
+    page_block: np.ndarray
+    blocks: list                    # list[ColumnarPages]
+    page_offset: list
+
+    @property
+    def nbytes(self) -> int:
+        # the entry pins BOTH the stacked copies and each block's source
+        # ColumnarPages (needed for result rendering + query compile) —
+        # budget against real RAM, not just the cat arrays, or a 32 GB
+        # budget pins ~64 GB (code-review r4)
+        return int(sum(a.nbytes for a in self.cat.values())
+                   + sum(b.nbytes for b in self.blocks))
+
+
+def stack_host(blocks: list[ColumnarPages],
+               pad_to: int | None = None) -> HostBatch:
+    """Concatenate uniform-geometry blocks along the page axis on host."""
     E = blocks[0].geometry.entries_per_page
     C = max(b.geometry.kv_per_entry for b in blocks)
     arrays = {name: [] for name in ("kv_key", "kv_val", "entry_start",
@@ -92,6 +111,13 @@ def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None,
         ])
 
     cat["page_block"] = page_block
+    return HostBatch(cat=cat, page_block=page_block, blocks=blocks,
+                     page_offset=page_offset)
+
+
+def place_batch(host: HostBatch, sharding=None) -> BlockBatch:
+    """H2D: put a host-stacked batch on device(s)."""
+    cat = host.cat
     if sharding is not None:
         if jax.process_count() > 1:
             # multi-host: each process transfers ONLY its devices' page
@@ -107,8 +133,16 @@ def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None,
             dev = {k: jax.device_put(v, sharding) for k, v in cat.items()}
     else:
         dev = {k: jnp.asarray(v) for k, v in cat.items()}
-    return BlockBatch(device=dev, page_block=page_block, blocks=blocks,
-                      page_offset=page_offset)
+    return BlockBatch(device=dev, page_block=host.page_block,
+                      blocks=host.blocks, page_offset=host.page_offset)
+
+
+def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None,
+                 sharding=None) -> BlockBatch:
+    """Concatenate uniform-geometry blocks along the page axis and place
+    on device. With `sharding` (a NamedSharding over the page axis) the
+    stacked arrays shard across the mesh instead of the default device."""
+    return place_batch(stack_host(blocks, pad_to=pad_to), sharding=sharding)
 
 
 @dataclass
@@ -287,9 +321,8 @@ class MultiBlockEngine:
         self.mesh = mesh
         self.n_shards = int(mesh.devices.size) if mesh is not None else 1
 
-    def stage(self, blocks: list[ColumnarPages]) -> BlockBatch:
-        """Stack + place a batch on device(s). With a mesh the page axis
-        pads to a shard multiple and shards across it.
+    def stage_host(self, blocks: list[ColumnarPages]) -> HostBatch:
+        """Stack a batch on host, padded for this engine's device layout.
 
         The padded page count buckets to a power of two (shard-aligned):
         group sizes vary freely with the blocklist, and each distinct
@@ -299,13 +332,21 @@ class MultiBlockEngine:
         pad_to = max(1, self.n_shards)
         while pad_to < total:
             pad_to *= 2
+        return stack_host(blocks, pad_to=pad_to)
+
+    def place(self, host: HostBatch) -> BlockBatch:
+        """H2D of a host-stacked batch (sharded over the mesh if any)."""
         if self.mesh is None:
-            return stack_blocks(blocks, pad_to=pad_to)
+            return place_batch(host)
         from jax.sharding import NamedSharding, PartitionSpec as P
         from tempo_tpu.parallel.mesh import SCAN_AXIS
 
         spec = NamedSharding(self.mesh, P(SCAN_AXIS))
-        return stack_blocks(blocks, pad_to=pad_to, sharding=spec)
+        return place_batch(host, sharding=spec)
+
+    def stage(self, blocks: list[ColumnarPages]) -> BlockBatch:
+        """Stack + place a batch on device(s)."""
+        return self.place(self.stage_host(blocks))
 
     def scan_async(self, batch: BlockBatch, mq: MultiQuery):
         """Dispatch without device→host sync; returns device arrays."""

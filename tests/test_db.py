@@ -614,3 +614,93 @@ def test_truncated_entries_surface_in_search_response(tmp_path):
         j.pages_to_search = 1
     res2 = db.search_blocks(breq)
     assert res2.response().metrics.truncated_entries == total
+
+
+def test_host_tier_survives_hbm_eviction(tmp_path):
+    """An HBM-evicted batch must re-stage from the host-RAM stacked tier
+    (one H2D copy) without re-reading or re-decompressing from the
+    object store (VERDICT r3 #2)."""
+    from tempo_tpu.observability import metrics as obs
+
+    db = _db(tmp_path)
+    for b in range(3):
+        _ingest(db, "t1", 4, seed_base=b * 50)
+    db.poll()
+    req = _mk_req({})
+    req.limit = 10_000
+    r1 = db.search("t1", req).response()
+    assert db.batcher._host_total > 0  # host tier populated
+
+    # count backend reads of search containers to prove no re-IO
+    reads = [0]
+    real_read = db.backend.read
+    def counting_read(*a, **kw):
+        reads[0] += 1
+        return real_read(*a, **kw)
+    db.backend.read = counting_read
+
+    # evict everything from HBM, keep the host tier
+    with db.batcher._lock:
+        db.batcher._cache.clear()
+        db.batcher._cache_total = 0
+    h0 = obs.batch_cache_events.value(result="host_hit")
+    r2 = db.search("t1", req).response()
+    assert obs.batch_cache_events.value(result="host_hit") > h0
+    assert reads[0] == 0  # no object-store IO on the evicted path
+    assert ({t.trace_id for t in r1.traces}
+            == {t.trace_id for t in r2.traces})
+    assert r1.metrics.inspected_traces == r2.metrics.inspected_traces
+
+
+def test_host_tier_budget_evicts(tmp_path):
+    """The host tier honors its byte budget."""
+    db = _db(tmp_path)
+    for b in range(4):
+        _ingest(db, "t1", 4, seed_base=b * 50)
+    db.poll()
+    db.batcher.max_batch_pages = 1   # one group per block
+    db.batcher.host_cache_bytes = 1  # budget below any batch
+    req = _mk_req({})
+    req.limit = 10_000
+    db.search("t1", req)
+    # budget of 1 byte keeps at most one entry (evict-to-last semantics)
+    assert len(db.batcher._host_cache) <= 1
+
+
+def test_staging_prefetch_results_identical(tmp_path):
+    """With multiple groups the one-slot staging lookahead must not
+    change results or metrics vs a cold single-threaded pass."""
+    db = _db(tmp_path)
+    db.batcher.max_batch_pages = 8  # force several groups
+    for b in range(10):
+        _ingest(db, "t1", 4, seed_base=b * 30)
+    db.poll()
+    req = _mk_req({})
+    req.limit = 10_000
+    r1 = db.search("t1", req).response()
+    assert len(r1.traces) == 40
+    # second pass: everything cached, same answers
+    r2 = db.search("t1", req).response()
+    assert ({t.trace_id for t in r1.traces}
+            == {t.trace_id for t in r2.traces})
+    assert r1.metrics.inspected_traces == r2.metrics.inspected_traces
+
+
+def test_prewarm_stages_before_first_query(tmp_path):
+    """prewarm (poll-triggered) stages every group and warms the compile
+    cache so the first query hits the staged-batch cache."""
+    from tempo_tpu.observability import metrics as obs
+
+    db = _db(tmp_path)
+    for b in range(3):
+        _ingest(db, "t1", 4, seed_base=b * 40)
+    db.cfg.search_prewarm_on_poll = False
+    db.poll()
+    staged = db.prewarm(["t1"], background=False)
+    assert staged >= 1
+    h0 = obs.batch_cache_events.value(result="hit")
+    req = _mk_req({})
+    req.limit = 10_000
+    r = db.search("t1", req).response()
+    assert len(r.traces) == 12
+    assert obs.batch_cache_events.value(result="hit") > h0  # no staging
