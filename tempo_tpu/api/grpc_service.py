@@ -32,6 +32,7 @@ OTLP_EXPORT_METHOD = f"/{OTLP_SERVICE}/Export"
 
 def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
                             querier=None, otlp_push=None,
+                            frontend_dispatcher=None,
                             max_workers: int = 16) -> grpc.Server:
     """gRPC server exposing only the services this process's modules back:
 
@@ -39,11 +40,25 @@ def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
       ingester  — Ingester (IngesterQuerier service: querier replica reads)
       querier   — Querier (Querier service: frontend job dispatch)
       otlp_push — fn(tenant, batches) (OTLP receiver, distributor role)
+      frontend_dispatcher — PullDispatcher (Frontend service: querier
+                  workers pull jobs over the Process duplex stream)
     """
     from concurrent import futures
 
+    # each Frontend/Process pull stream PARKS one executor thread for its
+    # whole lifetime (the servicer loop blocks on the job queue), so the
+    # dispatch server needs headroom for queriers × parallelism streams
+    # on top of ordinary unary traffic — threads are cheap, starved
+    # worker streams are silent
+    if frontend_dispatcher is not None:
+        max_workers = max(max_workers, 128)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handlers = []
+
+    if frontend_dispatcher is not None:
+        from tempo_tpu.modules.worker import make_frontend_pull_handler
+
+        handlers.append(make_frontend_pull_handler(frontend_dispatcher))
 
     if pusher is not None:
         def push_bytes(request, context):

@@ -58,8 +58,10 @@ class TempoDBConfig:
     search_max_batch_pages: int = 4096    # pages stacked per dispatch
     search_batch_cache_bytes: int = 4 << 30   # staged-batch HBM budget
     # host-RAM overflow tier for stacked batches: HBM-evicted batches
-    # re-stage with one H2D copy instead of IO+decompress+restack
-    search_host_cache_bytes: int = 32 << 30
+    # re-stage with one H2D copy instead of IO+decompress+restack.
+    # None = auto: min(32 GB, half of physical RAM) — this tier RETAINS
+    # memory, so a fixed default would OOM small hosts
+    search_host_cache_bytes: int | None = None
     search_pipeline_depth: int = 2        # dispatches in flight
     # stage + compile-warm hot batches in the background after each poll
     # so the first query pays neither (off by default: polls in tests and

@@ -58,6 +58,9 @@ class AppConfig:
     # (push receivers — OTLP gRPC/HTTP, Zipkin, Jaeger — live on the
     # server ports and need no config here)
     receivers: dict = field(default_factory=dict)
+    # streams each querier opens per discovered query-frontend for pull
+    # dispatch (reference querier.frontend_worker parallelism)
+    frontend_worker_parallelism: int = 2
 
 
 class App:

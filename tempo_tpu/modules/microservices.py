@@ -13,11 +13,19 @@ runs one module (or `all`), discovers its peers via gossip membership
   compactor       ring-ownership-gated compaction + retention loops
   all             the single-binary App (modules/app.py), unchanged
 
-Deviation from the reference, on purpose: job dispatch frontend→querier is
-a bounded-concurrency push over the Querier service rather than the
-httpgrpc pull-stream — the queue/fairness layer (modules/queue.py) sits in
-the frontend; the job protocol (SearchBlockRequest) is identical either
-way (SURVEY.md §2.6 note).
+Job dispatch frontend→querier runs in one of two modes:
+
+  pull (default when the query-frontend has a grpc_port): querier workers
+  dial the frontend and pull jobs over the tempopb.Frontend/Process
+  duplex stream (modules/worker.py) — the reference's httpgrpc pull
+  dispatch (frontend v1 + querier/worker). A killed querier's in-flight
+  jobs requeue to the survivors.
+
+  push (fallback, and the mode when the frontend serves no gRPC): the
+  frontend pushes jobs to Querier-service clients with bounded
+  concurrency. The queue/fairness layer (modules/queue.py) and the job
+  protocol (SearchBlocksRequest) are identical either way (SURVEY.md
+  §2.6 note).
 """
 
 from __future__ import annotations
@@ -148,7 +156,11 @@ class ModuleProcess:
         needs_grpc = target in ("ingester", "querier", "distributor")
         if needs_grpc and not grpc_port:
             raise ValueError("grpc_port must be set for gRPC-serving targets")
-        self.grpc_addr = f"{adv_host}:{grpc_port}" if needs_grpc else ""
+        # a query-frontend WITH a grpc_port serves the Frontend/Process
+        # pull stream; without one it falls back to push dispatch
+        serves_grpc = needs_grpc or (target == "query-frontend"
+                                     and bool(grpc_port))
+        self.grpc_addr = f"{adv_host}:{grpc_port}" if serves_grpc else ""
         self.http_addr = f"{adv_host}:{http_port}" if http_port else ""
 
         self.ingester = None
@@ -157,6 +169,8 @@ class ModuleProcess:
         self.frontend = None
         self.db = None
         self.grpc_server = None
+        self.dispatcher = None       # query-frontend pull dispatch
+        self.worker_manager = None   # querier-side pull workers
 
         if target in ("ingester", "querier", "query-frontend", "compactor"):
             self.db = TempoDB(self.backend, f"{cfg.wal_dir}/{self.id}",
@@ -189,19 +203,33 @@ class ModuleProcess:
                                    ingesters, self.overrides,
                                    external_endpoints=cfg.external_endpoints)
         elif target == "query-frontend":
-            queriers = ClientList(ClientDict(self.ml, "querier",
-                                             lambda a: QuerierClient(a)))
+            push_clients = ClientList(ClientDict(self.ml, "querier",
+                                                 lambda a: QuerierClient(a)))
+            if serves_grpc:
+                from .worker import PullDispatcher, PullQuerierPool
+                self.dispatcher = PullDispatcher()
+                queriers = PullQuerierPool(self.dispatcher,
+                                           fallback=push_clients)
+            else:
+                queriers = push_clients
             self.frontend = QueryFrontend(queriers, cfg.frontend, db=self.db)
 
-        if needs_grpc:
+        if serves_grpc:
             self.grpc_server = make_module_grpc_server(
                 f"0.0.0.0:{grpc_port}",
                 pusher=self.ingester,
                 ingester=self.ingester,
                 querier=self.querier,
                 otlp_push=self.push if self.distributor is not None else None,
+                frontend_dispatcher=self.dispatcher,
             )
             self.grpc_server.start()
+
+        if target == "querier":
+            from .worker import PullWorkerManager
+            self.worker_manager = PullWorkerManager(
+                self.querier, self.ml,
+                parallelism=cfg.frontend_worker_parallelism)
 
         # self-tracing: in-process self-ingest only works where a
         # distributor lives; other targets must export OTLP to a
@@ -270,6 +298,10 @@ class ModuleProcess:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.worker_manager is not None:
+            self.worker_manager.stop()
+        if self.dispatcher is not None:
+            self.dispatcher.stop()
         if self.tracer is not None:
             from tempo_tpu.observability import tracing
             self.tracer.shutdown()

@@ -1,0 +1,470 @@
+"""Pull-model query dispatch: frontend fairness queue → querier workers.
+
+Role-equivalent to the reference's frontend v1 httpgrpc dispatch
+(/root/reference/modules/frontend/v1/frontend.go:33-60 Process loop,
+/root/reference/modules/querier/worker/frontend_processor.go:1-80):
+querier WORKERS dial the frontend and pull jobs over a duplex gRPC
+stream (tempopb.Frontend/Process). The querier is the gRPC CLIENT, so
+job requests are the stream's responses and results its requests; one
+job is in flight per stream; a querier opens `parallelism` streams.
+
+Why pull: work distribution becomes demand-driven. A slow or loaded
+querier simply pulls less; a dead one stops pulling and its in-flight
+jobs are requeued to the survivors — the redistribution-on-kill the
+bounded-concurrency push model (modules/microservices.py, the fallback)
+can only approximate with health probes and retries.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+
+from tempo_tpu import tempopb
+from tempo_tpu.observability import get_logger
+
+from .queue import RequestQueue
+
+SERVICE_FRONTEND = "tempopb.Frontend"
+PROCESS_METHOD = f"/{SERVICE_FRONTEND}/Process"
+
+
+class JobFailed(Exception):
+    """A pulled job exhausted its redeliveries or the worker reported an
+    execution error; the frontend's retry ware decides what happens next."""
+
+
+class _Entry:
+    __slots__ = ("job", "future", "tenant", "deliveries", "cancelled")
+
+    def __init__(self, job, future, tenant):
+        self.job = job
+        self.future = future
+        self.tenant = tenant
+        self.deliveries = 0
+        self.cancelled = False
+
+
+class PullDispatcher:
+    """Frontend side: a tenant-fair queue of ProcessJobs that connected
+    worker streams drain. Jobs whose stream dies mid-flight are requeued
+    (bounded redeliveries) so a killed querier's work redistributes to
+    the survivors — reference frontend.go Process: a failed send/recv
+    re-enqueues the request for the next worker."""
+
+    def __init__(self, max_redeliveries: int = 3,
+                 max_queued_per_tenant: int = 100_000):
+        self._queue = RequestQueue(max_queued_per_tenant=max_queued_per_tenant)
+        self._pending: dict[int, _Entry] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._workers = 0
+        self.max_redeliveries = max_redeliveries
+        self.stopped = False
+        self.delivered = 0   # results handed back to waiters
+        self.requeued = 0    # jobs redistributed off dead streams
+        self.log = get_logger()
+
+    # ---- frontend-facing ----
+
+    def submit(self, tenant: str, job: tempopb.ProcessJob):
+        """Enqueue one job; returns a concurrent.futures.Future resolving
+        to the worker's ProcessResult (or raising JobFailed)."""
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        job.job_id = next(self._ids)
+        job.tenant_id = tenant
+        entry = _Entry(job, fut, tenant)
+        with self._lock:
+            self._pending[job.job_id] = entry
+        try:
+            self._queue.enqueue(tenant, entry)
+        except Exception:
+            with self._lock:
+                self._pending.pop(job.job_id, None)
+            raise
+        return fut
+
+    def abandon(self, job_id: int) -> None:
+        """Caller gave up waiting (timeout): drop the pending entry and
+        mark it cancelled so a queued copy is skipped, not executed."""
+        with self._lock:
+            entry = self._pending.pop(job_id, None)
+            if entry is not None:
+                entry.cancelled = True
+
+    def workers(self) -> int:
+        with self._lock:
+            return self._workers
+
+    def queued(self) -> int:
+        return sum(self._queue.lengths().values())
+
+    def stop(self) -> None:
+        self.stopped = True
+        self._queue.stop()
+
+    # ---- stream-servicer-facing ----
+
+    def register_worker(self) -> None:
+        with self._lock:
+            self._workers += 1
+
+    def unregister_worker(self) -> None:
+        with self._lock:
+            self._workers -= 1
+
+    def next_job(self, timeout: float | None = None):
+        """Next live entry, tenant-fair; None on timeout/stop. Cancelled
+        entries (abandoned by their waiter) are skipped silently."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            left = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            item = self._queue.get(timeout=left)
+            if item is None:
+                return None
+            _tenant, entry = item
+            if entry.cancelled:
+                continue
+            entry.deliveries += 1
+            return entry
+
+    def requeue(self, entry: _Entry) -> None:
+        """Stream died holding this job: hand it to the next worker, or
+        fail it once the redelivery budget is spent."""
+        if entry.cancelled:
+            return
+        if entry.deliveries > self.max_redeliveries:
+            self._fail(entry, JobFailed(
+                f"job {entry.job.job_id} ({entry.job.kind}) failed after "
+                f"{entry.deliveries} deliveries"))
+            return
+        try:
+            self._queue.enqueue(entry.tenant, entry)
+            self.requeued += 1
+        except Exception as e:  # noqa: BLE001 — queue stopped/full
+            self._fail(entry, e)
+
+    def deliver(self, result: tempopb.ProcessResult) -> None:
+        with self._lock:
+            entry = self._pending.pop(result.job_id, None)
+        if entry is None:
+            return  # abandoned by its waiter, or duplicate delivery
+        self.delivered += 1
+        if result.error:
+            entry.future.set_exception(JobFailed(result.error))
+        else:
+            entry.future.set_result(result)
+
+    def _fail(self, entry: _Entry, exc: BaseException) -> None:
+        with self._lock:
+            self._pending.pop(entry.job.job_id, None)
+        if not entry.future.done():
+            entry.future.set_exception(exc)
+
+
+def make_frontend_pull_handler(dispatcher: PullDispatcher):
+    """Generic gRPC handler for tempopb.Frontend/Process. The servicer is
+    the frontend.go:33-60 loop inverted into a response generator: pop a
+    job from the fair queue, yield it down the stream, block on the
+    worker's result, deliver. Any stream death between yield and recv —
+    GeneratorExit on client disconnect, StopIteration on half-close —
+    requeues the in-flight job."""
+    import grpc
+
+    def process(request_iterator, context):
+        dispatcher.register_worker()
+        entry = None
+        try:
+            while True:
+                entry = dispatcher.next_job(timeout=0.5)
+                if entry is None:
+                    if dispatcher.stopped or not context.is_active():
+                        return
+                    continue
+                yield entry.job
+                try:
+                    result = next(request_iterator)
+                except StopIteration:
+                    return  # client half-closed; finally requeues
+                except Exception:  # noqa: BLE001 — stream torn down
+                    return
+                dispatcher.deliver(result)
+                entry = None
+        finally:
+            if entry is not None:
+                dispatcher.requeue(entry)
+            dispatcher.unregister_worker()
+
+    handler = grpc.stream_stream_rpc_method_handler(
+        process,
+        request_deserializer=tempopb.ProcessResult.FromString,
+        response_serializer=lambda m: m.SerializeToString(),
+    )
+    return grpc.method_handlers_generic_handler(
+        SERVICE_FRONTEND, {"Process": handler})
+
+
+# ---------------------------------------------------------------------------
+# frontend-side querier facade
+
+
+class PullQuerierStub:
+    """Duck-types the Querier interface the frontend dispatches to
+    (api/grpc_service.QuerierClient): each call becomes a ProcessJob in
+    the dispatcher's queue, answered by whichever worker pulls it."""
+
+    def __init__(self, dispatcher: PullDispatcher,
+                 job_timeout_s: float | None = 120.0):
+        """job_timeout_s guards against a hung worker holding a LIVE
+        stream (a dead stream requeues instantly). It must comfortably
+        exceed a cold query's staging + XLA-compile cost (~30s+), or the
+        retry ware duplicates exactly the slow jobs."""
+        self.dispatcher = dispatcher
+        self.job_timeout_s = job_timeout_s
+
+    def _dispatch(self, tenant: str, job: tempopb.ProcessJob):
+        import concurrent.futures
+
+        fut = self.dispatcher.submit(tenant, job)
+        try:
+            return fut.result(timeout=self.job_timeout_s)
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            self.dispatcher.abandon(job.job_id)
+            raise
+
+    def find_trace_by_id(self, tenant, trace_id, block_start="", block_end="",
+                         mode="all") -> tempopb.TraceByIDResponse:
+        job = tempopb.ProcessJob(kind="trace_by_id")
+        job.trace_by_id.trace_id = trace_id
+        job.trace_by_id.block_start = block_start
+        job.trace_by_id.block_end = block_end
+        job.trace_by_id.query_mode = mode
+        return self._dispatch(tenant, job).trace
+
+    def search_recent(self, tenant, req) -> tempopb.SearchResponse:
+        job = tempopb.ProcessJob(kind="search_recent")
+        job.search_recent.CopyFrom(req)
+        return self._dispatch(tenant, job).search
+
+    def search_blocks(self, req: tempopb.SearchBlocksRequest) -> tempopb.SearchResponse:
+        job = tempopb.ProcessJob(kind="search_blocks")
+        job.search_blocks.CopyFrom(req)
+        return self._dispatch(req.tenant_id, job).search
+
+    def search_block(self, req: tempopb.SearchBlockRequest) -> tempopb.SearchResponse:
+        # singular job rides the batched kind with one entry
+        breq = tempopb.SearchBlocksRequest()
+        breq.search_req.CopyFrom(req.search_req)
+        breq.tenant_id = req.tenant_id
+        j = breq.jobs.add()
+        j.block_id = req.block_id
+        j.start_page = req.start_page
+        j.pages_to_search = req.pages_to_search
+        j.encoding = req.encoding
+        j.version = req.version
+        j.data_encoding = req.data_encoding
+        j.start_time = req.start_time
+        j.end_time = req.end_time
+        return self.search_blocks(breq)
+
+    def search_tags(self, tenant) -> tempopb.SearchTagsResponse:
+        job = tempopb.ProcessJob(kind="search_tags")
+        return self._dispatch(tenant, job).tags
+
+    def search_tag_values(self, tenant, tag) -> tempopb.SearchTagValuesResponse:
+        job = tempopb.ProcessJob(kind="search_tag_values")
+        job.search_tag_values.tag_name = tag
+        return self._dispatch(tenant, job).tag_values
+
+
+class PullQuerierPool:
+    """List-ish pool the frontend indexes round-robin. With worker streams
+    connected every index resolves to the pull stub (demand-driven — the
+    index is meaningless on purpose); with none it falls back to the
+    direct push clients so a frontend that lost all its workers degrades
+    instead of queueing into the void."""
+
+    def __init__(self, dispatcher: PullDispatcher, fallback=None,
+                 job_timeout_s: float | None = 120.0):
+        self.dispatcher = dispatcher
+        self.fallback = fallback
+        self._stub = PullQuerierStub(dispatcher, job_timeout_s=job_timeout_s)
+
+    def _pull_mode(self) -> bool:
+        if self.dispatcher.workers() > 0:
+            return True
+        return self.fallback is None or len(self.fallback) == 0
+
+    def __getitem__(self, i):
+        if self._pull_mode():
+            return self._stub
+        return self.fallback[i]
+
+    def __len__(self):
+        """Never 0: the frontend round-robins with `rr % len(pool)`, and
+        in pull-degraded mode (no workers, no push clients) indexing must
+        still resolve to the stub — whose queued jobs time out — rather
+        than crash the query with a modulo-by-zero."""
+        w = self.dispatcher.workers()
+        if w > 0:
+            return w
+        if self.fallback is not None and len(self.fallback) > 0:
+            return len(self.fallback)
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# querier-side worker
+
+
+class PullWorker:
+    """Querier side: `parallelism` client streams against one frontend.
+    Each stream receives ProcessJobs, executes them against the local
+    Querier, and sends ProcessResults back — frontend_processor.go's
+    processQueries/runOneRequest loop. Streams reconnect with backoff so
+    a restarted frontend gets its workers back without operator action."""
+
+    def __init__(self, querier, frontend_address: str, parallelism: int = 2,
+                 reconnect_backoff_s: float = 1.0):
+        self.querier = querier
+        self.address = frontend_address
+        self.backoff_s = reconnect_backoff_s
+        self._stop = threading.Event()
+        self._threads = []
+        self._calls_lock = threading.Lock()
+        self._calls: set = set()
+        self.log = get_logger()
+        for i in range(max(1, parallelism)):
+            t = threading.Thread(target=self._stream_loop, daemon=True,
+                                 name=f"pull-worker-{frontend_address}-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def _stream_loop(self) -> None:
+        import grpc
+
+        while not self._stop.is_set():
+            send_q: _queue.SimpleQueue = _queue.SimpleQueue()
+            channel = grpc.insecure_channel(self.address)
+            call = None
+            try:
+                rpc = channel.stream_stream(
+                    PROCESS_METHOD,
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=tempopb.ProcessJob.FromString,
+                )
+
+                def req_iter():
+                    while True:
+                        item = send_q.get()
+                        if item is None:
+                            return
+                        yield item
+
+                call = rpc(req_iter())
+                with self._calls_lock:
+                    if self._stop.is_set():
+                        call.cancel()
+                        return
+                    self._calls.add(call)
+                for job in call:
+                    if self._stop.is_set():
+                        # orderly stop mid-stream: drop the job WITHOUT
+                        # replying so the frontend requeues it elsewhere
+                        call.cancel()
+                        break
+                    send_q.put(self._execute(job))
+            except Exception:  # noqa: BLE001 — reconnect with backoff
+                pass
+            finally:
+                send_q.put(None)
+                if call is not None:
+                    call.cancel()
+                    with self._calls_lock:
+                        self._calls.discard(call)
+                channel.close()
+            if not self._stop.is_set():
+                self._stop.wait(self.backoff_s)
+
+    def _execute(self, job: tempopb.ProcessJob) -> tempopb.ProcessResult:
+        res = tempopb.ProcessResult(job_id=job.job_id)
+        q = self.querier
+        try:
+            if job.kind == "trace_by_id":
+                r = q.find_trace_by_id(
+                    job.tenant_id, job.trace_by_id.trace_id,
+                    block_start=job.trace_by_id.block_start,
+                    block_end=job.trace_by_id.block_end,
+                    mode=job.trace_by_id.query_mode or "all")
+                res.trace.CopyFrom(r)
+            elif job.kind == "search_blocks":
+                res.search.CopyFrom(q.search_blocks(job.search_blocks))
+            elif job.kind == "search_recent":
+                res.search.CopyFrom(
+                    q.search_recent(job.tenant_id, job.search_recent))
+            elif job.kind == "search_tags":
+                res.tags.CopyFrom(q.search_tags(job.tenant_id))
+            elif job.kind == "search_tag_values":
+                res.tag_values.CopyFrom(q.search_tag_values(
+                    job.tenant_id, job.search_tag_values.tag_name))
+            else:
+                res.error = f"unknown job kind {job.kind!r}"
+        except Exception as e:  # noqa: BLE001 — travels as result.error
+            res.error = f"{type(e).__name__}: {e}"
+        return res
+
+    def stop(self) -> None:
+        """Cancel the streams; jobs in flight on them are requeued by the
+        frontend servicer (the kill path the redistribution test kills)."""
+        self._stop.set()
+        with self._calls_lock:
+            for call in list(self._calls):
+                call.cancel()
+
+
+class PullWorkerManager:
+    """Maintains one PullWorker per discovered query-frontend: watches
+    gossip membership (role `query-frontend`) and dials/retires workers
+    as frontends come and go — the reference's worker DNS watcher
+    (querier/worker/worker.go AddressAdded/AddressRemoved) on top of our
+    membership layer instead of DNS."""
+
+    def __init__(self, querier, memberlist, parallelism: int = 2,
+                 refresh_s: float = 1.0):
+        self.querier = querier
+        self.ml = memberlist
+        self.parallelism = parallelism
+        self._workers: dict[str, PullWorker] = {}
+        self._stop = threading.Event()
+        self._refresh_s = refresh_s
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="pull-worker-manager")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._refresh_s):
+            try:
+                self.refresh()
+            except Exception:  # noqa: BLE001 — keep watching
+                pass
+
+    def refresh(self) -> None:
+        want = {m.grpc_addr for m in self.ml.members("query-frontend")
+                if m.grpc_addr}
+        for addr in list(self._workers):
+            if addr not in want:
+                self._workers.pop(addr).stop()
+        for addr in want:
+            if addr not in self._workers:
+                self._workers[addr] = PullWorker(
+                    self.querier, addr, parallelism=self.parallelism)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for w in self._workers.values():
+            w.stop()

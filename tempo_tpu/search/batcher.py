@@ -101,12 +101,22 @@ class BlockBatcher:
     def __init__(self, mesh=None, top_k: int = DEFAULT_TOP_K,
                  max_batch_pages: int = 4096,
                  cache_bytes: int = 4 << 30,
-                 host_cache_bytes: int = 32 << 30,
+                 host_cache_bytes: int | None = None,
                  pipeline_depth: int = 2,
                  io_workers: int = 8):
         self.engine = MultiBlockEngine(top_k=top_k, mesh=mesh)
         self.max_batch_pages = max_batch_pages
         self.cache_bytes = cache_bytes
+        if host_cache_bytes is None:
+            # auto-size: the host tier retains stacked batches (and pins
+            # their source pages), so an unconditional 32 GB default
+            # OOM-kills small hosts — cap at half of physical RAM
+            import os
+            try:
+                phys = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+            except (ValueError, OSError, AttributeError):
+                phys = 16 << 30
+            host_cache_bytes = min(32 << 30, phys // 2)
         self.host_cache_bytes = host_cache_bytes
         self.pipeline_depth = max(1, pipeline_depth)
         self.io_workers = io_workers
@@ -123,11 +133,14 @@ class BlockBatcher:
         self._prune_cache: OrderedDict = OrderedDict()
         self._plan_cache: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
-        # one-slot staging lookahead: stages group i+1 while group i's
-        # kernel runs, overlapping H2D with compute (double-buffering)
+        # staging lookahead: stages group i+1 while group i's kernel
+        # runs, overlapping H2D with compute (double-buffering). More
+        # than one thread so CONCURRENT searches' lookaheads don't
+        # serialize behind each other (each search still submits one at
+        # a time; _staged dedupes racing stages)
         import concurrent.futures
         self._prefetcher = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="stage-prefetch")
+            max_workers=4, thread_name_prefix="stage-prefetch")
         self.last_dispatches = 0  # diagnostics: kernel calls in last search
 
     # ------------------------------------------------------------------
@@ -263,12 +276,19 @@ class BlockBatcher:
                 break
             if budget <= 0:
                 break
+            gkey = tuple(j.key for j in group)
+            with self._lock:
+                resident = gkey in self._cache
             try:
                 cached = self._staged(group)
             except Exception:  # noqa: BLE001 — prewarm is best-effort
                 continue
-            budget -= cached.nbytes
-            staged += 1
+            # only actual staging WORK spends the budget: charging
+            # resident hits would exhaust it on the warm prefix every
+            # poll and never reach newly added groups (code-review r4)
+            if not resident:
+                budget -= cached.nbytes
+                staged += 1
             if stop is not None and stop.is_set():
                 break
             if warm_compile:
@@ -511,6 +531,12 @@ class BlockBatcher:
                     inflight.clear()
                     break
                 drain_one()
+            # early quit leaves a lookahead pending: cancel it so a
+            # not-yet-started stage doesn't burn IO+decompress+H2D (and
+            # possibly evict a hotter batch) for a group nobody needs; an
+            # already-running one completes harmlessly via _staged dedupe
+            for f in prefetched.values():
+                f.cancel()
             span.set_attributes(groups=len(groups), scan_dispatches=dispatches,
                                 inspected_blocks=results.metrics.inspected_blocks,
                                 skipped_blocks=results.metrics.skipped_blocks)

@@ -1,0 +1,315 @@
+"""Pull-model query dispatch (tempopb.Frontend/Process): dispatcher
+fairness + redelivery semantics, the real gRPC duplex stream, and the
+redistribution-on-querier-kill behavior the pull model exists for
+(reference modules/frontend/v1/frontend.go Process +
+modules/querier/worker/frontend_processor.go)."""
+
+import socket
+import threading
+import time
+
+import grpc
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.api.grpc_service import make_module_grpc_server
+from tempo_tpu.modules.worker import (
+    JobFailed, PullDispatcher, PullQuerierPool, PullQuerierStub, PullWorker,
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_for(pred, timeout_s=10.0, interval_s=0.02, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval_s)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# dispatcher unit semantics
+
+
+def test_dispatcher_roundtrip():
+    d = PullDispatcher()
+    job = tempopb.ProcessJob(kind="search_tags")
+    fut = d.submit("acme", job)
+    entry = d.next_job(timeout=1.0)
+    assert entry.job.job_id == job.job_id and entry.job.tenant_id == "acme"
+    res = tempopb.ProcessResult(job_id=entry.job.job_id)
+    res.tags.tag_names.append("svc")
+    d.deliver(res)
+    assert fut.result(timeout=1).tags.tag_names == ["svc"]
+    assert d.delivered == 1
+    d.stop()
+
+
+def test_dispatcher_error_result_raises():
+    d = PullDispatcher()
+    fut = d.submit("t", tempopb.ProcessJob(kind="search_recent"))
+    entry = d.next_job(timeout=1.0)
+    d.deliver(tempopb.ProcessResult(job_id=entry.job.job_id, error="boom"))
+    with pytest.raises(JobFailed, match="boom"):
+        fut.result(timeout=1)
+    d.stop()
+
+
+def test_dispatcher_requeue_then_fail_after_budget():
+    d = PullDispatcher(max_redeliveries=2)
+    fut = d.submit("t", tempopb.ProcessJob(kind="search_recent"))
+    # three deliveries (initial + 2 redeliveries) may fail; the fourth
+    # requeue attempt exhausts the budget
+    for _ in range(3):
+        entry = d.next_job(timeout=1.0)
+        assert entry is not None
+        d.requeue(entry)
+    with pytest.raises(JobFailed, match="failed after"):
+        fut.result(timeout=1)
+    assert d.next_job(timeout=0.05) is None  # nothing left queued
+    d.stop()
+
+
+def test_dispatcher_abandoned_job_skipped():
+    d = PullDispatcher()
+    job = tempopb.ProcessJob(kind="search_tags")
+    d.submit("t", job)
+    d.abandon(job.job_id)
+    assert d.next_job(timeout=0.05) is None  # cancelled entry skipped
+    d.stop()
+
+
+def test_dispatcher_tenant_fairness():
+    d = PullDispatcher()
+    for _ in range(3):
+        d.submit("a", tempopb.ProcessJob(kind="search_tags"))
+    d.submit("b", tempopb.ProcessJob(kind="search_tags"))
+    order = [d.next_job(timeout=1.0).job.tenant_id for _ in range(4)]
+    # round-robin: b is served before a's backlog drains
+    assert order.index("b") < 3
+    d.stop()
+
+
+# ---------------------------------------------------------------------------
+# gRPC stream end-to-end
+
+
+class FakeQuerier:
+    """Duck-typed Querier that records which instance served each job."""
+
+    def __init__(self, name, block_event=None):
+        self.name = name
+        self.block_event = block_event
+        self.served = []
+
+    def search_blocks(self, req):
+        if self.block_event is not None:
+            self.block_event.wait(30)
+        self.served.append("search_blocks")
+        resp = tempopb.SearchResponse()
+        t = resp.traces.add()
+        t.root_service_name = self.name
+        resp.metrics.inspected_blocks = len(req.jobs)
+        return resp
+
+    def search_recent(self, tenant, req):
+        self.served.append("search_recent")
+        return tempopb.SearchResponse()
+
+    def find_trace_by_id(self, tenant, trace_id, block_start="", block_end="",
+                         mode="all"):
+        self.served.append("trace_by_id")
+        resp = tempopb.TraceByIDResponse()
+        resp.metrics.failed_blocks = 0
+        return resp
+
+    def search_tags(self, tenant):
+        self.served.append("search_tags")
+        resp = tempopb.SearchTagsResponse()
+        resp.tag_names.append(f"tag-from-{self.name}")
+        return resp
+
+    def search_tag_values(self, tenant, tag):
+        self.served.append("search_tag_values")
+        resp = tempopb.SearchTagValuesResponse()
+        resp.tag_values.append(f"{tag}={self.name}")
+        return resp
+
+
+@pytest.fixture
+def frontend_server():
+    d = PullDispatcher()
+    port = free_port()
+    server = make_module_grpc_server(f"127.0.0.1:{port}",
+                                     frontend_dispatcher=d)
+    server.start()
+    yield d, f"127.0.0.1:{port}"
+    d.stop()
+    server.stop(0)
+
+
+def test_pull_stream_all_job_kinds(frontend_server):
+    d, addr = frontend_server
+    q = FakeQuerier("q1")
+    w = PullWorker(q, addr, parallelism=1)
+    try:
+        wait_for(lambda: d.workers() >= 1, what="worker stream connects")
+        stub = PullQuerierStub(d, job_timeout_s=10)
+
+        breq = tempopb.SearchBlocksRequest(tenant_id="t")
+        breq.jobs.add()
+        assert stub.search_blocks(breq).metrics.inspected_blocks == 1
+        assert stub.search_recent("t", tempopb.SearchRequest()) is not None
+        assert stub.find_trace_by_id("t", b"\x01" * 16) is not None
+        assert stub.search_tags("t").tag_names == ["tag-from-q1"]
+        assert stub.search_tag_values("t", "svc").tag_values == ["svc=q1"]
+        assert set(q.served) == {"search_blocks", "search_recent",
+                                 "trace_by_id", "search_tags",
+                                 "search_tag_values"}
+    finally:
+        w.stop()
+
+
+def test_pull_worker_error_travels_as_job_failure(frontend_server):
+    d, addr = frontend_server
+
+    class Exploding(FakeQuerier):
+        def search_tags(self, tenant):
+            raise ValueError("no tags today")
+
+    w = PullWorker(Exploding("q1"), addr, parallelism=1)
+    try:
+        wait_for(lambda: d.workers() >= 1, what="worker connects")
+        stub = PullQuerierStub(d, job_timeout_s=10)
+        with pytest.raises(JobFailed, match="no tags today"):
+            stub.search_tags("t")
+    finally:
+        w.stop()
+
+
+def test_kill_querier_redistributes_inflight_job(frontend_server):
+    """THE pull-model property: a worker dies holding a job; the frontend
+    requeues it and the surviving worker answers."""
+    d, addr = frontend_server
+    stall = threading.Event()
+    victim_q = FakeQuerier("victim", block_event=stall)
+    victim = PullWorker(victim_q, addr, parallelism=1)
+    try:
+        wait_for(lambda: d.workers() >= 1, what="victim connects")
+
+        stub = PullQuerierStub(d, job_timeout_s=30)
+        breq = tempopb.SearchBlocksRequest(tenant_id="t")
+        breq.jobs.add()
+        result = {}
+
+        def query():
+            result["resp"] = stub.search_blocks(breq)
+
+        t = threading.Thread(target=query, daemon=True)
+        t.start()
+        # the victim pulls the job and stalls inside its querier
+        wait_for(lambda: d.queued() == 0 and d.workers() == 1,
+                 what="victim holds the job")
+        time.sleep(0.2)
+
+        # survivor joins, then the victim is killed mid-job
+        survivor_q = FakeQuerier("survivor")
+        survivor = PullWorker(survivor_q, addr, parallelism=1)
+        try:
+            wait_for(lambda: d.workers() >= 2, what="survivor connects")
+            victim.stop()   # cancels the stream with the job in flight
+            stall.set()     # unblock the victim thread (its reply is moot)
+
+            t.join(timeout=20)
+            assert not t.is_alive(), "query never completed after kill"
+            assert result["resp"].traces[0].root_service_name == "survivor"
+            assert d.requeued >= 1
+        finally:
+            survivor.stop()
+    finally:
+        victim.stop()
+
+
+def test_pull_pool_falls_back_to_push_clients():
+    d = PullDispatcher()
+    fallback = ["push-client-0", "push-client-1"]
+    pool = PullQuerierPool(d, fallback=fallback)
+    # no workers connected: indexes resolve to the push clients
+    assert pool[0] == "push-client-0" and len(pool) == 2
+    d.register_worker()
+    assert isinstance(pool[0], PullQuerierStub) and len(pool) == 1
+    d.unregister_worker()
+    d.stop()
+
+
+# ---------------------------------------------------------------------------
+# microservice topology over pull dispatch
+
+
+def test_microservice_pull_topology(tmp_path):
+    from tempo_tpu.db import TempoDBConfig
+    from tempo_tpu.modules import AppConfig
+    from tempo_tpu.modules.microservices import ModuleProcess
+    from tempo_tpu.utils.ids import random_trace_id
+    from tempo_tpu.utils.test_data import make_trace
+
+    cfg = AppConfig(
+        backend={"backend": "local", "local": {"path": str(tmp_path / "blk")}},
+        wal_dir=str(tmp_path / "wal"),
+        replication_factor=1,
+        db=TempoDBConfig(blocklist_poll_s=1),
+    )
+    procs = []
+
+    def mk(target, iid, join=(), grpc=False):
+        p = ModuleProcess(
+            cfg, target, instance_id=iid,
+            grpc_port=free_port() if grpc else 0,
+            memberlist_cfg={"join": list(join), "gossip_interval_s": 0.1,
+                            "suspect_timeout_s": 5.0},
+        )
+        procs.append(p)
+        return p
+
+    try:
+        ing = mk("ingester", "ing-1", grpc=True)
+        seed = [ing.ml.gossip_addr]
+        dist = mk("distributor", "dist-1", join=seed, grpc=True)
+        quer = mk("querier", "quer-1", join=seed, grpc=True)
+        front = mk("query-frontend", "front-1", join=seed, grpc=True)
+
+        assert front.dispatcher is not None, "frontend must run pull mode"
+        wait_for(lambda: dist.ready() and front.ready(), what="convergence")
+        # querier workers discover the frontend via gossip and dial in
+        wait_for(lambda: front.dispatcher.workers()
+                 >= cfg.frontend_worker_parallelism,
+                 timeout_s=15, what="pull workers connect")
+
+        tid = random_trace_id()
+        dist.push("acme", list(make_trace(tid, seed=5).batches))
+        ing.flush_tick(force=True)
+        quer.db.poll()
+        front.db.poll()
+
+        req = tempopb.SearchRequest()
+        req.tags["service.name"] = "frontend"
+        req.limit = 10
+        resp = front.search("acme", req)
+        assert resp.metrics.inspected_blocks >= 1
+        # the answer came over the pull stream, not the push fallback
+        assert front.dispatcher.delivered >= 1
+
+        byid = front.find_trace(tenant="acme", trace_id=tid)
+        assert byid.trace.batches
+    finally:
+        for p in procs:
+            try:
+                p.shutdown()
+            except Exception:
+                pass
