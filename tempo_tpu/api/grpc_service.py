@@ -49,7 +49,9 @@ def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
     # whole lifetime (the servicer loop blocks on the job queue), so the
     # dispatch server needs headroom for queriers × parallelism streams
     # on top of ordinary unary traffic — threads are cheap, starved
-    # worker streams are silent
+    # worker streams are silent. The floor covers small deployments;
+    # size AppConfig.frontend_grpc_max_workers above your fleet's
+    # stream count for large ones.
     if frontend_dispatcher is not None:
         max_workers = max(max_workers, 128)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
@@ -271,6 +273,8 @@ class IngesterClient(_Base):
         m.inspected_bytes += resp.metrics.inspected_bytes
         m.inspected_blocks += resp.metrics.inspected_blocks
         m.skipped_blocks += resp.metrics.skipped_blocks
+        m.truncated_entries += resp.metrics.truncated_entries
+        m.failed_blocks += resp.metrics.failed_blocks
 
     def search_tags(self, tenant: str) -> set:
         resp = self._call(SERVICE_INGESTER_QUERIER, "SearchTags",

@@ -147,7 +147,10 @@ class HTTPApi:
         if path == PATH_SEARCH:
             req = parse_search_request(query)
             resp = self.app.search(tenant, req)
-            return 200, json_format.MessageToDict(resp)
+            # tolerated block failures = partial answer (reference
+            # frontend.go:144-146 semantics, extended to search)
+            code = 206 if resp.metrics.failed_blocks else 200
+            return code, json_format.MessageToDict(resp)
         if path == PATH_SEARCH_TAGS:
             resp = self.app.queriers[0].search_tags(tenant)
             return 200, json_format.MessageToDict(resp)

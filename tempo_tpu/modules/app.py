@@ -61,6 +61,10 @@ class AppConfig:
     # streams each querier opens per discovered query-frontend for pull
     # dispatch (reference querier.frontend_worker parallelism)
     frontend_worker_parallelism: int = 2
+    # gRPC executor threads on the query-frontend: every pull stream
+    # PARKS one thread for its lifetime, so size this above queriers ×
+    # parallelism + unary headroom — a starved stream is silent
+    frontend_grpc_max_workers: int = 256
 
 
 class App:

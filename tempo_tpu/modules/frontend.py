@@ -196,7 +196,13 @@ class QueryFrontend:
         same contract the direct TempoDB.search path uses; an
         out-of-window block costs a cached skip, not a scan."""
         db = self.db
-        key = (tenant, db.blocklist.epoch(), len(self.queriers))
+        # width: stable querier-process count, NOT the live stream count
+        # a pull pool reports via len() — that flaps per connect and
+        # would churn this cache through every rollout
+        width = (self.queriers.stable_len()
+                 if hasattr(self.queriers, "stable_len")
+                 else len(self.queriers))
+        key = (tenant, db.blocklist.epoch(), width)
         hit = self._batches_cache.get(key)
         if hit is not None:
             return hit
@@ -205,7 +211,7 @@ class QueryFrontend:
         # auto: spread the whole job list over the querier pool — each
         # querier's share scans in ~one batched dispatch
         B = self.cfg.batch_jobs_per_request or max(
-            1, -(-len(block_jobs) // max(1, len(self.queriers))))
+            1, -(-len(block_jobs) // max(1, width)))
         batches = []
         run_start = 0
         for i in range(1, len(block_jobs) + 1):
@@ -302,5 +308,9 @@ class QueryFrontend:
             or len(failed_block_ids) > self.cfg.tolerate_failed_blocks
         ):
             raise errors[0]
-        merged.metrics.skipped_blocks += len(failed_block_ids)  # tolerated
+        # tolerated failures stay FAILED in the metrics — folding them
+        # into skipped_blocks would make "broken" indistinguishable from
+        # "pruned" (reference frontend.go:144-146; HTTP layer maps
+        # failed_blocks > 0 to 206)
+        merged.metrics.failed_blocks += len(failed_block_ids)
         return merged.response()

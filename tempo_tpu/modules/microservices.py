@@ -222,6 +222,8 @@ class ModuleProcess:
                 querier=self.querier,
                 otlp_push=self.push if self.distributor is not None else None,
                 frontend_dispatcher=self.dispatcher,
+                max_workers=(cfg.frontend_grpc_max_workers
+                             if self.dispatcher is not None else 16),
             )
             self.grpc_server.start()
 

@@ -37,6 +37,7 @@ class Querier:
         overflow to them when more than `prefer_self` jobs run locally
         (reference querier.go:397-452: hedged external search with a
         prefer-self semaphore)."""
+        import concurrent.futures
         import threading
 
         self.db = db
@@ -47,6 +48,11 @@ class Querier:
         self._prefer_self = threading.Semaphore(prefer_self)
         self.external_hedge_after_s = external_hedge_after_s
         self._rr = 0
+        # replica fan-out pool: ingester reads go out CONCURRENTLY so one
+        # slow replica costs max(replicas), not sum (reference
+        # querier.go:252-276 forGivenIngesters errgroup)
+        self._fanout = concurrent.futures.ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="replica-fanout")
 
     # ---- trace by id (reference querier.go:171-249) ----
 
@@ -58,14 +64,20 @@ class Querier:
         failed = 0
 
         if mode in (QUERY_MODE_INGESTERS, QUERY_MODE_ALL):
+            import concurrent.futures
+
             replicas = self.ring.get(token_for(tenant, tid))
+            futs = []
             for iid in replicas:
                 ing = self.ingesters.get(iid)
                 if ing is None:
                     failed += 1
                     continue
+                futs.append(self._fanout.submit(
+                    ing.find_trace_by_id, tenant, tid))
+            for f in concurrent.futures.as_completed(futs):
                 try:
-                    partials.extend(ing.find_trace_by_id(tenant, tid))
+                    partials.extend(f.result())
                 except Exception:  # noqa: BLE001 — replica failure → partial
                     failed += 1
 
@@ -88,12 +100,30 @@ class Querier:
     # ---- search (reference SearchRecent :278, SearchBlock :397) ----
 
     def search_recent(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
+        """Concurrent fan-out over the ingester replica set with merge +
+        early quit: latency is the slowest replica still NEEDED, not the
+        sum of all (reference querier.go:252-276). A failed replica
+        counts as failed_blocks — an operator must be able to tell
+        "pruned" from "broken" — and the merge stops once the limit is
+        satisfied (stragglers complete in the pool, their answers moot)."""
+        import concurrent.futures
+
         results = SearchResults.for_request(req)
-        for ing in self.ingesters.values():
+        ings = list(self.ingesters.values())
+        if not ings:
+            return results.response()
+
+        def one(ing):
+            local = SearchResults.for_request(req)
+            ing.search(tenant, req, local)
+            return local.response()
+
+        futs = [self._fanout.submit(one, ing) for ing in ings]
+        for f in concurrent.futures.as_completed(futs):
             try:
-                ing.search(tenant, req, results)
+                results.merge_response(f.result())
             except Exception:  # noqa: BLE001 — replica failure → partial
-                results.metrics.skipped_blocks += 1
+                results.metrics.failed_blocks += 1
                 continue
             if results.complete:
                 break

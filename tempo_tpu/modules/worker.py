@@ -301,9 +301,19 @@ class PullQuerierPool:
         return self.fallback is None or len(self.fallback) == 0
 
     def __getitem__(self, i):
-        if self._pull_mode():
-            return self._stub
-        return self.fallback[i]
+        if not self._pull_mode():
+            try:
+                # ClientList mods internally; a raw list needs the mod
+                # here because the worker count backing len() can change
+                # between the caller's len() and this index (TOCTOU on
+                # querier restart must degrade, not IndexError a search)
+                fb = self.fallback
+                n = len(fb)
+                if n:
+                    return fb[i % n]
+            except Exception:  # noqa: BLE001 — fallback shrank to empty
+                pass
+        return self._stub
 
     def __len__(self):
         """Never 0: the frontend round-robins with `rr % len(pool)`, and
@@ -316,6 +326,18 @@ class PullQuerierPool:
         if self.fallback is not None and len(self.fallback) > 0:
             return len(self.fallback)
         return 1
+
+    def stable_len(self) -> int:
+        """Dispatch width for job-batch sizing and its memo key. The live
+        stream count (len) flaps on every worker connect/disconnect —
+        keying a 10K-job template cache on it would churn the cache
+        through every rollout — so batch geometry uses the QUERIER
+        process count from membership (the push-client list), which only
+        moves on actual scale events."""
+        if self.fallback is not None and len(self.fallback) > 0:
+            return len(self.fallback)
+        w = self.dispatcher.workers()
+        return w if w > 0 else 1
 
 
 # ---------------------------------------------------------------------------
@@ -348,6 +370,7 @@ class PullWorker:
     def _stream_loop(self) -> None:
         import grpc
 
+        warned = False  # one warning per outage, not one per second
         while not self._stop.is_set():
             send_q: _queue.SimpleQueue = _queue.SimpleQueue()
             channel = grpc.insecure_channel(self.address)
@@ -373,14 +396,20 @@ class PullWorker:
                         return
                     self._calls.add(call)
                 for job in call:
+                    warned = False  # stream is live again
                     if self._stop.is_set():
                         # orderly stop mid-stream: drop the job WITHOUT
                         # replying so the frontend requeues it elsewhere
                         call.cancel()
                         break
                     send_q.put(self._execute(job))
-            except Exception:  # noqa: BLE001 — reconnect with backoff
-                pass
+            except Exception as e:  # noqa: BLE001 — reconnect with backoff
+                if not warned and not self._stop.is_set():
+                    self.log.warning(
+                        "pull worker: frontend %s stream failed (%s); "
+                        "reconnecting every %.1fs", self.address,
+                        getattr(e, "details", lambda: e)(), self.backoff_s)
+                    warned = True
             finally:
                 send_q.put(None)
                 if call is not None:
@@ -441,6 +470,10 @@ class PullWorkerManager:
         self.parallelism = parallelism
         self._workers: dict[str, PullWorker] = {}
         self._stop = threading.Event()
+        # serializes refresh() against stop() so a refresh racing the
+        # shutdown can't insert a worker after stop()'s sweep — that
+        # worker would reconnect forever against a torn-down querier
+        self._lock = threading.Lock()
         self._refresh_s = refresh_s
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="pull-worker-manager")
@@ -456,15 +489,20 @@ class PullWorkerManager:
     def refresh(self) -> None:
         want = {m.grpc_addr for m in self.ml.members("query-frontend")
                 if m.grpc_addr}
-        for addr in list(self._workers):
-            if addr not in want:
-                self._workers.pop(addr).stop()
-        for addr in want:
-            if addr not in self._workers:
-                self._workers[addr] = PullWorker(
-                    self.querier, addr, parallelism=self.parallelism)
+        with self._lock:
+            if self._stop.is_set():
+                return
+            for addr in list(self._workers):
+                if addr not in want:
+                    self._workers.pop(addr).stop()
+            for addr in want:
+                if addr not in self._workers:
+                    self._workers[addr] = PullWorker(
+                        self.querier, addr, parallelism=self.parallelism)
 
     def stop(self) -> None:
         self._stop.set()
-        for w in self._workers.values():
-            w.stop()
+        with self._lock:
+            for w in self._workers.values():
+                w.stop()
+            self._workers.clear()

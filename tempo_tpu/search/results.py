@@ -57,6 +57,7 @@ class SearchResults:
         m.inspected_blocks += resp.metrics.inspected_blocks
         m.skipped_blocks += resp.metrics.skipped_blocks
         m.truncated_entries += resp.metrics.truncated_entries
+        m.failed_blocks += resp.metrics.failed_blocks
 
     @property
     def complete(self) -> bool:

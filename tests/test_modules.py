@@ -412,11 +412,13 @@ def test_frontend_tolerance_counts_blocks_not_batches(tmp_path):
     with pytest.raises(RuntimeError):
         fe.search("t1", req)
 
-    # tolerance 4 covers it -> partial (ingester-only) result, skipped=4
+    # tolerance 4 covers it -> partial (ingester-only) result, FAILED=4
+    # (failed stays failed — pruning skips, breakage fails)
     fe2 = QueryFrontend([FailingBatches()], FrontendConfig(
         batch_jobs_per_request=4, retries=0, tolerate_failed_blocks=4), db=db)
     r = fe2.search("t1", req)
-    assert r.metrics.skipped_blocks == 4
+    assert r.metrics.failed_blocks == 4
+    assert r.metrics.skipped_blocks == 0
 
 
 def test_frontend_failed_block_spanning_batches_counts_once(tmp_path):
@@ -445,7 +447,7 @@ def test_frontend_failed_block_spanning_batches_counts_once(tmp_path):
         target_bytes_per_job=1, batch_jobs_per_request=1, retries=0,
         tolerate_failed_blocks=1), db=db)
     r = fe.search("t1", req)
-    assert r.metrics.skipped_blocks == 1
+    assert r.metrics.failed_blocks == 1
 
 
 def test_frontend_batches_are_geometry_pure(tmp_path):
@@ -1111,3 +1113,105 @@ def test_windowed_search_skips_containerless_block(tmp_path):
     assert obs.fallback_scans.value(tenant="t1") == f0  # no proto scan
     assert r.metrics.inspected_traces == 20  # container block only
     assert r.metrics.skipped_blocks >= 1  # the out-of-window block
+
+
+# ---------------------------------------------------------------------------
+# concurrent replica fan-out (reference querier.go:252-276)
+
+
+class _FanoutIngester:
+    """Duck-typed ingester replica with injectable delay/failure."""
+
+    def __init__(self, name, n_traces=0, delay_s=0.0, fail=False):
+        self.name = name
+        self.n_traces = n_traces
+        self.delay_s = delay_s
+        self.fail = fail
+
+    def search(self, tenant, req, results):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise RuntimeError(f"{self.name} down")
+        for i in range(self.n_traces):
+            m = tempopb.TraceSearchMetadata(
+                trace_id=f"{self.name}-{i}", root_service_name=self.name,
+                start_time_unix_nano=1, duration_ms=1)
+            results.add(m)
+        results.metrics.inspected_traces += self.n_traces
+
+    def find_trace_by_id(self, tenant, tid):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise RuntimeError(f"{self.name} down")
+        return []
+
+
+def test_search_recent_fanout_is_concurrent_not_additive():
+    """Three replicas × 0.4s each must cost ~0.4s, not ~1.2s."""
+    from tempo_tpu.modules.querier import Querier
+
+    ings = {f"i{k}": _FanoutIngester(f"i{k}", n_traces=1, delay_s=0.4)
+            for k in range(3)}
+    q = Querier(None, Ring(), ings)
+    req = tempopb.SearchRequest()
+    req.limit = 100
+    t0 = time.monotonic()
+    resp = q.search_recent("t1", req)
+    elapsed = time.monotonic() - t0
+    assert len(resp.traces) == 3
+    assert elapsed < 0.9, f"fan-out took {elapsed:.2f}s — additive, not concurrent"
+
+
+def test_search_recent_early_quit_skips_slow_straggler():
+    """Limit satisfied by fast replicas: don't wait for the slow one."""
+    from tempo_tpu.modules.querier import Querier
+
+    ings = {"fast1": _FanoutIngester("fast1", n_traces=2),
+            "fast2": _FanoutIngester("fast2", n_traces=2),
+            "slow": _FanoutIngester("slow", n_traces=1, delay_s=2.0)}
+    q = Querier(None, Ring(), ings)
+    req = tempopb.SearchRequest()
+    req.limit = 2
+    t0 = time.monotonic()
+    resp = q.search_recent("t1", req)
+    elapsed = time.monotonic() - t0
+    assert len(resp.traces) == 2
+    assert elapsed < 1.0, f"early quit waited on the straggler ({elapsed:.2f}s)"
+
+
+def test_search_recent_failed_replica_counts_failed_not_skipped():
+    from tempo_tpu.modules.querier import Querier
+
+    ings = {"ok": _FanoutIngester("ok", n_traces=2),
+            "dead": _FanoutIngester("dead", fail=True)}
+    q = Querier(None, Ring(), ings)
+    req = tempopb.SearchRequest()
+    req.limit = 100
+    resp = q.search_recent("t1", req)
+    assert len(resp.traces) == 2
+    assert resp.metrics.failed_blocks == 1
+    assert resp.metrics.skipped_blocks == 0
+
+
+def test_trace_by_id_ingester_leg_concurrent():
+    """The replica leg of trace-by-id fans out concurrently too."""
+    from tempo_tpu.modules.querier import Querier
+
+    ring = Ring(replication_factor=3)
+    ings = {}
+    for k in range(3):
+        ring.register(f"i{k}")
+        ings[f"i{k}"] = _FanoutIngester(f"i{k}", delay_s=0.4)
+
+    class _NoBlocks:
+        def find_trace_by_id(self, tenant, tid, bs, be):
+            return None, 0
+
+    q = Querier(_NoBlocks(), ring, ings)
+    t0 = time.monotonic()
+    resp = q.find_trace_by_id("t1", b"\x01" * 16, mode="ingesters")
+    elapsed = time.monotonic() - t0
+    assert resp.metrics.failed_blocks == 0
+    assert elapsed < 0.9, f"replica leg additive ({elapsed:.2f}s)"
