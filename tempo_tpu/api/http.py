@@ -132,6 +132,17 @@ class HTTPApi:
         if path == "/flush":
             completed = self.app.flush_tick(force=True)
             return 200, {"completed_blocks": len(completed)}
+        if path == "/debug/threads":
+            # faulthandler-style all-thread stack dump (reference pprof
+            # goroutine profile role, cmd/tempo/main.go:54-115): the
+            # first tool for "this process is stuck where?"
+            return 200, self._debug_threads()
+        if path == "/debug/scan":
+            # per-stage breakdown of the last scan + cache occupancy
+            db = getattr(self.app, "reader_db", None)
+            if db is None:
+                return 404, {"error": "no storage reader in this target"}
+            return 200, db.batcher.debug_stats()
         if path == "/shutdown":
             threading.Thread(target=self.app.shutdown, daemon=True).start()
             return 200, "shutting down"
@@ -185,6 +196,22 @@ class HTTPApi:
                 return 404, {"errors": [{"msg": "trace not found"}]}
             return 200, data
         return 404, {"error": f"no jaeger route {sub}"}
+
+    def _debug_threads(self) -> str:
+        """All-thread stack dump as plain text. Pure-Python equivalent of
+        faulthandler.dump_traceback (which needs a real fd, not a
+        response body): name each thread and format its current frame
+        stack, so a hung flush/scan/stream shows exactly where it sits."""
+        import sys
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in sorted(sys._current_frames().items()):
+            out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+            out.extend(line.rstrip()
+                       for line in traceback.format_stack(frame))
+        return "\n".join(out) + "\n"
 
     def _status(self, path, query: dict | None = None) -> dict:
         app = self.app

@@ -14,11 +14,21 @@ subset a consumer/producer needs:
 with RecordBatch v2 (magic=2) encode/decode including CRC32C
 (Castagnoli) integrity checks and zigzag-varint record fields.
 
-Group membership is static: each receiver instance is configured with
-(member_index, members) and consumes partitions where
-``partition % members == member_index`` — the deterministic analog of
-the collector's consumer-group rebalance (documented deviation; offsets
-are still committed per group via the coordinator so restarts resume).
+Group membership is static-with-liveness: each receiver instance is
+configured with (member_index, members) and owns partitions by
+deterministic split — but members heartbeat THROUGH the group
+coordinator (OffsetCommit on a reserved synthetic partition per member,
+``_HEARTBEAT_PART_BASE + index``; the offsets log is a keyed KV store,
+so committing to a partition the topic doesn't have is valid on any
+Kafka), and the split is computed over the members whose heartbeat is
+fresh: ``owner(p) = live[p % len(live)]``. With every member alive this
+is exactly the static ``partition % members`` split; when one dies, the
+survivors adopt its partitions within ``liveness_timeout_s``, resuming
+from its committed offsets — the collector's consumer-group rebalance
+(shim.go:75-138 role) without the join/sync-group protocol. A revived
+member reclaims its share on its next heartbeat; the handover window is
+at-least-once (both ends may briefly fetch the same partition), which
+trace combining downstream already dedupes.
 
 Google Cloud Pub/Sub Lite (the Shopify fork's extra receiver,
 shim.go:10,97) exposes a Kafka-compatible endpoint
@@ -56,6 +66,11 @@ _poll_errors_total = Counter(
 # CRC32C (Castagnoli) — RecordBatch v2 integrity. The native slice-by-8
 # (ops/native.py tt_crc32c, ~1 GB/s) carries the fetch hot path; the
 # table loop below is the no-toolchain fallback.
+
+# reserved synthetic partition range for member heartbeats: far above
+# any real topic's partition count, so the offsets-log keys never
+# collide with data partitions
+_HEARTBEAT_PART_BASE = 1 << 20
 
 _CRC32C_POLY = 0x82F63B78
 _crc32c_table = []
@@ -753,6 +768,8 @@ class KafkaReceiverConfig:
         start_at: str = "latest",  # or earliest
         sasl_username: str | None = None,
         sasl_password: str | None = None,
+        heartbeat_interval_s: float = 2.0,  # 0 disables liveness
+        liveness_timeout_s: float = 10.0,
     ):
         self.brokers = brokers
         self.topic = topic
@@ -764,6 +781,8 @@ class KafkaReceiverConfig:
         self.poll_interval_s = poll_interval_s
         self.tls = tls
         self.start_at = start_at
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.liveness_timeout_s = liveness_timeout_s
         if (sasl_username is None) != (sasl_password is None):
             raise ValueError(
                 "kafka receiver: sasl_username and sasl_password must be "
@@ -798,6 +817,12 @@ class KafkaReceiver:
         self.client = KafkaClient(cfg.brokers, tls=cfg.tls, sasl=cfg.sasl)
         self._offsets: dict[int, int] = {}
         self._reset_parts: set[int] = set()
+        self._last_beat = 0.0
+        self._live: list[int] = []
+        self._live_checked = 0.0
+        self._started = time.time()
+        # peer index → (last heartbeat value, monotonic time it changed)
+        self._peer_seen: dict[int, tuple[int, float]] = {}
         self.records_consumed = 0
         self.decode_errors = 0
         self.offset_resets = 0
@@ -805,15 +830,106 @@ class KafkaReceiver:
 
         self._log = get_logger("tempo_tpu.kafka")
 
-    def _my_partitions(self, parts: dict[int, int]) -> dict[int, int]:
+    def _heartbeat_if_due(self) -> None:
+        """Publish liveness through the group coordinator: commit the
+        current unix time as the "offset" of this member's reserved
+        synthetic partition. Survivable by construction — a failed
+        heartbeat just ages us toward the timeout."""
         c = self.cfg
-        return {p: l for p, l in parts.items() if p % c.members == c.member_index}
+        if c.members <= 1 or c.heartbeat_interval_s <= 0:
+            return
+        now = time.time()
+        if now - self._last_beat < c.heartbeat_interval_s:
+            return
+        try:
+            # milliseconds: the offset is an int64, and whole seconds
+            # would alias away sub-second liveness timeouts
+            self.client.commit_offset(
+                c.group_id, c.topic,
+                _HEARTBEAT_PART_BASE + c.member_index, int(now * 1000))
+            self._last_beat = now
+        except Exception:  # noqa: BLE001 — next poll retries
+            pass
+
+    def _live_members(self) -> list[int]:
+        """Member indices with a fresh heartbeat (self always counts).
+        Cached at heartbeat cadence so a poll round costs at most one
+        liveness sweep, not one per partition."""
+        c = self.cfg
+        if c.members <= 1 or c.heartbeat_interval_s <= 0:
+            return list(range(c.members))
+        now = time.time()
+        # startup grace: until one full timeout has passed, assume the
+        # configured roster is alive — peers that start seconds apart
+        # must come up in the static split, not thrash partitions
+        if now - self._started < c.liveness_timeout_s:
+            return list(range(c.members))
+        if self._live and now - self._live_checked < c.heartbeat_interval_s:
+            return self._live
+        live = []
+        mono = time.monotonic()
+        for i in range(c.members):
+            if i == c.member_index:
+                live.append(i)
+                continue
+            try:
+                ts_ms = self.client.fetch_offset(
+                    c.group_id, c.topic, _HEARTBEAT_PART_BASE + i)
+            except Exception:  # noqa: BLE001 — unknown = not live
+                ts_ms = -1
+            if ts_ms < 0:
+                continue  # never heartbeated
+            # liveness = the peer's heartbeat VALUE advanced recently on
+            # OUR monotonic clock — never a cross-host wall-clock
+            # comparison, which a few seconds of skew would turn into a
+            # permanent false death (code-review r4)
+            prev = self._peer_seen.get(i)
+            if prev is None or prev[0] != ts_ms:
+                self._peer_seen[i] = (ts_ms, mono)
+                live.append(i)
+            elif mono - prev[1] <= c.liveness_timeout_s:
+                live.append(i)
+        if self._live != live:
+            self._log.info("kafka group %s liveness: members %s of %d",
+                           c.group_id, live, c.members)
+        self._live, self._live_checked = live, now
+        return live
+
+    def _my_partitions(self, parts: dict[int, int]) -> dict[int, int]:
+        """STICKY deterministic split over live members: a partition
+        whose static owner (p % members) is alive stays put; only dead
+        owners' partitions fold onto the survivors (live[p % len(live)]).
+        All-alive reduces to the static split, and one death moves
+        exactly the dead member's share — reshuffling healthy members'
+        partitions would open an at-least-once dual-fetch window across
+        the whole topic for every roster change (code-review r4)."""
+        c = self.cfg
+        live = self._live_members()
+        if not live:
+            live = [c.member_index]
+        n = len(live)
+
+        def owner(p: int) -> int:
+            static = p % c.members
+            return static if static in live else live[p % n]
+
+        return {p: l for p, l in parts.items()
+                if owner(p) == c.member_index}
 
     def poll_once(self) -> int:
         """One fetch round over owned partitions. Returns records pushed."""
         c = self.cfg
+        self._heartbeat_if_due()
         meta = self.client.metadata([c.topic])
         parts = self._my_partitions(meta.get(c.topic, {}))
+        # partitions reassigned away (a member revived) restart from the
+        # group's committed offset on re-adoption, not a stale local one —
+        # including a pending out-of-range reset, which after another
+        # member's hours of commits would replay the whole partition
+        for p in list(self._offsets):
+            if p not in parts:
+                self._offsets.pop(p)
+                self._reset_parts.discard(p)
         n = 0
         for partition, leader in sorted(parts.items()):
             if partition not in self._offsets:

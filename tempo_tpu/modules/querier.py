@@ -29,7 +29,8 @@ class Querier:
                  overrides: Overrides | None = None,
                  external_endpoints: list | None = None,
                  prefer_self: int = 10,
-                 external_hedge_after_s: float = 4.0):
+                 external_hedge_after_s: float = 4.0,
+                 fanout_workers: int | None = None):
         """ingesters: instance id → object with find_trace_by_id/search/
         instance() (in-process Ingester or gRPC stub).
 
@@ -50,9 +51,18 @@ class Querier:
         self._rr = 0
         # replica fan-out pool: ingester reads go out CONCURRENTLY so one
         # slow replica costs max(replicas), not sum (reference
-        # querier.go:252-276 forGivenIngesters errgroup)
+        # querier.go:252-276 errgroup). Sized for concurrent REQUESTS ×
+        # replicas because early-quit stragglers pin their thread until
+        # the RPC completes — a pool at ~replica count would head-of-line
+        # block independent requests behind one slow ingester
+        if fanout_workers is None:
+            try:
+                n_ing = len(ingesters)
+            except Exception:  # noqa: BLE001 — dynamic client dicts
+                n_ing = 0
+            fanout_workers = max(32, 8 * max(1, n_ing))
         self._fanout = concurrent.futures.ThreadPoolExecutor(
-            max_workers=16, thread_name_prefix="replica-fanout")
+            max_workers=fanout_workers, thread_name_prefix="replica-fanout")
 
     # ---- trace by id (reference querier.go:171-249) ----
 

@@ -142,6 +142,7 @@ class BlockBatcher:
         self._prefetcher = concurrent.futures.ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="stage-prefetch")
         self.last_dispatches = 0  # diagnostics: kernel calls in last search
+        self.last_scan = None     # /debug/scan: last search's breakdown
 
     # ------------------------------------------------------------------
     # planning
@@ -361,8 +362,18 @@ class BlockBatcher:
                         self._plan_cache.popitem(last=False)
         inflight: deque = deque()
         dispatches = 0
+        # per-stage wall time for the LAST search, exposed at /debug/scan
+        # (reference pprof/debug role, cmd/tempo/main.go:54-115): the
+        # operator's first question about a slow query is which stage ate
+        # it — host prune, staging IO+H2D, predicate compile, kernel, or
+        # the D2H fetch/merge
+        import time as _time
+        stages = {"header_prune": 0.0, "staging": 0.0, "prepare": 0.0,
+                  "dispatch": 0.0, "drain": 0.0}
+        t_search0 = _time.perf_counter()
 
         def drain_one():
+            t0 = _time.perf_counter()
             cached, mq, pre, fut = inflight.popleft()
             count, inspected, scores, idx = fut
             inspected = int(inspected) - pre["entries_skipped"]
@@ -373,6 +384,7 @@ class BlockBatcher:
             for m in self.engine.results(cached.batch, mq,
                                          np.asarray(scores), np.asarray(idx)):
                 results.add(m)
+            stages["drain"] += _time.perf_counter() - t0
 
         def prepare(group, cached, skip) -> dict:
             """O(group) predicate work, memoized per (batch, predicate):
@@ -418,6 +430,13 @@ class BlockBatcher:
             """Header-only prune BEFORE staging: a decidably-dead group
             (time window, tag rollup) costs no IO and no HBM; the skip
             list is memoized so repeats are O(1)."""
+            t0 = _time.perf_counter()
+            try:
+                return _hdr_skip_for(group)
+            finally:
+                stages["header_prune"] += _time.perf_counter() - t0
+
+        def _hdr_skip_for(group):
             gkey = tuple(j.key for j in group)
             with self._lock:
                 skip = self._prune_cache.get((gkey, sig))
@@ -460,16 +479,20 @@ class BlockBatcher:
                     continue
                 # memo lookup needs the staged batch's identity; the memo
                 # itself lives on the cached batch so it dies with it
+                t0 = _time.perf_counter()
                 fut_staged = prefetched.pop(gkey, None)
                 cached = (fut_staged.result() if fut_staged is not None
                           else self._staged(group))
+                stages["staging"] += _time.perf_counter() - t0
                 submit_prefetch(gi + 1)
                 with self._lock:
                     pre = cached.query_cache.get(sig)
                     if pre is not None:
                         cached.query_cache.move_to_end(sig)
                 if pre is None:
+                    t0 = _time.perf_counter()
                     pre = prepare(group, cached, list(hdr_skip))
+                    stages["prepare"] += _time.perf_counter() - t0
                     with self._lock:
                         cached.query_cache[sig] = pre
                         while len(cached.query_cache) > _QUERY_CACHE_MAX:
@@ -500,7 +523,9 @@ class BlockBatcher:
                     # per dispatch costs real ms through a relay
                     mq._device_params = dp
                 results.metrics.skipped_blocks += pre["skipped"]
+                t0 = _time.perf_counter()
                 fut = self.engine.scan_async(cached.batch, mq)
+                stages["dispatch"] += _time.perf_counter() - t0
                 if dp is None:
                     new_dp = mq._device_params
                     # the uploaded query tables live in HBM: account them
@@ -542,4 +567,36 @@ class BlockBatcher:
                                 skipped_blocks=results.metrics.skipped_blocks)
         obs.scan_dispatches.inc(dispatches, mode="batched")
         self.last_dispatches = dispatches
+        self.last_scan = {
+            "total_ms": round((_time.perf_counter() - t_search0) * 1000, 3),
+            "stages_ms": {k: round(v * 1000, 3) for k, v in stages.items()},
+            "scan_dispatches": dispatches,
+            "groups": len(groups),
+            "inspected_blocks": results.metrics.inspected_blocks,
+            "skipped_blocks": results.metrics.skipped_blocks,
+        }
         return results
+
+    def debug_stats(self) -> dict:
+        """Operator-facing snapshot for /debug/scan: the last search's
+        per-stage breakdown plus cache occupancy — the numbers that
+        answer "why is this query slow" without a profiler attached."""
+        with self._lock:
+            return {
+                "last_scan": getattr(self, "last_scan", None),
+                "hbm_cache": {
+                    "batches": len(self._cache),
+                    "bytes": self._cache_total,
+                    "budget_bytes": self.cache_bytes,
+                },
+                "host_cache": {
+                    "batches": len(self._host_cache),
+                    "bytes": self._host_total,
+                    "budget_bytes": self.host_cache_bytes,
+                },
+                "memo": {
+                    "prune_entries": len(self._prune_cache),
+                    "plan_entries": len(self._plan_cache),
+                    "warmed_shapes": len(self._warmed_shapes),
+                },
+            }

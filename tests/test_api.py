@@ -380,3 +380,43 @@ def test_exhaustive_tag_multiblock():
     assert mq2 is not None and mq2.n_terms == 0
     count, inspected, _, _ = MultiBlockEngine().scan(batch, mq2)
     assert count == 8 == inspected
+
+
+# ---------------------------------------------------------------------------
+# debug endpoints (reference cmd/tempo/main.go:54-115 pprof role)
+
+
+def test_debug_threads_dumps_all_stacks(app):
+    api = HTTPApi(app)
+    code, body = api.handle("GET", "/debug/threads", {}, {})
+    assert code == 200
+    assert "--- thread MainThread" in body
+    assert "test_debug_threads_dumps_all_stacks" in body  # our own frame
+
+
+def test_debug_scan_reports_stage_breakdown(app):
+    api = HTTPApi(app)
+    tid = random_trace_id()
+    app.push("t1", list(make_trace(tid, seed=11).batches))
+    app.flush_tick(force=True)
+    app.poll_tick()
+
+    # before any scan: caches present, no last_scan yet
+    code, body = api.handle("GET", "/debug/scan", {}, {})
+    assert code == 200
+    assert body["hbm_cache"]["budget_bytes"] > 0
+    assert body["host_cache"]["budget_bytes"] > 0
+
+    req = _mk_req({})
+    req.limit = 10
+    app.search("t1", req)
+    code, body = api.handle("GET", "/debug/scan", {}, {})
+    assert code == 200
+    last = body["last_scan"]
+    assert last is not None and last["scan_dispatches"] >= 1
+    for stage in ("header_prune", "staging", "prepare", "dispatch", "drain"):
+        assert stage in last["stages_ms"]
+    assert last["total_ms"] > 0
+    # the stages must account for a meaningful share of the total —
+    # a breakdown that misses the time is worse than none
+    assert sum(last["stages_ms"].values()) <= last["total_ms"] * 1.05

@@ -408,3 +408,90 @@ def test_corrupt_batch_unanchored_base_not_trusted():
     with pytest.raises(CorruptBatchError) as ei:
         decode_record_batches(bytes(batch), expect_base=5000)
     assert ei.value.next_offset is None
+
+
+def test_dead_member_partitions_adopted_by_survivor(broker):
+    """Liveness rebalance: member 1 heartbeats, consumes its partition,
+    then dies (stops heartbeating). After liveness_timeout_s the
+    survivor's split covers ALL partitions, resuming partition 1 from
+    the dead member's committed offset."""
+    import time as _t
+
+    client = KafkaClient([broker.addr])
+    client.produce("otlp_spans", 0, [(None, _otlp_bytes(os.urandom(16), 1))])
+    client.produce("otlp_spans", 1, [(None, _otlp_bytes(os.urandom(16), 2))])
+
+    def cfg(idx):
+        return KafkaReceiverConfig(
+            [broker.addr], start_at="earliest", member_index=idx, members=2,
+            heartbeat_interval_s=0.05, liveness_timeout_s=0.4)
+
+    rx0 = KafkaReceiver(cfg(0), lambda t, b: None)
+    rx1 = KafkaReceiver(cfg(1), lambda t, b: None)
+    # both alive: static split, one record each
+    assert rx1.poll_once() == 1   # member 1 consumes + commits partition 1
+    assert rx0.poll_once() == 1
+    assert rx0._live_members() == [0, 1]
+
+    # member 1 dies silently (no more heartbeats)
+    rx1.stop()
+    client.produce("otlp_spans", 1, [(None, _otlp_bytes(os.urandom(16), 3))])
+
+    deadline = _t.monotonic() + 5.0
+    adopted = 0
+    while _t.monotonic() < deadline:
+        _t.sleep(0.1)
+        adopted += rx0.poll_once()
+        if adopted:
+            break
+    assert adopted == 1, "survivor never adopted the dead member's partition"
+    assert rx0._live_members() == [0]
+    # resumed from member 1's commit: exactly the ONE new record, not a
+    # replay of what member 1 already consumed
+    rx0.stop()
+    client.close()
+
+
+def test_revived_member_reclaims_partitions(broker):
+    import time as _t
+
+    def cfg(idx):
+        return KafkaReceiverConfig(
+            [broker.addr], start_at="earliest", member_index=idx, members=2,
+            heartbeat_interval_s=0.05, liveness_timeout_s=0.3)
+
+    client = KafkaClient([broker.addr])
+    rx0 = KafkaReceiver(cfg(0), lambda t, b: None)
+    rx0.poll_once()
+    _t.sleep(0.4)  # member 1 has never heartbeated → not live
+    assert rx0._live_members() == [0]
+    assert set(rx0._my_partitions({0: 1, 1: 1})) == {0, 1}
+
+    rx1 = KafkaReceiver(cfg(1), lambda t, b: None)
+    rx1.poll_once()  # heartbeats
+    _t.sleep(0.1)
+    rx0._live_checked = 0.0  # force a fresh liveness sweep
+    assert rx0._live_members() == [0, 1]
+    assert set(rx0._my_partitions({0: 1, 1: 1})) == {0}
+    rx0.stop(); rx1.stop()
+    client.close()
+
+
+def test_sticky_reassignment_moves_only_dead_members_share(broker):
+    """members=3, member 1 dead: members 0 and 2 keep their static
+    partitions; only member 1's fold onto survivors."""
+    def rx_with_live(idx, live):
+        cfg = KafkaReceiverConfig([broker.addr], member_index=idx, members=3,
+                                  heartbeat_interval_s=0)  # static base
+        r = KafkaReceiver(cfg, lambda t, b: None)
+        r._live_members = lambda: live  # fabricate the liveness view
+        return r
+
+    parts = {p: 1 for p in range(6)}
+    own0 = set(rx_with_live(0, [0, 2])._my_partitions(parts))
+    own2 = set(rx_with_live(2, [0, 2])._my_partitions(parts))
+    # static shares survive: 0 keeps {0,3}, 2 keeps {2,5}
+    assert {0, 3} <= own0 and {2, 5} <= own2
+    # the dead member's {1,4} are covered exactly once between survivors
+    assert own0 | own2 == set(parts)
+    assert own0 & own2 == set()
