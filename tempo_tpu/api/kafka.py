@@ -848,8 +848,11 @@ class KafkaReceiver:
                 c.group_id, c.topic,
                 _HEARTBEAT_PART_BASE + c.member_index, int(now * 1000))
             self._last_beat = now
-        except Exception:  # noqa: BLE001 — next poll retries
-            pass
+        except Exception:  # noqa: BLE001 — next beat retries
+            # a moved coordinator must not strand an IDLE member's
+            # heartbeats until its next data commit fails: re-discover
+            # now, or peers declare us dead and adopt our partitions
+            self.client.invalidate()
 
     def _live_members(self) -> list[int]:
         """Member indices with a fresh heartbeat (self always counts).
@@ -875,8 +878,15 @@ class KafkaReceiver:
             try:
                 ts_ms = self.client.fetch_offset(
                     c.group_id, c.topic, _HEARTBEAT_PART_BASE + i)
-            except Exception:  # noqa: BLE001 — unknown = not live
-                ts_ms = -1
+            except Exception:  # noqa: BLE001 — coordinator unreachable
+                # UNKNOWN is not DEAD: during a coordinator outage every
+                # member's sweep fails for every peer at once — defaulting
+                # to "all dead" would have the whole group consume the
+                # whole topic concurrently. Keep the previous view until
+                # the coordinator answers again.
+                if i in self._live:
+                    live.append(i)
+                continue
             if ts_ms < 0:
                 continue  # never heartbeated
             # liveness = the peer's heartbeat VALUE advanced recently on

@@ -55,14 +55,38 @@ class Querier:
         # replicas because early-quit stragglers pin their thread until
         # the RPC completes — a pool at ~replica count would head-of-line
         # block independent requests behind one slow ingester
-        if fanout_workers is None:
-            try:
-                n_ing = len(ingesters)
-            except Exception:  # noqa: BLE001 — dynamic client dicts
-                n_ing = 0
-            fanout_workers = max(32, 8 * max(1, n_ing))
+        self._fanout_fixed = fanout_workers is not None
+        self._fanout_size = fanout_workers or 32
+        self._fanout_lock = threading.Lock()
         self._fanout = concurrent.futures.ThreadPoolExecutor(
-            max_workers=fanout_workers, thread_name_prefix="replica-fanout")
+            max_workers=self._fanout_size,
+            thread_name_prefix="replica-fanout")
+
+    def _fanout_pool(self):
+        """The replica pool, re-sized as gossip discovers ingesters: the
+        dict is usually EMPTY at construction in microservices mode, so
+        a build-time snapshot would lock in the floor and reintroduce
+        head-of-line blocking at scale. Growth swaps in a bigger
+        executor; the old one drains its in-flight tasks and exits."""
+        import concurrent.futures
+
+        if self._fanout_fixed:
+            return self._fanout
+        try:
+            n = len(self.ingesters)
+        except Exception:  # noqa: BLE001 — dynamic client dicts
+            n = 0
+        want = max(32, 8 * max(1, n))
+        if want > self._fanout_size:
+            with self._fanout_lock:
+                if want > self._fanout_size:
+                    old = self._fanout
+                    self._fanout = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=want,
+                        thread_name_prefix="replica-fanout")
+                    self._fanout_size = want
+                    old.shutdown(wait=False)
+        return self._fanout
 
     # ---- trace by id (reference querier.go:171-249) ----
 
@@ -83,7 +107,7 @@ class Querier:
                 if ing is None:
                     failed += 1
                     continue
-                futs.append(self._fanout.submit(
+                futs.append(self._fanout_pool().submit(
                     ing.find_trace_by_id, tenant, tid))
             for f in concurrent.futures.as_completed(futs):
                 try:
@@ -128,7 +152,8 @@ class Querier:
             ing.search(tenant, req, local)
             return local.response()
 
-        futs = [self._fanout.submit(one, ing) for ing in ings]
+        pool = self._fanout_pool()
+        futs = [pool.submit(one, ing) for ing in ings]
         for f in concurrent.futures.as_completed(futs):
             try:
                 results.merge_response(f.result())
