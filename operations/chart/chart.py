@@ -76,8 +76,12 @@ def _container(v, component: str, extra_ports=(), extra="", grpc=True) -> str:
 
 
 def _deployment(v, component: str, replicas: int, *, comment: str = "",
-                grpc: bool = True, pre_container: str = "",
-                container_extra: str = "") -> str:
+                grpc: bool = True, container_extra: str = "") -> str:
+    """Stateless-Deployment skeleton. The ingester (StatefulSet + PVC +
+    preStop drain) and querier (TPU nodeSelector + device resources)
+    keep hand-rolled templates below on purpose: their shapes diverge
+    enough that threading them through here would mean more hook
+    parameters than shared lines."""
     name = f'{v["name_prefix"]}-{component}'
     return f"""{comment}apiVersion: apps/v1
 kind: Deployment
@@ -93,7 +97,7 @@ spec:
     metadata:
       labels: {_labels(v, component)}
     spec:
-{pre_container}      containers:
+      containers:
 {_container(v, component, extra="", grpc=grpc) if not container_extra else container_extra}
       volumes:
         - name: config

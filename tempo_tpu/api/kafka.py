@@ -818,7 +818,10 @@ class KafkaReceiver:
         self._offsets: dict[int, int] = {}
         self._reset_parts: set[int] = set()
         self._last_beat = 0.0
-        self._live: list[int] = []
+        # seeded with the full roster, NOT []: the keep-previous-view
+        # fallback for coordinator outages must have a sane "previous
+        # view" even when the outage hits the very first sweep
+        self._live: list[int] = list(range(cfg.members))
         self._live_checked = 0.0
         self._started = time.time()
         # peer index → (last heartbeat value, monotonic time it changed)

@@ -60,6 +60,7 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
 
     db = TempoDBConfig(
         block_encoding=storage.get("block_encoding", "zstd"),
+        wal_encoding=storage.get("wal_encoding", "auto"),
         search_encoding=storage.get("search_encoding", "zstd"),
         compaction_window_s=compactor.get("window_s", 3600),
         compaction_max_inputs=compactor.get("max_inputs", 8),

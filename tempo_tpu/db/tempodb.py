@@ -40,6 +40,9 @@ from .retention import apply_retention
 @dataclass
 class TempoDBConfig:
     block_encoding: str = "zstd"          # reference: block zstd
+    # WAL record compression (reference: snappy v2 pages, wal.go:54-97).
+    # "auto" = native snappy if built, zlib otherwise; "none" disables
+    wal_encoding: str = "auto"
     search_encoding: str = "zstd"         # reference: search snappy
     block_page_size: int = 1 << 20
     pool_workers: int = 50                # reference: pool 50 workers
@@ -81,7 +84,7 @@ class TempoDB:
         devices is built automatically if more than one is present."""
         self.backend = backend
         self.cfg = cfg or TempoDBConfig()
-        self.wal = WAL(wal_dir)
+        self.wal = WAL(wal_dir, encoding=self.cfg.wal_encoding)
         self.blocklist = Blocklist()
         self.poller = Poller(backend, build_index=self.cfg.tenant_index_builder)
         self.selector = TimeWindowBlockSelector(

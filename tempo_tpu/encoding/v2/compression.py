@@ -18,7 +18,15 @@ try:
 except ImportError:  # pragma: no cover
     _zstd = None
 
-SUPPORTED_ENCODINGS = ("none", "gzip", "zlib", "zstd", "lz4", "snappy")
+SUPPORTED_ENCODINGS = ("none", "gzip", "zlib", "zstd", "lz4", "snappy", "s2")
+
+# `s2` (reference pool.go:36-93, klauspost/compress/s2) is an extended
+# snappy whose value on the reference is the Go assembly encoder's
+# speed; its framing is snappy-compatible in the mode the reference
+# uses. This framework's block format is deliberately not byte-
+# compatible with the reference's, so `s2` here is config-surface
+# parity: it maps onto the native snappy codec, which fills the same
+# fast-codec role on this runtime.
 
 
 def _native():
@@ -41,7 +49,7 @@ def compress(data: bytes, encoding: str, level: int = 3) -> bytes:
         if _zstd is None:
             raise RuntimeError("zstd unavailable: no native lib and no zstandard wheel")
         return _zstd.ZstdCompressor(level=level).compress(data)
-    if encoding in ("lz4", "snappy"):
+    if encoding in ("lz4", "snappy", "s2"):
         n = _native()
         if n is None:
             raise RuntimeError(f"{encoding} requires the native runtime (make -C native)")
@@ -63,7 +71,7 @@ def decompress(data: bytes, encoding: str) -> bytes:
         if _zstd is None:
             raise RuntimeError("zstd unavailable: no native lib and no zstandard wheel")
         return _zstd.ZstdDecompressor().decompress(data)
-    if encoding in ("lz4", "snappy"):
+    if encoding in ("lz4", "snappy", "s2"):
         n = _native()
         if n is None:
             raise RuntimeError(f"{encoding} requires the native runtime (make -C native)")

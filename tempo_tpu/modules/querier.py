@@ -80,12 +80,16 @@ class Querier:
         if want > self._fanout_size:
             with self._fanout_lock:
                 if want > self._fanout_size:
-                    old = self._fanout
+                    # deliberately NOT shutting the old pool down: a
+                    # concurrent request captured it before the swap and
+                    # its next submit would raise "cannot schedule new
+                    # futures after shutdown" — dropping the reference
+                    # lets in-flight work finish and idle threads die
+                    # with the executor at GC
                     self._fanout = concurrent.futures.ThreadPoolExecutor(
                         max_workers=want,
                         thread_name_prefix="replica-fanout")
                     self._fanout_size = want
-                    old.shutdown(wait=False)
         return self._fanout
 
     # ---- trace by id (reference querier.go:171-249) ----

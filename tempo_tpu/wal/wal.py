@@ -7,6 +7,14 @@ re-scanned (tolerating a truncated tail from a crashed writer), corrupt or
 zero-length files are removed, and the in-memory appender state (records,
 time range) is rebuilt. Filenames encode everything needed to replay:
 ``<block_id>+<tenant>+<version>+<encoding>+<data_encoding>``.
+
+Record payloads are COMPRESSED per segment (the reference WAL writes
+snappy v2 pages, wal.go:54-97 — at ingest volume the WAL is a real
+disk-bandwidth term). The codec rides in the filename's encoding field,
+so replay is self-describing and an upgrade replays old uncompressed
+("none") files unchanged. Default "auto": native snappy when the C++
+runtime is built, zlib otherwise — the ack path never depends on an
+optional build.
 """
 
 from __future__ import annotations
@@ -16,6 +24,7 @@ import urllib.parse
 from dataclasses import dataclass
 
 from tempo_tpu.backend.types import BlockMeta, VERSION_VT1
+from tempo_tpu.encoding.v2.compression import compress, decompress
 from tempo_tpu.encoding.v2.objects import marshal_object, unmarshal_objects
 from tempo_tpu.model.codec import segment_codec_for, CURRENT_ENCODING
 from tempo_tpu.utils.ids import pad_trace_id
@@ -23,12 +32,31 @@ from tempo_tpu.utils.ids import pad_trace_id
 _SEP = "+"
 
 
+def resolve_wal_encoding(encoding: str = "auto") -> str:
+    """Validated at WAL CONSTRUCTION: a typo'd codec, or one whose
+    native library isn't built, must fail startup — not the first
+    append, after the process already reported ready."""
+    from tempo_tpu.encoding.v2.compression import SUPPORTED_ENCODINGS
+    from tempo_tpu.ops import native
+
+    if encoding == "auto":
+        return "snappy" if native.available() else "zlib"
+    if encoding not in SUPPORTED_ENCODINGS:
+        raise ValueError(f"wal_encoding {encoding!r}: supported are "
+                         f"auto, {', '.join(SUPPORTED_ENCODINGS)}")
+    if encoding in ("snappy", "lz4", "s2") and not native.available():
+        raise ValueError(f"wal_encoding {encoding!r} requires the native "
+                         "runtime (make -C native)")
+    return encoding
+
+
 def wal_filename(meta: BlockMeta) -> str:
     # tenant ids are arbitrary strings — percent-encode so the separator
     # (and '/', NUL, etc.) can never corrupt the filename round-trip
     tenant = urllib.parse.quote(meta.tenant_id, safe="")
     return _SEP.join([
-        meta.block_id, tenant, meta.version, "none", meta.data_encoding,
+        meta.block_id, tenant, meta.version, meta.encoding or "none",
+        meta.data_encoding,
     ])
 
 
@@ -62,6 +90,7 @@ class AppendBlock:
         self._entries: list[_Entry] = []
         self._by_id: dict[bytes, list[int]] = {}
         self._codec = segment_codec_for(meta.data_encoding)
+        self._enc = meta.encoding or "none"
         if _replay:
             self._fh = None
             self._replay_file()
@@ -79,6 +108,8 @@ class AppendBlock:
         # normalize to the padded 16-byte key so WAL iteration order matches
         # block index order (StreamingBlock pads the same way)
         obj_id = pad_trace_id(obj_id)
+        if self._enc != "none":
+            segment = compress(segment, self._enc)
         rec = marshal_object(obj_id, segment)
         self._fh.write(rec)
         self._fh.flush()
@@ -102,7 +133,8 @@ class AppendBlock:
         self._rfh.seek(e.offset)
         buf = self._rfh.read(e.length)
         for _, data in unmarshal_objects(buf):
-            return data
+            return (decompress(data, self._enc)
+                    if self._enc != "none" else data)
         raise ValueError("corrupt wal entry")
 
     def find(self, obj_id: bytes) -> bytes | None:
@@ -162,6 +194,11 @@ class AppendBlock:
             self._by_id.setdefault(obj_id, []).append(len(self._entries))
             self._entries.append(e)
             off += length
+            if self._enc != "none":
+                try:
+                    data = decompress(data, self._enc)
+                except Exception:  # noqa: BLE001 — range stays unknown;
+                    data = b""     # find/iterate surface the corruption
             r = self._codec.fast_range(data) if len(data) >= 8 else None
             if r:
                 self.meta.extend_range(r[0], r[1])
@@ -173,14 +210,15 @@ class AppendBlock:
 
 
 class WAL:
-    def __init__(self, wal_dir: str):
+    def __init__(self, wal_dir: str, encoding: str = "auto"):
         self.dir = wal_dir
+        self.encoding = resolve_wal_encoding(encoding)
         os.makedirs(wal_dir, exist_ok=True)
 
     def new_block(self, tenant: str, block_id: str | None = None,
                   data_encoding: str = CURRENT_ENCODING) -> AppendBlock:
         meta = BlockMeta(version=VERSION_VT1, tenant_id=tenant,
-                         data_encoding=data_encoding, encoding="none")
+                         data_encoding=data_encoding, encoding=self.encoding)
         if block_id:
             meta.block_id = block_id
         return AppendBlock(self.dir, meta)
