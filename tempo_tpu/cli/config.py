@@ -36,6 +36,7 @@ import yaml
 
 from tempo_tpu.db import TempoDBConfig
 from tempo_tpu.modules import AppConfig, Limits
+from tempo_tpu.modules.frontend import FrontendConfig
 
 _ENV_RE = re.compile(r"\$\{(\w+)(?::([^}]*))?\}")
 
@@ -52,11 +53,15 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         text = open(path).read() if path else "{}"
     doc = yaml.safe_load(expand_env(text)) or {}
 
-    storage = doc.get("storage", {})
-    ingester = doc.get("ingester", {})
-    compactor = doc.get("compactor", {})
-    retention = doc.get("retention", {})
-    overrides = doc.get("overrides", {})
+    # `or {}` throughout: a bare section key with its children commented
+    # out parses to None, which must mean "all defaults", not a crash
+    storage = doc.get("storage") or {}
+    ingester = doc.get("ingester") or {}
+    compactor = doc.get("compactor") or {}
+    retention = doc.get("retention") or {}
+    overrides = doc.get("overrides") or {}
+    frontend_doc = doc.get("frontend") or {}
+    querier_doc = doc.get("querier") or {}
 
     db = TempoDBConfig(
         block_encoding=storage.get("block_encoding", "zstd"),
@@ -67,6 +72,12 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         retention_s=retention.get("block_s", 14 * 24 * 3600),
         compacted_retention_s=retention.get("compacted_s", 3600),
         blocklist_poll_s=storage.get("blocklist_poll_s", 30),
+        # serving-tier budgets the runbook tells operators to raise
+        # under staging pressure (/debug/scan)
+        search_batch_cache_bytes=storage.get(
+            "search_batch_cache_bytes", 4 << 30),
+        search_host_cache_bytes=storage.get("search_host_cache_bytes"),
+        search_prewarm_on_poll=storage.get("search_prewarm_on_poll", False),
     )
     cfg = AppConfig(
         backend={
@@ -81,7 +92,18 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         n_ingesters=ingester.get("n_ingesters", 1),
         replication_factor=ingester.get("replication_factor", 1),
         write_quorum=ingester.get("write_quorum", "majority"),
-        external_endpoints=doc.get("querier", {}).get("external_endpoints", []),
+        external_endpoints=querier_doc.get("external_endpoints", []),
+        # frontend: {query_shards, max_concurrent_jobs, retries,
+        # tolerate_failed_blocks, max_outstanding_per_tenant,
+        # target_bytes_per_job, batch_jobs_per_request} — sharding/queue
+        # knobs (reference query_frontend block)
+        frontend=FrontendConfig(**{
+            k: v for k, v in frontend_doc.items()
+            if k in FrontendConfig.__dataclass_fields__
+        }),
+        frontend_worker_parallelism=querier_doc.get(
+            "frontend_worker_parallelism", 2),
+        frontend_grpc_max_workers=frontend_doc.get("grpc_max_workers", 256),
         flush_tick_s=ingester.get("flush_tick_s", 10.0),
         poll_tick_s=storage.get("poll_tick_s", 30.0),
         compaction_tick_s=compactor.get("tick_s", 30.0),

@@ -35,6 +35,17 @@ def _native():
     return native if native.available() else None
 
 
+def requires_native(encoding: str) -> bool:
+    """True when this codec has no pure-python fallback here — the one
+    source of truth for startup validation (a codec that passes config
+    load must never fail its first compress call)."""
+    if encoding in ("lz4", "snappy", "s2"):
+        return True
+    if encoding == "zstd":
+        return _zstd is None  # zstandard wheel is the fallback
+    return False
+
+
 def compress(data: bytes, encoding: str, level: int = 3) -> bytes:
     if encoding == "none":
         return data

@@ -36,7 +36,9 @@ def resolve_wal_encoding(encoding: str = "auto") -> str:
     """Validated at WAL CONSTRUCTION: a typo'd codec, or one whose
     native library isn't built, must fail startup — not the first
     append, after the process already reported ready."""
-    from tempo_tpu.encoding.v2.compression import SUPPORTED_ENCODINGS
+    from tempo_tpu.encoding.v2.compression import (
+        SUPPORTED_ENCODINGS, requires_native,
+    )
     from tempo_tpu.ops import native
 
     if encoding == "auto":
@@ -44,7 +46,7 @@ def resolve_wal_encoding(encoding: str = "auto") -> str:
     if encoding not in SUPPORTED_ENCODINGS:
         raise ValueError(f"wal_encoding {encoding!r}: supported are "
                          f"auto, {', '.join(SUPPORTED_ENCODINGS)}")
-    if encoding in ("snappy", "lz4", "s2") and not native.available():
+    if requires_native(encoding) and not native.available():
         raise ValueError(f"wal_encoding {encoding!r} requires the native "
                          "runtime (make -C native)")
     return encoding
@@ -91,6 +93,7 @@ class AppendBlock:
         self._by_id: dict[bytes, list[int]] = {}
         self._codec = segment_codec_for(meta.data_encoding)
         self._enc = meta.encoding or "none"
+        self.corrupt_records = 0  # dropped at replay (decompress failures)
         if _replay:
             self._fh = None
             self._replay_file()
@@ -133,8 +136,16 @@ class AppendBlock:
         self._rfh.seek(e.offset)
         buf = self._rfh.read(e.length)
         for _, data in unmarshal_objects(buf):
-            return (decompress(data, self._enc)
-                    if self._enc != "none" else data)
+            if self._enc == "none":
+                return data
+            try:
+                return decompress(data, self._enc)
+            except Exception as exc:  # noqa: BLE001 — post-replay rot
+                # normalize codec errors (zlib.error, native RuntimeError)
+                # to the ValueError find() already treats as on-disk
+                # corruption — surfaced, and swallowed only during a
+                # racing clear()
+                raise ValueError(f"corrupt wal entry: {exc}") from exc
         raise ValueError("corrupt wal entry")
 
     def find(self, obj_id: bytes) -> bytes | None:
@@ -190,19 +201,31 @@ class AppendBlock:
         off = 0
         for obj_id, data in unmarshal_objects(buf, tolerate_truncation=True):
             length = 8 + len(obj_id) + len(data)
-            e = _Entry(obj_id, off, length)
-            self._by_id.setdefault(obj_id, []).append(len(self._entries))
-            self._entries.append(e)
             off += length
             if self._enc != "none":
                 try:
                     data = decompress(data, self._enc)
-                except Exception:  # noqa: BLE001 — range stays unknown;
-                    data = b""     # find/iterate surface the corruption
+                except Exception:  # noqa: BLE001 — corrupt record
+                    # DROP it, like the reference drops corrupt WAL data
+                    # at replay (wal.go:119-143): indexing it would make
+                    # every later find() raise and wedge block completion
+                    # in an infinite retry. Framing is per-record, so
+                    # subsequent intact records still replay.
+                    self.corrupt_records += 1
+                    continue
+            e = _Entry(obj_id, off - length, length)
+            self._by_id.setdefault(obj_id, []).append(len(self._entries))
+            self._entries.append(e)
             r = self._codec.fast_range(data) if len(data) >= 8 else None
             if r:
                 self.meta.extend_range(r[0], r[1])
             self.meta.total_objects += 1
+        if self.corrupt_records:
+            from tempo_tpu.observability import get_logger
+
+            get_logger().warning(
+                "wal replay %s: dropped %d corrupt record(s)",
+                os.path.basename(self.path), self.corrupt_records)
         # truncate any torn tail so future appends start clean
         if off < len(buf):
             with open(self.path, "ab") as f:

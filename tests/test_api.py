@@ -420,3 +420,31 @@ def test_debug_scan_reports_stage_breakdown(app):
     # the stages must account for a meaningful share of the total —
     # a breakdown that misses the time is worse than none
     assert sum(last["stages_ms"].values()) <= last["total_ms"] * 1.05
+
+
+def test_config_maps_frontend_querier_and_serving_knobs():
+    from tempo_tpu.cli.config import load_config
+
+    cfg, _ = load_config(text="""
+frontend:
+  tolerate_failed_blocks: 3
+  batch_jobs_per_request: 64
+  grpc_max_workers: 300
+querier:
+  frontend_worker_parallelism: 4
+storage:
+  wal_encoding: zlib
+  search_prewarm_on_poll: true
+  search_batch_cache_bytes: 1073741824
+""")
+    assert cfg.frontend.tolerate_failed_blocks == 3
+    assert cfg.frontend.batch_jobs_per_request == 64
+    assert cfg.frontend_worker_parallelism == 4
+    assert cfg.frontend_grpc_max_workers == 300
+    assert cfg.db.wal_encoding == "zlib"
+    assert cfg.db.search_prewarm_on_poll is True
+    assert cfg.db.search_batch_cache_bytes == 1 << 30
+    # defaults survive an empty doc (host cache auto-sizes at None)
+    cfg2, _ = load_config(text="{}")
+    assert cfg2.db.search_host_cache_bytes is None
+    assert cfg2.frontend.batch_jobs_per_request is None

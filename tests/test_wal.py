@@ -212,3 +212,41 @@ def test_s2_encoding_accepted():
     data = b"tempo" * 1000
     assert decompress(compress(data, "s2"), "s2") == data
     assert len(compress(data, "s2")) < len(data)
+
+
+def test_wal_corrupt_compressed_record_dropped_at_replay(tmp_wal_dir):
+    """A bit-flipped compressed payload must be DROPPED at replay (like
+    the reference's corrupt-WAL cleanup) — indexing it would wedge block
+    completion in an infinite retry and 500 every find()."""
+    wal = WAL(tmp_wal_dir)
+    blk = wal.new_block("t1")
+    tids = sorted(random_trace_id() for _ in range(3))
+    for i, tid in enumerate(tids):
+        blk.append(tid, _seg(tid, i, 100, 200), 100, 200)
+    blk.close()
+    # flip bytes INSIDE the middle record's compressed payload (frame
+    # intact: length prefix + 16-byte id untouched)
+    e1 = blk._entries[1]
+    with open(blk.path, "r+b") as f:
+        f.seek(e1.offset + 8 + 16 + 4)
+        f.write(b"\xff\xff\xff\xff")
+
+    blocks, _ = WAL(tmp_wal_dir).replay_all()
+    rb = blocks[0]
+    assert rb.corrupt_records == 1
+    assert rb.meta.total_objects == 2
+    # intact records before AND after the corrupt one survive
+    assert rb.find(tids[0]) is not None
+    assert rb.find(tids[2]) is not None
+    assert rb.find(tids[1]) is None  # dropped, not raising
+    # completion-path iterator works (no infinite flush retry)
+    assert [i for i, _ in rb.iterator()] == [tids[0], tids[2]]
+    rb.close()
+
+
+def test_config_empty_sections_use_defaults():
+    from tempo_tpu.cli.config import load_config
+
+    cfg, _ = load_config(text="frontend:\nquerier:\nstorage:\ningester:\n")
+    assert cfg.frontend.retries == 2
+    assert cfg.frontend_worker_parallelism == 2
