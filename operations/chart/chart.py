@@ -109,7 +109,33 @@ spec:
 
 
 def configmap(v) -> str:
-    s3 = v["storage"]["s3"]
+    st = v["storage"]
+    # render only the ACTIVE backend's section: a local/gcs/azure values
+    # overlay must not ship dead s3 placeholders into the ConfigMap
+    if st["backend"] == "s3":
+        s3 = st["s3"]
+        backend_yaml = f"""      s3:
+        endpoint: {s3["endpoint"]}
+        bucket: {s3["bucket"]}
+        region: {s3["region"]}
+        access_key: {s3["access_key"]}
+        secret_key: {s3["secret_key"]}"""
+    elif st["backend"] == "local":
+        local = st.get("local") or {}  # bare `local:` key = defaults
+        backend_yaml = f"""      local:
+        path: {local.get("path", "/var/tempo/blocks")}"""
+    else:
+        # yaml-dump values so null/lists/nested maps render as YAML,
+        # not python reprs (str(None) would become the STRING "None")
+        # flow-style dump is single-line; scalars get a "..." document
+        # terminator on line 2, hence the first-line take
+        body = "\n".join(
+            "        %s: %s" % (
+                k,
+                yaml.safe_dump(val, default_flow_style=True,
+                               width=10**9).partition("\n")[0])
+            for k, val in (st.get(st["backend"]) or {}).items())
+        backend_yaml = f"      {st['backend']}:\n{body}" if body else ""
     cache_addrs = ", ".join(f'"{a}"' for a in v["cache"]["addresses"])
     return f"""apiVersion: v1
 kind: ConfigMap
@@ -124,12 +150,7 @@ data:
     multitenancy_enabled: {str(v["multitenancy"]).lower()}
     storage:
       backend: {v["storage"]["backend"]}
-      s3:
-        endpoint: {s3["endpoint"]}
-        bucket: {s3["bucket"]}
-        region: {s3["region"]}
-        access_key: {s3["access_key"]}
-        secret_key: {s3["secret_key"]}
+{backend_yaml}
       wal_dir: {v["storage"]["wal_dir"]}
       block_encoding: {v["storage"]["block_encoding"]}
       search_encoding: {v["storage"]["search_encoding"]}

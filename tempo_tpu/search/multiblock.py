@@ -166,11 +166,27 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
     (staging is query-independent) but compile to the -1 sentinel without
     touching their dictionaries."""
     from tempo_tpu.ops import native
-    from .pipeline import NATIVE_SCAN_THRESHOLD
+    from .pipeline import NATIVE_SCAN_THRESHOLD, _dict_fingerprint
 
     use_packed = bool(req.tags) and native.available()
-    per_block: list[CompiledQuery | None] = [
-        None if (skip is not None and skip[i]) else compile_query(
+    # one probe per DISTINCT dictionary, not per block: a 10K-block
+    # tenant usually cycles a handful of dictionary contents (same
+    # services/status codes everywhere), so a novel tag set costs
+    # distinct-dict probes + O(B) numpy assembly instead of 10K python
+    # cache round-trips (~100ms of the cold-tags budget at 10K blocks)
+    fp_of: list[bytes | None] = []
+    rep_idx: dict[bytes, int] = {}
+    for i, b in enumerate(blocks):
+        if skip is not None and skip[i]:
+            fp_of.append(None)
+            continue
+        fp = _dict_fingerprint(b, b.key_dict, b.val_dict)
+        fp_of.append(fp)
+        rep_idx.setdefault(fp, i)
+    compiled: dict[bytes, CompiledQuery | None] = {}
+    for fp, i in rep_idx.items():
+        b = blocks[i]
+        compiled[fp] = compile_query(
             b.key_dict, b.val_dict, req,
             packed_vals=(b.packed_val_dict()
                          if use_packed and len(b.val_dict) >= NATIVE_SCAN_THRESHOLD
@@ -178,7 +194,8 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
             cache_on=b,  # blocks are immutable: repeated tag-sets skip
                          # the O(dict) probe (VERDICT r2 #1 host cost)
         )
-        for i, b in enumerate(blocks)
+    per_block: list[CompiledQuery | None] = [
+        None if fp is None else compiled[fp] for fp in fp_of
     ]
     if all(cq is None for cq in per_block):
         return None
@@ -196,13 +213,19 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
         R *= 2
     term_keys = np.full((B, max(1, T)), -1, dtype=np.int32)
     val_ranges = np.tile(np.array([1, 0], dtype=np.int32), (B, max(1, T), R, 1))
-    for b, cq in enumerate(per_block):
-        if cq is None:
+    # assemble per distinct dictionary: one row-broadcast per group
+    # instead of a python loop over every (block, term)
+    fp_arr = np.array([rep_idx.get(fp, -1) if fp is not None else -1
+                       for fp in fp_of], dtype=np.int64)
+    for fp, cq in compiled.items():
+        if cq is None or not cq.n_terms:
             continue
-        for t in range(cq.n_terms):
-            term_keys[b, t] = cq.term_keys[t]
-            r = cq.val_ranges[t]
-            val_ranges[b, t, : r.shape[0]] = r
+        rows = np.flatnonzero(fp_arr == rep_idx[fp])
+        t_n, r_n = cq.n_terms, cq.val_ranges.shape[1]
+        term_keys[rows[:, None], np.arange(t_n)] = cq.term_keys[:t_n]
+        val_ranges[rows[:, None, None],
+                   np.arange(t_n)[:, None],
+                   np.arange(r_n)] = cq.val_ranges[:t_n]
 
     any_cq = next(cq for cq in per_block if cq is not None)
     return MultiQuery(
