@@ -125,17 +125,20 @@ def configmap(v) -> str:
         backend_yaml = f"""      local:
         path: {local.get("path", "/var/tempo/blocks")}"""
     else:
-        # yaml-dump values so null/lists/nested maps render as YAML,
-        # not python reprs (str(None) would become the STRING "None")
-        # flow-style dump is single-line; scalars get a "..." document
-        # terminator on line 2, hence the first-line take
-        body = "\n".join(
-            "        %s: %s" % (
-                k,
-                yaml.safe_dump(val, default_flow_style=True,
-                               width=10**9).partition("\n")[0])
-            for k, val in (st.get(st["backend"]) or {}).items())
-        backend_yaml = f"      {st['backend']}:\n{body}" if body else ""
+        # dump the whole section as one YAML mapping: null/lists/nested
+        # maps/multi-line credentials all render as valid YAML (a
+        # hand-rolled per-value f-string cannot — str(None) is the
+        # string "None", and newline-bearing scalars need block quoting)
+        import textwrap
+
+        section = dict(st.get(st["backend"]) or {})
+        if section:
+            body = textwrap.indent(
+                yaml.safe_dump(section, default_flow_style=False,
+                               sort_keys=False), "        ").rstrip()
+            backend_yaml = f"      {st['backend']}:\n{body}"
+        else:
+            backend_yaml = ""
     cache_addrs = ", ".join(f'"{a}"' for a in v["cache"]["addresses"])
     return f"""apiVersion: v1
 kind: ConfigMap

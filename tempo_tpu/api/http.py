@@ -231,6 +231,14 @@ class HTTPApi:
             out["tenants"] = db.blocklist.tenants()
             out["blocks"] = {t: len(db.blocklist.metas(t))
                              for t in db.blocklist.tenants()}
+        dispatcher = getattr(app, "dispatcher", None)
+        if dispatcher is not None:  # query-frontend pull dispatch
+            out["pull_dispatch"] = {
+                "workers": dispatcher.workers(),
+                "queued": dispatcher.queued(),
+                "delivered": dispatcher.delivered,
+                "requeued": dispatcher.requeued,
+            }
         return out
 
     _SECRET_KEY_RE = None  # compiled lazily below

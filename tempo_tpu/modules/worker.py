@@ -24,8 +24,16 @@ import time
 
 from tempo_tpu import tempopb
 from tempo_tpu.observability import get_logger
+from tempo_tpu.observability.metrics import Counter, Gauge
 
 from .queue import RequestQueue
+
+_jobs_delivered = Counter("tempo_frontend_pull_jobs_delivered_total",
+                          "results returned to waiting requests")
+_jobs_requeued = Counter("tempo_frontend_pull_jobs_requeued_total",
+                         "jobs redistributed off dead worker streams")
+_worker_streams = Gauge("tempo_frontend_pull_worker_streams",
+                        "connected querier worker streams")
 
 SERVICE_FRONTEND = "tempopb.Frontend"
 PROCESS_METHOD = f"/{SERVICE_FRONTEND}/Process"
@@ -112,10 +120,12 @@ class PullDispatcher:
     def register_worker(self) -> None:
         with self._lock:
             self._workers += 1
+            _worker_streams.set(self._workers)
 
     def unregister_worker(self) -> None:
         with self._lock:
             self._workers -= 1
+            _worker_streams.set(self._workers)
 
     def next_job(self, timeout: float | None = None):
         """Next live entry, tenant-fair; None on timeout/stop. Cancelled
@@ -146,6 +156,7 @@ class PullDispatcher:
         try:
             self._queue.enqueue(entry.tenant, entry)
             self.requeued += 1
+            _jobs_requeued.inc()
         except Exception as e:  # noqa: BLE001 — queue stopped/full
             self._fail(entry, e)
 
@@ -155,6 +166,7 @@ class PullDispatcher:
         if entry is None:
             return  # abandoned by its waiter, or duplicate delivery
         self.delivered += 1
+        _jobs_delivered.inc()
         if result.error:
             entry.future.set_exception(JobFailed(result.error))
         else:

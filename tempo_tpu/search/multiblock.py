@@ -176,6 +176,9 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
     # cache round-trips (~100ms of the cold-tags budget at 10K blocks)
     fp_of: list[bytes | None] = []
     rep_idx: dict[bytes, int] = {}
+    rows_of: dict[bytes, list[int]] = {}  # fp → block rows, built in the
+    # same pass — a per-group flatnonzero rescan would be O(dicts × B),
+    # quadratic exactly when every block has its own dictionary
     for i, b in enumerate(blocks):
         if skip is not None and skip[i]:
             fp_of.append(None)
@@ -183,6 +186,7 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
         fp = _dict_fingerprint(b, b.key_dict, b.val_dict)
         fp_of.append(fp)
         rep_idx.setdefault(fp, i)
+        rows_of.setdefault(fp, []).append(i)
     compiled: dict[bytes, CompiledQuery | None] = {}
     for fp, i in rep_idx.items():
         b = blocks[i]
@@ -215,12 +219,10 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
     val_ranges = np.tile(np.array([1, 0], dtype=np.int32), (B, max(1, T), R, 1))
     # assemble per distinct dictionary: one row-broadcast per group
     # instead of a python loop over every (block, term)
-    fp_arr = np.array([rep_idx.get(fp, -1) if fp is not None else -1
-                       for fp in fp_of], dtype=np.int64)
     for fp, cq in compiled.items():
         if cq is None or not cq.n_terms:
             continue
-        rows = np.flatnonzero(fp_arr == rep_idx[fp])
+        rows = np.asarray(rows_of[fp], dtype=np.int64)
         t_n, r_n = cq.n_terms, cq.val_ranges.shape[1]
         term_keys[rows[:, None], np.arange(t_n)] = cq.term_keys[:t_n]
         val_ranges[rows[:, None, None],

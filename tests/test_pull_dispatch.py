@@ -313,3 +313,27 @@ def test_microservice_pull_topology(tmp_path):
                 p.shutdown()
             except Exception:
                 pass
+
+
+def test_status_exposes_pull_dispatch_stats(tmp_path):
+    """Operators see worker/queue/delivery counts on the frontend's
+    /status (the reference's frontend queue metrics role)."""
+    from tempo_tpu.api.http import HTTPApi
+    from tempo_tpu.db import TempoDBConfig
+    from tempo_tpu.modules import AppConfig
+    from tempo_tpu.modules.microservices import ModuleProcess
+
+    cfg = AppConfig(
+        backend={"backend": "local", "local": {"path": str(tmp_path / "b")}},
+        wal_dir=str(tmp_path / "wal"), db=TempoDBConfig(blocklist_poll_s=1))
+    front = ModuleProcess(cfg, "query-frontend", instance_id="f1",
+                          grpc_port=free_port(),
+                          memberlist_cfg={"gossip_interval_s": 0.2})
+    try:
+        api = HTTPApi(front)
+        code, doc = api.handle("GET", "/status", {}, {})
+        assert code == 200
+        pd = doc["pull_dispatch"]
+        assert set(pd) == {"workers", "queued", "delivered", "requeued"}
+    finally:
+        front.shutdown()
