@@ -98,8 +98,7 @@ def cmd_gen_bloom(be, args):
     ids = [oid for oid, _ in bb.iter_objects()]
     shards = max(1, m.bloom_shard_count or 1)
     bloom = ShardedBloom(shards, expected_per_shard=max(1, len(ids) // shards))
-    for i in ids:
-        bloom.add(i)
+    bloom.add_many(ids)
     for s in range(bloom.shard_count):
         be.write(args.tenant, args.block, bloom_name(s), bloom.marshal_shard(s))
     print(f"rebuilt {bloom.shard_count} bloom shards over {len(ids)} ids")

@@ -60,6 +60,29 @@ class ShardedBloom:
         np.bitwise_or.at(self._bits[s], (pos // 64).astype(np.int64),
                          np.uint64(1) << (pos % np.uint64(64)))
 
+    def add_many(self, obj_ids) -> None:
+        """Vectorized bulk insert: the per-id cost collapses to the two
+        xxhash C calls; probe positions and bit-ORs batch per shard. The
+        block writer inserts every id at complete() time, so this is the
+        completion/compaction hot loop, not `add` (probe math identical
+        to _probe_positions — the KM scheme shared with readers)."""
+        ids = list(obj_ids)
+        if not ids:
+            return
+        n = len(ids)
+        h1 = np.fromiter((xxhash.xxh64_intdigest(o, seed=0) for o in ids),
+                         dtype=np.uint64, count=n)
+        h2 = np.fromiter((xxhash.xxh64_intdigest(o, seed=_SEED2)
+                          for o in ids), dtype=np.uint64, count=n) | np.uint64(1)
+        i = np.arange(self.k, dtype=np.uint64)
+        pos = (h1[:, None] + i[None, :] * h2[:, None]) % np.uint64(self.m)
+        shards = np.fromiter((fnv1a_32(o) for o in ids),
+                             dtype=np.int64, count=n) % self.shard_count
+        for s in np.unique(shards):
+            p = pos[shards == s].ravel()
+            np.bitwise_or.at(self._bits[int(s)], (p // 64).astype(np.int64),
+                             np.uint64(1) << (p % np.uint64(64)))
+
     def test(self, obj_id: bytes) -> bool:
         s = self.shard_for(obj_id, self.shard_count)
         return _probe_words(self._bits[s],

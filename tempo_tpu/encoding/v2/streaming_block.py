@@ -126,8 +126,7 @@ class StreamingBlock:
             fp_rate=self.bloom_fp,
             expected_per_shard=max(1, -(-len(self._ids) // shards)),
         )
-        for i in self._ids:
-            bloom.add(i)
+        bloom.add_many(self._ids)
 
         m = self.meta
         m.size = self._offset

@@ -207,7 +207,7 @@ class ModuleProcess:
                                                  lambda a: QuerierClient(a)))
             if serves_grpc:
                 from .worker import PullDispatcher, PullQuerierPool
-                self.dispatcher = PullDispatcher()
+                self.dispatcher = PullDispatcher(instance=self.id)
                 queriers = PullQuerierPool(self.dispatcher,
                                            fallback=push_clients)
             else:

@@ -63,7 +63,12 @@ class PullDispatcher:
     re-enqueues the request for the next worker."""
 
     def __init__(self, max_redeliveries: int = 3,
-                 max_queued_per_tenant: int = 100_000):
+                 max_queued_per_tenant: int = 100_000,
+                 instance: str = "default"):
+        # metric label: two dispatchers in one process (in-process test
+        # topologies, embedded frontends) must not clobber each other's
+        # gauge with last-writer-wins
+        self.instance = instance
         self._queue = RequestQueue(max_queued_per_tenant=max_queued_per_tenant)
         self._pending: dict[int, _Entry] = {}
         self._lock = threading.Lock()
@@ -120,12 +125,12 @@ class PullDispatcher:
     def register_worker(self) -> None:
         with self._lock:
             self._workers += 1
-            _worker_streams.set(self._workers)
+            _worker_streams.set(self._workers, instance=self.instance)
 
     def unregister_worker(self) -> None:
         with self._lock:
             self._workers -= 1
-            _worker_streams.set(self._workers)
+            _worker_streams.set(self._workers, instance=self.instance)
 
     def next_job(self, timeout: float | None = None):
         """Next live entry, tenant-fair; None on timeout/stop. Cancelled
@@ -156,7 +161,7 @@ class PullDispatcher:
         try:
             self._queue.enqueue(entry.tenant, entry)
             self.requeued += 1
-            _jobs_requeued.inc()
+            _jobs_requeued.inc(instance=self.instance)
         except Exception as e:  # noqa: BLE001 — queue stopped/full
             self._fail(entry, e)
 
@@ -166,7 +171,7 @@ class PullDispatcher:
         if entry is None:
             return  # abandoned by its waiter, or duplicate delivery
         self.delivered += 1
-        _jobs_delivered.inc()
+        _jobs_delivered.inc(instance=self.instance)
         if result.error:
             entry.future.set_exception(JobFailed(result.error))
         else:
