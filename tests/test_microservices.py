@@ -311,6 +311,25 @@ memberlist:
         # while the rest of the suite loads the machine
         wait_for(searched, timeout_s=180, interval_s=0.5,
                  what="backend search via frontend")
+
+        # pull dispatch engages: the frontend binds the default gRPC
+        # port and the querier's workers dial in via gossip (early
+        # queries may legitimately ride the push fallback while workers
+        # are still connecting, so query again once they're in)
+        def pull_stats():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{front_http}/status", timeout=5) as r:
+                return json.loads(r.read()).get("pull_dispatch") or {}
+
+        wait_for(lambda: pull_stats().get("workers", 0) >= 1,
+                 timeout_s=30, what="pull workers connect")
+        q2 = urllib.request.Request(
+            f"http://127.0.0.1:{front_http}/api/search?limit=20",
+            headers={"X-Scope-OrgID": "sub"})
+        with urllib.request.urlopen(q2, timeout=10) as r:
+            assert json.loads(r.read()).get("traces")
+        assert pull_stats().get("delivered", 0) >= 1, \
+            f"post-connect search did not travel over pull: {pull_stats()}"
     finally:
         for p in procs:
             p.terminate()
