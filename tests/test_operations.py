@@ -20,6 +20,7 @@ _METRIC_RE = re.compile(r"\b(tempo[a-z_]*_[a-z_]+|traces_[a-z_]+)\b")
 def _exposed_metric_names() -> set[str]:
     import tempo_tpu.api.kafka  # noqa: F401 — registers its counters
     import tempo_tpu.modules.membership  # noqa: F401
+    import tempo_tpu.modules.worker  # noqa: F401 — pull-dispatch metrics
     import tempo_tpu.modules.generator as gen
     from tempo_tpu.observability.metrics import REGISTRY, Registry
 
