@@ -637,6 +637,13 @@ def main():
         n_blocks, max(1024, n_entries // n_blocks), iters)
     hc_rate, hc_matches, hc_compile_ms = bench_high_cardinality(
         n_entries, cardinality, iters)
+    # BASELINE config 4 names 10M distinct values — run the prefilter at
+    # full cardinality too (device side is unchanged: ranges, not values)
+    hc10_cardinality = int(os.environ.get("BENCH_CARDINALITY_FULL",
+                                          10_000_000))
+    hc10 = (bench_high_cardinality(n_entries, hc10_cardinality,
+                                   max(3, iters // 4))
+            if hc10_cardinality else None)
     scale_blocks = int(os.environ.get("BENCH_SCALE_BLOCKS", 10_000))
     scale = (bench_scale(scale_blocks,
                          int(os.environ.get("BENCH_SCALE_ENTRIES", 512)),
@@ -681,6 +688,12 @@ def main():
                     "traces_per_sec": round(hc_rate),
                     "dict_prefilter_ms": round(hc_compile_ms, 1),
                     "matches": hc_matches,
+                },
+                "high_cardinality_full": None if hc10 is None else {
+                    "distinct_values": hc10_cardinality,
+                    "traces_per_sec": round(hc10[0]),
+                    "dict_prefilter_ms": round(hc10[2], 1),
+                    "matches": hc10[1],
                 },
                 "scale_10k": scale,
                 "scale_large_blocks": scale_large,

@@ -101,13 +101,28 @@ long long tt_substr_scan(const char* buf, const long long* offsets,
       out_ids[found++] = (int)i;
     return found;
   }
-  for (long long i = 0; i < n_strs; i++) {
-    long long len = offsets[i + 1] - offsets[i];
-    if (len < needle_len) continue;
-    const char* s = buf + offsets[i];
-    if (memmem(s, (size_t)len, needle, (size_t)needle_len) != nullptr) {
+  // ONE memmem pass over the whole packed buffer instead of one call
+  // per string: at 10M short values the per-call overhead dominates
+  // (~500ms vs ~100ms measured). Strings are concatenated WITHOUT
+  // separators, so a raw hit can straddle a boundary — validate that
+  // the match lies inside a single string before accepting, else resume
+  // one byte past the false hit.
+  const char* end = buf + offsets[n_strs];
+  const char* p = buf;
+  long long cur = 0;       // monotone string cursor (offsets ascend)
+  while (p < end) {
+    const char* hit =
+        (const char*)memmem(p, (size_t)(end - p), needle, (size_t)needle_len);
+    if (hit == nullptr) break;
+    long long pos = hit - buf;
+    while (offsets[cur + 1] <= pos) cur++;
+    if (pos + needle_len <= offsets[cur + 1]) {
       if (found >= out_cap) return -2;  // caller must grow out buffer
-      out_ids[found++] = (int)i;
+      out_ids[found++] = (int)cur;
+      p = buf + offsets[cur + 1];  // further hits in this string are dupes
+      cur++;
+    } else {
+      p = hit + 1;  // boundary-straddling false hit
     }
   }
   return found;

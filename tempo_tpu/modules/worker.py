@@ -69,6 +69,11 @@ class PullDispatcher:
         # topologies, embedded frontends) must not clobber each other's
         # gauge with last-writer-wins
         self.instance = instance
+        # seed the gauge at 0: the workers-missing alert matches on the
+        # series EXISTING with value 0 — a never-written gauge is an
+        # empty vector and the primary outage (no worker ever connected)
+        # would never fire it
+        _worker_streams.set(0, instance=instance)
         self._queue = RequestQueue(max_queued_per_tenant=max_queued_per_tenant)
         self._pending: dict[int, _Entry] = {}
         self._lock = threading.Lock()
