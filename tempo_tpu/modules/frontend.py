@@ -54,6 +54,10 @@ class FrontendConfig:
     # to invert); a per-request count still caps it for CPU-style
     # deployments with many worker processes behind few querier stubs
     batch_jobs_per_request: int | None = None
+    # querier shuffle-sharding on the pull dispatcher (reference
+    # queue.go querier awareness): cap how many worker streams one
+    # tenant's jobs spread over. 0 = off
+    max_queriers_per_tenant: int = 0
 
 
 def create_block_boundaries(shards: int) -> list[str]:

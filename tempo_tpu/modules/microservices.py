@@ -207,7 +207,9 @@ class ModuleProcess:
                                                  lambda a: QuerierClient(a)))
             if serves_grpc:
                 from .worker import PullDispatcher, PullQuerierPool
-                self.dispatcher = PullDispatcher(instance=self.id)
+                self.dispatcher = PullDispatcher(
+                    instance=self.id,
+                    max_queriers_per_tenant=cfg.frontend.max_queriers_per_tenant)
                 queriers = PullQuerierPool(self.dispatcher,
                                            fallback=push_clients)
             else:

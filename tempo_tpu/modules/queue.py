@@ -37,12 +37,18 @@ class RequestQueue:
     complementary memory bound on total queued sub-requests."""
 
     def __init__(self, max_outstanding_per_tenant: int = 64,
-                 max_queued_per_tenant: int = 100_000):
+                 max_queued_per_tenant: int = 100_000,
+                 filtered_consumers: bool = False):
         self.max_outstanding = max_outstanding_per_tenant
         # memory backpressure, complementary to the request cap: many
         # outstanding requests × many sub-requests each must not grow the
         # queue without bound
         self.max_queued = max_queued_per_tenant
+        # filtered_consumers: consumers pass accept predicates (querier
+        # shuffle-shard) — a single notify could land on an ineligible
+        # consumer and strand the item, so enqueue must wake everyone.
+        # Without filters, single notify keeps the hot path O(1)
+        self._filtered = filtered_consumers
         self._queues: OrderedDict[str, deque] = OrderedDict()
         self._outstanding: dict[str, int] = {}
         self._cv = threading.Condition()
@@ -78,14 +84,25 @@ class RequestQueue:
             if len(q) >= self.max_queued:
                 raise TooManyRequests(f"{tenant}: sub-request queue full")
             q.append(request)
-            self._cv.notify()
+            if self._filtered:
+                self._cv.notify_all()
+            else:
+                self._cv.notify()
 
-    def get(self, timeout: float | None = None):
+    def get(self, timeout: float | None = None, accept=None):
         """(tenant, request) or None on stop/timeout. Tenants are served
-        round-robin: the tenant we serve moves to the back."""
+        round-robin: the tenant we serve moves to the back. `accept` is
+        an optional tenant predicate — the pull dispatcher's querier
+        shuffle-sharding (a worker only drains tenants it is eligible
+        for); ineligible tenants stay queued for an eligible consumer."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._cv:
             while True:
                 for tenant in list(self._queues):
+                    if accept is not None and not accept(tenant):
+                        continue
                     q = self._queues[tenant]
                     if q:
                         req = q.popleft()
@@ -95,12 +112,29 @@ class RequestQueue:
                         return tenant, req
                 if self._stopped:
                     return None
-                if not self._cv.wait(timeout):
+                # absolute deadline, not a fresh window per wakeup: with
+                # filtered consumers every enqueue wakes everyone, and a
+                # per-wait timeout would never elapse under steady
+                # traffic — the caller's poll loop (and its
+                # is-stream-alive check) must run on schedule
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                left = deadline - _time.monotonic()
+                if left <= 0:
                     return None
+                self._cv.wait(left)
 
     def lengths(self) -> dict[str, int]:
         with self._cv:
             return {t: len(q) for t, q in self._queues.items()}
+
+    def kick(self) -> None:
+        """Wake every blocked consumer so accept predicates re-evaluate —
+        called when ELIGIBILITY changed without an enqueue (a worker
+        died and survivors inherited its tenants)."""
+        with self._cv:
+            self._cv.notify_all()
 
     def stop(self) -> None:
         with self._cv:

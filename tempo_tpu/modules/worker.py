@@ -25,6 +25,7 @@ import time
 from tempo_tpu import tempopb
 from tempo_tpu.observability import get_logger
 from tempo_tpu.observability.metrics import Counter, Gauge
+from tempo_tpu.utils.hashing import fnv1a_32
 
 from .queue import RequestQueue
 
@@ -64,21 +65,40 @@ class PullDispatcher:
 
     def __init__(self, max_redeliveries: int = 3,
                  max_queued_per_tenant: int = 100_000,
-                 instance: str = "default"):
+                 instance: str = "default",
+                 max_queriers_per_tenant: int = 0):
         # metric label: two dispatchers in one process (in-process test
         # topologies, embedded frontends) must not clobber each other's
         # gauge with last-writer-wins
         self.instance = instance
+        # querier shuffle-sharding (reference queue.go cortex lineage):
+        # cap how many worker streams one tenant's jobs spread over, so
+        # a tenant's pathological query can't heat every querier's HBM
+        # cache. 0 = off. Eligibility is rendezvous-hashed over the LIVE
+        # stream set, so worker death self-heals the shard
+        self.max_queriers_per_tenant = max_queriers_per_tenant
+        # (epoch, worker-id tuple): replaced wholesale under _lock on
+        # membership change, read WITHOUT the lock by the accept path —
+        # which runs under the queue's condition variable, where a
+        # dispatcher-lock acquire would serialize all dispatch traffic
+        self._shard_view: tuple[int, tuple[int, ...]] = (0, ())
+        # tenant → (epoch, eligible frozenset); bounded
+        from collections import OrderedDict
+        self._shard_cache: OrderedDict[str, tuple] = OrderedDict()
         # seed the gauge at 0: the workers-missing alert matches on the
         # series EXISTING with value 0 — a never-written gauge is an
         # empty vector and the primary outage (no worker ever connected)
         # would never fire it
         _worker_streams.set(0, instance=instance)
-        self._queue = RequestQueue(max_queued_per_tenant=max_queued_per_tenant)
+        self._queue = RequestQueue(
+            max_queued_per_tenant=max_queued_per_tenant,
+            filtered_consumers=max_queriers_per_tenant > 0)
         self._pending: dict[int, _Entry] = {}
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._workers = 0
+        self._worker_seq = itertools.count(1)
+        self._worker_ids: set[int] = set()
         self.max_redeliveries = max_redeliveries
         self.stopped = False
         self.delivered = 0   # results handed back to waiters
@@ -127,24 +147,64 @@ class PullDispatcher:
 
     # ---- stream-servicer-facing ----
 
-    def register_worker(self) -> None:
+    def register_worker(self) -> int:
         with self._lock:
             self._workers += 1
+            wid = next(self._worker_seq)
+            self._worker_ids.add(wid)
+            self._shard_view = (self._shard_view[0] + 1,
+                                tuple(self._worker_ids))
             _worker_streams.set(self._workers, instance=self.instance)
+            return wid
 
-    def unregister_worker(self) -> None:
+    def unregister_worker(self, worker_id: int | None = None) -> None:
         with self._lock:
             self._workers -= 1
+            self._worker_ids.discard(worker_id)
+            self._shard_view = (self._shard_view[0] + 1,
+                                tuple(self._worker_ids))
             _worker_streams.set(self._workers, instance=self.instance)
+        if self.max_queriers_per_tenant > 0:
+            # survivors inherit the dead worker's tenants NOW: blocked
+            # consumers must re-evaluate eligibility, not wait out their
+            # poll timeout on already-queued jobs
+            self._queue.kick()
 
-    def next_job(self, timeout: float | None = None):
+    def eligible(self, tenant: str, worker_id: int) -> bool:
+        """Querier shuffle-shard: is this worker in the tenant's top-S
+        rendezvous set over the LIVE streams? With sharding off, fewer
+        workers than S, or an unknown id, everyone is eligible. Cached
+        per tenant against the membership epoch, and lock-free on the
+        hot path (this runs inside the queue's condition variable)."""
+        s = self.max_queriers_per_tenant
+        if s <= 0:
+            return True
+        epoch, ids = self._shard_view  # atomic tuple read, no lock
+        if len(ids) <= s or worker_id not in ids:
+            return True
+        hit = self._shard_cache.get(tenant)
+        if hit is not None and hit[0] == epoch:
+            return worker_id in hit[1]
+        ranked = sorted(ids, key=lambda w: fnv1a_32(f"{tenant}/{w}".encode()))
+        shard = frozenset(ranked[:s])
+        self._shard_cache[tenant] = (epoch, shard)
+        while len(self._shard_cache) > 4096:
+            self._shard_cache.popitem(last=False)
+        return worker_id in shard
+
+    def next_job(self, timeout: float | None = None,
+                 worker_id: int | None = None):
         """Next live entry, tenant-fair; None on timeout/stop. Cancelled
-        entries (abandoned by their waiter) are skipped silently."""
+        entries (abandoned by their waiter) are skipped silently; with
+        shuffle-sharding on, a worker only drains eligible tenants."""
+        accept = None
+        if self.max_queriers_per_tenant > 0 and worker_id is not None:
+            accept = lambda t: self.eligible(t, worker_id)  # noqa: E731
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             left = None if deadline is None else max(
                 0.0, deadline - time.monotonic())
-            item = self._queue.get(timeout=left)
+            item = self._queue.get(timeout=left, accept=accept)
             if item is None:
                 return None
             _tenant, entry = item
@@ -199,11 +259,11 @@ def make_frontend_pull_handler(dispatcher: PullDispatcher):
     import grpc
 
     def process(request_iterator, context):
-        dispatcher.register_worker()
+        wid = dispatcher.register_worker()
         entry = None
         try:
             while True:
-                entry = dispatcher.next_job(timeout=0.5)
+                entry = dispatcher.next_job(timeout=0.5, worker_id=wid)
                 if entry is None:
                     if dispatcher.stopped or not context.is_active():
                         return
@@ -220,7 +280,7 @@ def make_frontend_pull_handler(dispatcher: PullDispatcher):
         finally:
             if entry is not None:
                 dispatcher.requeue(entry)
-            dispatcher.unregister_worker()
+            dispatcher.unregister_worker(wid)
 
     handler = grpc.stream_stream_rpc_method_handler(
         process,

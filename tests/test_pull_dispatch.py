@@ -337,3 +337,59 @@ def test_status_exposes_pull_dispatch_stats(tmp_path):
         assert set(pd) == {"workers", "queued", "delivered", "requeued"}
     finally:
         front.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# querier shuffle-sharding (reference queue.go querier awareness)
+
+
+def test_shuffle_shard_limits_tenant_to_subset_of_workers():
+    """With max_queriers_per_tenant=2 and 4 worker streams, a tenant's
+    jobs only ever pop on its 2 rendezvous-elected workers; another
+    tenant gets its own (generally different) pair."""
+    d = PullDispatcher(max_queriers_per_tenant=2)
+    wids = [d.register_worker() for _ in range(4)]
+    try:
+        for t in ("tenant-a", "tenant-b", "tenant-c"):
+            elig = [w for w in wids if d.eligible(t, w)]
+            assert len(elig) == 2, (t, elig)
+            # deterministic given the same live set
+            assert elig == [w for w in wids if d.eligible(t, w)]
+            # jobs for t pop ONLY on eligible workers
+            d.submit(t, tempopb.ProcessJob(kind="search_tags"))
+            for w in wids:
+                if w not in elig:
+                    assert d.next_job(timeout=0.02, worker_id=w) is None
+            entry = d.next_job(timeout=1.0, worker_id=elig[0])
+            assert entry is not None and entry.job.tenant_id == t
+        # shards differ across tenants (4 choose 2: collision possible
+        # for ONE pair, not all three identical)
+        shards = {t: tuple(w for w in wids if d.eligible(t, w))
+                  for t in ("tenant-a", "tenant-b", "tenant-c")}
+        assert len(set(shards.values())) >= 2, shards
+    finally:
+        d.stop()
+
+
+def test_shuffle_shard_heals_on_worker_death():
+    d = PullDispatcher(max_queriers_per_tenant=1)
+    w1 = d.register_worker()
+    w2 = d.register_worker()
+    try:
+        owner = w1 if d.eligible("t", w1) else w2
+        other = w2 if owner == w1 else w1
+        assert not d.eligible("t", other)
+        d.unregister_worker(owner)  # the tenant's only worker dies
+        # survivors inherit: with one live stream, it is always eligible
+        assert d.eligible("t", other)
+        d.submit("t", tempopb.ProcessJob(kind="search_tags"))
+        assert d.next_job(timeout=1.0, worker_id=other) is not None
+    finally:
+        d.stop()
+
+
+def test_shuffle_shard_off_by_default():
+    d = PullDispatcher()
+    w = d.register_worker()
+    assert d.eligible("anyone", w) and d.eligible("anyone", 999)
+    d.stop()
