@@ -74,6 +74,7 @@ class ServiceGraphProcessor:
         self._store: dict[tuple, tuple] = {}  # key -> (kind, svc, span, t)
         self._lock = threading.Lock()
         self.expired = 0
+        self._last_expire = 0.0
 
     def consume(self, batch: tempopb.ResourceSpans) -> None:
         svc = ""
@@ -89,12 +90,27 @@ class ServiceGraphProcessor:
                 elif span.kind == tempopb.Span.SPAN_KIND_SERVER:
                     key = (bytes(span.trace_id), bytes(span.parent_span_id))
                     self._pair(key, "server", svc, span, now)
-        self._expire(now)
+        # amortize: an O(store) expiry sweep per BATCH was a steady tax
+        # on the ack path; unpaired edges only need to age out at wait_s
+        # granularity, so sweep at most once per wait_s/4
+        if now - self._last_expire >= self.wait_s / 4:
+            self._last_expire = now
+            self._expire(now)
 
     def _pair(self, key, kind, svc, span, now) -> None:
         with self._lock:
             other = self._store.get(key)
             if other is None or other[0] == kind:
+                if len(self._store) >= self.max_items:
+                    # amortized expiry must not turn the cap into edge
+                    # loss: expired entries may be squatting the slots —
+                    # sweep NOW and retry the insert (inline expiry, the
+                    # lock is already held)
+                    dead = [k for k, v in self._store.items()
+                            if now - v[3] > self.wait_s]
+                    for k in dead:
+                        del self._store[k]
+                    self.expired += len(dead)
                 if len(self._store) < self.max_items:
                     self._store[key] = (
                         kind, svc, span.SerializeToString(), now

@@ -53,7 +53,35 @@ class _LiveTrace:
     segments: list = field(default_factory=list)
     nbytes: int = 0
     last_append: float = 0.0
-    search: SearchData | None = None
+    # encoded SearchData fragments, decoded+merged LAZILY: the ack path
+    # runs per push, while folding is only needed at live-search or cut
+    # time — decode-per-push was ~10% of distributor→ingester latency
+    search_raw: list = field(default_factory=list)
+    _search: SearchData | None = None
+
+    def search_data(self, tid: bytes) -> SearchData | None:
+        """Folded search entry (caches; drains the raw fragment list).
+        A corrupt fragment is DROPPED here, not raised: this runs inside
+        cut_complete_traces after the trace object is already appended —
+        an exception would leave the trace live, duplicate its WAL
+        append on every retry, and wedge the tenant's sweep forever."""
+        if self.search_raw:
+            raws, self.search_raw = self.search_raw, []
+            for raw in raws:
+                try:
+                    sd = decode_search_data(raw, tid)
+                except Exception:  # noqa: BLE001 — skip corrupt fragment
+                    from tempo_tpu.observability import get_logger
+
+                    get_logger().warning(
+                        "dropping corrupt search-data fragment for %s",
+                        tid.hex()[:16])
+                    continue
+                if self._search is None:
+                    self._search = sd
+                else:
+                    self._search.merge(sd)
+        return self._search
 
 
 @dataclass
@@ -115,11 +143,7 @@ class TenantInstance:
             t.last_append = time.monotonic()
             obs.live_traces.set(len(self.live), tenant=self.tenant)
             if search_data:
-                sd = decode_search_data(search_data, tid)
-                if t.search is None:
-                    t.search = sd
-                else:
-                    t.search.merge(sd)
+                t.search_raw.append(search_data)
 
     # ---- sweep / cut (reference CutCompleteTraces instance.go:222) ----
 
@@ -135,8 +159,9 @@ class TenantInstance:
                 obj = self.codec.to_object(t.segments)
                 r = self.codec.fast_range(obj) or (0, 0)
                 self.head.append(tid, obj, r[0], r[1])
-                if t.search is not None:
-                    self.head_search.append(tid, t.search)
+                sd = t.search_data(tid)
+                if sd is not None:
+                    self.head_search.append(tid, sd)
                 del self.live[tid]
                 cut += 1
             obs.live_traces.set(len(self.live), tenant=self.tenant)
@@ -249,7 +274,8 @@ class TenantInstance:
 
     def search(self, req, results: SearchResults) -> None:
         with self.lock:
-            live_sds = [t.search for t in self.live.values() if t.search]
+            live_sds = [sd for tid, t in self.live.items()
+                        if (sd := t.search_data(tid)) is not None]
             searches = [self.head_search] + [c.search for c in self.completing]
             recent = [m for m, _ in self.recent]
         for sd in live_sds:
@@ -273,9 +299,10 @@ class TenantInstance:
     def search_tags(self) -> set:
         tags = set()
         with self.lock:
-            for t in self.live.values():
-                if t.search:
-                    tags.update(t.search.kvs)
+            for tid, t in self.live.items():
+                sd = t.search_data(tid)
+                if sd is not None:
+                    tags.update(sd.kvs)
             for ssb in [self.head_search] + [c.search for c in self.completing]:
                 for sd in ssb.entries():
                     tags.update(sd.kvs)
@@ -285,7 +312,8 @@ class TenantInstance:
         vals: set[str] = set()
         size = 0
         with self.lock:
-            sds = [t.search for t in self.live.values() if t.search]
+            sds = [sd for tid, t in self.live.items()
+                   if (sd := t.search_data(tid)) is not None]
             for ssb in [self.head_search] + [c.search for c in self.completing]:
                 sds.extend(ssb.entries())
         for sd in sds:

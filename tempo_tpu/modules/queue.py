@@ -13,6 +13,7 @@ import contextvars
 import heapq
 import itertools
 import threading
+import time
 from collections import OrderedDict, deque
 
 
@@ -95,9 +96,7 @@ class RequestQueue:
         an optional tenant predicate — the pull dispatcher's querier
         shuffle-sharding (a worker only drains tenants it is eligible
         for); ineligible tenants stay queued for an eligible consumer."""
-        import time as _time
-
-        deadline = None if timeout is None else _time.monotonic() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while True:
                 for tenant in list(self._queues):
@@ -120,7 +119,7 @@ class RequestQueue:
                 if deadline is None:
                     self._cv.wait()
                     continue
-                left = deadline - _time.monotonic()
+                left = deadline - time.monotonic()
                 if left <= 0:
                     return None
                 self._cv.wait(left)

@@ -157,7 +157,7 @@ class PullDispatcher:
             _worker_streams.set(self._workers, instance=self.instance)
             return wid
 
-    def unregister_worker(self, worker_id: int | None = None) -> None:
+    def unregister_worker(self, worker_id: int) -> None:
         with self._lock:
             self._workers -= 1
             self._worker_ids.discard(worker_id)

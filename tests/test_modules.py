@@ -1215,3 +1215,25 @@ def test_trace_by_id_ingester_leg_concurrent():
     elapsed = time.monotonic() - t0
     assert resp.metrics.failed_blocks == 0
     assert elapsed < 0.9, f"replica leg additive ({elapsed:.2f}s)"
+
+
+def test_corrupt_search_fragment_does_not_wedge_sweep(tmp_path):
+    """A corrupt search_data blob is dropped at fold time; the trace
+    still cuts, flushes, and reads — sweep never wedges (code-review r4:
+    the lazy decode must not move a push-time reject into an infinite
+    completion retry)."""
+    app = _app(tmp_path)
+    ing = app.ingesters["ingester-0"]
+    tid = random_trace_id()
+    tr = make_trace(tid, seed=1)
+    app.push("t1", list(tr.batches))
+    # inject a corrupt fragment alongside the good one
+    from tempo_tpu.model.codec import segment_codec_for
+    codec = segment_codec_for("v2")
+    seg = codec.prepare_for_write(make_trace(tid, seed=2), 100, 200)
+    ing.instance("t1").push(tid, seg, search_data=b"\x01\x02garbage")
+
+    completed = app.flush_tick(force=True)
+    assert completed and completed[0].total_objects >= 1
+    app.poll_tick()
+    assert len(app.find_trace("t1", tid).trace.batches) > 0

@@ -242,9 +242,9 @@ def test_pull_pool_falls_back_to_push_clients():
     pool = PullQuerierPool(d, fallback=fallback)
     # no workers connected: indexes resolve to the push clients
     assert pool[0] == "push-client-0" and len(pool) == 2
-    d.register_worker()
+    wid = d.register_worker()
     assert isinstance(pool[0], PullQuerierStub) and len(pool) == 1
-    d.unregister_worker()
+    d.unregister_worker(wid)
     d.stop()
 
 
