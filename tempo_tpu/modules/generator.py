@@ -37,17 +37,26 @@ class SpanMetricsProcessor:
                                  "span latency (s)",
                                  buckets=LATENCY_BUCKETS_S, registry=registry)
 
+    # enum int → name, resolved once: proto .Name() does a descriptor
+    # lookup per call, and this runs per SPAN on the ack path
+    _KIND_NAMES = {v.number: v.name
+                   for v in tempopb.Span.SpanKind.DESCRIPTOR.values}
+    _STATUS_NAMES = {v.number: v.name
+                     for v in tempopb.Status.StatusCode.DESCRIPTOR.values}
+
     def consume(self, batch: tempopb.ResourceSpans) -> None:
         svc = ""
         for kv in batch.resource.attributes:
             if kv.key == "service.name":
                 svc = kv.value.string_value
+        kind_names, status_names = self._KIND_NAMES, self._STATUS_NAMES
         for ss in batch.scope_spans:
             for span in ss.spans:
                 labels = dict(
                     service=svc, span_name=span.name,
-                    span_kind=tempopb.Span.SpanKind.Name(span.kind),
-                    status_code=tempopb.Status.StatusCode.Name(span.status.code),
+                    span_kind=kind_names.get(span.kind, str(span.kind)),
+                    status_code=status_names.get(span.status.code,
+                                                 str(span.status.code)),
                 )
                 self.calls.inc(**labels)
                 dur_s = max(0, span.end_time_unix_nano
