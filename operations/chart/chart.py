@@ -256,8 +256,17 @@ def workloads(v) -> str:
                  "(modules/worker.py).\n"),
         grpc=True)
     compactor = _deployment(v, "compactor", r["compactor"], grpc=False)
+    generator = _deployment(
+        v, "metrics-generator", r["metrics_generator"],
+        comment=("# Standalone metrics-generator: the distributor ships "
+                 "span batches to it\n# over the MetricsGenerator/"
+                 "PushSpans gRPC service, routed per trace over\n# the "
+                 "generator ring (service-graph pairing is instance-"
+                 "local).\n"),
+        grpc=True)
     # compactor has no readiness dependency on peers; keep probe anyway
-    return "\n---\n".join([distributor, frontend, compactor]) + "\n"
+    return "\n---\n".join([distributor, frontend, compactor,
+                           generator]) + "\n"
 
 
 def ingester(v) -> str:

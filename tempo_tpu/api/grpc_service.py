@@ -22,6 +22,7 @@ from tempo_tpu import tempopb
 SERVICE_PUSHER = "tempopb.Pusher"
 SERVICE_QUERIER = "tempopb.Querier"
 SERVICE_INGESTER_QUERIER = "tempopb.IngesterQuerier"
+SERVICE_GENERATOR = "tempopb.MetricsGenerator"
 OTLP_SERVICE = "opentelemetry.proto.collector.trace.v1.TraceService"
 OTLP_EXPORT_METHOD = f"/{OTLP_SERVICE}/Export"
 
@@ -32,7 +33,7 @@ OTLP_EXPORT_METHOD = f"/{OTLP_SERVICE}/Export"
 
 def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
                             querier=None, otlp_push=None,
-                            frontend_dispatcher=None,
+                            frontend_dispatcher=None, generator=None,
                             max_workers: int = 16) -> grpc.Server:
     """gRPC server exposing only the services this process's modules back:
 
@@ -42,6 +43,7 @@ def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
       otlp_push — fn(tenant, batches) (OTLP receiver, distributor role)
       frontend_dispatcher — PullDispatcher (Frontend service: querier
                   workers pull jobs over the Process duplex stream)
+      generator — MetricsGenerator (PushSpans: distributor span forward)
     """
     from concurrent import futures
 
@@ -155,6 +157,18 @@ def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
                                       tempopb.SearchTagValuesResponse),
         }))
 
+    if generator is not None:
+        def push_spans(request, context):
+            generator.push_spans(_tenant_from(context),
+                                 list(request.batches))
+            return tempopb.PushResponse()
+
+        handlers.append(grpc.method_handlers_generic_handler(
+            SERVICE_GENERATOR, {
+                "PushSpans": _unary(push_spans, tempopb.PushSpansRequest,
+                                    tempopb.PushResponse),
+            }))
+
     if otlp_push is not None:
         def otlp_export(request, context):
             # request is wire-compatible ExportTraceServiceRequest; the empty
@@ -249,6 +263,17 @@ class PusherClient(_Base):
     def push_bytes(self, tenant: str, req: tempopb.PushBytesRequest) -> None:
         self._call(SERVICE_PUSHER, "PushBytes", req, tempopb.PushResponse,
                    tenant=tenant)
+
+
+class MetricsGeneratorClient(_Base):
+    """Distributor-side stub, duck-typed like MetricsGenerator (the
+    in-process forwarder target): push_spans(tenant, batches)."""
+
+    def push_spans(self, tenant: str, batches) -> None:
+        req = tempopb.PushSpansRequest()
+        req.batches.extend(batches)
+        self._call(SERVICE_GENERATOR, "PushSpans", req,
+                   tempopb.PushResponse, tenant=tenant)
 
 
 class IngesterClient(_Base):

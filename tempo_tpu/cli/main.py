@@ -108,7 +108,8 @@ def main(argv=None) -> int:
     proc = ModuleProcess(
         cfg, args.target, instance_id=instance_id,
         grpc_port=grpc_port if args.target in
-        ("ingester", "querier", "distributor", "query-frontend") else 0,
+        ("ingester", "querier", "distributor", "query-frontend",
+         "metrics-generator") else 0,
         http_port=http_port,
         memberlist_cfg=runtime["memberlist"],
     )

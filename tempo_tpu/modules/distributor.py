@@ -157,11 +157,20 @@ class Distributor:
                     )
 
     def _requests_by_trace_id(self, batches: list) -> tuple[dict, int]:
+        """Regroup + count spans_received (the ingest ack path). Callers
+        that only need the grouping (the generator forwarder re-routes
+        the same batches later, off the ack path) use regroup_by_trace —
+        counting here twice would double spans_received per push."""
+        out, n_spans = self.regroup_by_trace(batches)
+        self.metrics.spans_received += n_spans
+        return out, n_spans
+
+    @staticmethod
+    def regroup_by_trace(batches: list) -> tuple[dict, int]:
         """Regroup spans by trace id (reference distributor.go:442-516 —
         the hot loop: one trace's spans arrive scattered over resource
         batches; rebuild one Trace per id preserving resource/scope).
-        Returns (traces by id, span count) — the local count keeps
-        per-tenant metrics exact under concurrent pushes."""
+        Returns (traces by id, span count); no metric side effects."""
         out: dict[bytes, tempopb.Trace] = {}
         n_spans = 0
         for batch in batches:
@@ -192,5 +201,4 @@ class Distributor:
                         dss.scope.CopyFrom(ss.scope)
                         dss.schema_url = ss.schema_url
                     dss.spans.append(span)
-        self.metrics.spans_received += n_spans
         return out, n_spans

@@ -46,7 +46,7 @@ from .overrides import Overrides
 from .querier import Querier
 
 TARGETS = ("all", "distributor", "ingester", "querier", "query-frontend",
-           "compactor")
+           "compactor", "metrics-generator")
 
 
 class ClientDict:
@@ -153,7 +153,8 @@ class ModuleProcess:
 
         ml_cfg = dict(memberlist_cfg or {})
         adv_host = ml_cfg.get("advertise_host", "127.0.0.1")
-        needs_grpc = target in ("ingester", "querier", "distributor")
+        needs_grpc = target in ("ingester", "querier", "distributor",
+                                "metrics-generator")
         if needs_grpc and not grpc_port:
             raise ValueError("grpc_port must be set for gRPC-serving targets")
         # a query-frontend WITH a grpc_port serves the Frontend/Process
@@ -167,6 +168,8 @@ class ModuleProcess:
         self.querier = None
         self.distributor = None
         self.frontend = None
+        self.generator = None        # metrics-generator target
+        self.remote_write = None
         self.db = None
         self.grpc_server = None
         self.dispatcher = None       # query-frontend pull dispatch
@@ -191,11 +194,36 @@ class ModuleProcess:
         )
 
         if target == "distributor":
+            from tempo_tpu.api.grpc_service import MetricsGeneratorClient
+
             pushers = ClientDict(self.ml, "ingester",
                                  lambda a: PusherClient(a))
+            self._generator_clients = ClientDict(
+                self.ml, "metrics-generator",
+                lambda a: MetricsGeneratorClient(a))
             self.distributor = Distributor(
                 self.ml.ring("ingester"), pushers, self.overrides,
+                forwarder=self._forward_to_generators,
                 write_quorum=cfg.write_quorum)
+        elif target == "metrics-generator":
+            from .generator import MetricsGenerator
+
+            gen_cfg = cfg.metrics_generator or {}
+            self.generator = MetricsGenerator(
+                max_active_series=gen_cfg.get("max_active_series", 100_000))
+            rw = gen_cfg.get("remote_write") or {}
+            if rw.get("url"):
+                from .remote_write import RemoteWriteShipper
+
+                self.remote_write = RemoteWriteShipper(
+                    self.generator, rw["url"],
+                    spool_dir=gen_cfg.get(
+                        "spool_dir", f"{cfg.wal_dir}/{self.id}/remote-write"),
+                    interval_s=float(rw.get("interval_s", 15.0)),
+                    external_labels=rw.get("external_labels", {}),
+                    headers=rw.get("headers", {}),
+                )
+                self.remote_write.start()
         elif target == "querier":
             ingesters = ClientDict(self.ml, "ingester",
                                    lambda a: IngesterClient(a))
@@ -224,6 +252,7 @@ class ModuleProcess:
                 querier=self.querier,
                 otlp_push=self.push if self.distributor is not None else None,
                 frontend_dispatcher=self.dispatcher,
+                generator=self.generator,
                 max_workers=(cfg.frontend_grpc_max_workers
                              if self.dispatcher is not None else 16),
             )
@@ -256,6 +285,31 @@ class ModuleProcess:
 
         self._threads: list[threading.Thread] = []
         self._start_loops()
+
+    def _forward_to_generators(self, tenant: str, batches) -> None:
+        """Distributor → metrics-generator shipping (reference
+        distributor.go metrics_generator forwarder): route per TRACE over
+        the generator ring so a trace's client+server spans land on one
+        instance — service-graph pairing is instance-local state. Runs on
+        the forwarder's background thread, never the ack path; with no
+        generator in the ring the batches drop (the reference counts a
+        failure metric and moves on)."""
+        from tempo_tpu.modules.distributor import Distributor
+        from tempo_tpu.utils.hashing import token_for
+
+        ring = self.ml.ring("metrics-generator")
+        by_trace, _ = Distributor.regroup_by_trace(batches)
+        per_gen: dict[str, list] = {}
+        for tid, trace in by_trace.items():
+            owners = ring.get(token_for(tenant, tid), rf=1)
+            if not owners:
+                continue  # THIS trace unroutable; ship the rest
+            per_gen.setdefault(owners[0], []).extend(trace.batches)
+        for gid, gbatches in per_gen.items():
+            client = self._generator_clients.get(gid)
+            if client is None:
+                continue
+            client.push_spans(tenant, gbatches)
 
     # ---- the HTTPApi app-interface (api/http.py routes onto this) ----
 
@@ -306,6 +360,8 @@ class ModuleProcess:
             self.worker_manager.stop()
         if self.dispatcher is not None:
             self.dispatcher.stop()
+        if self.remote_write is not None:
+            self.remote_write.stop(final_ship=True)
         if self.tracer is not None:
             from tempo_tpu.observability import tracing
             self.tracer.shutdown()

@@ -28,6 +28,7 @@ SearchTagValuesResponse = tempo_pb2.SearchTagValuesResponse
 PartialsResponse = tempo_pb2.PartialsResponse
 ProcessJob = tempo_pb2.ProcessJob
 ProcessResult = tempo_pb2.ProcessResult
+PushSpansRequest = tempo_pb2.PushSpansRequest
 
 ResourceSpans = trace_pb2.ResourceSpans
 ScopeSpans = trace_pb2.ScopeSpans
@@ -44,7 +45,7 @@ __all__ = [
     "SearchResponse", "TraceSearchMetadata",
     "SearchMetrics", "SearchTagsRequest", "SearchTagsResponse",
     "SearchTagValuesRequest", "SearchTagValuesResponse", "PartialsResponse",
-    "ProcessJob", "ProcessResult",
+    "ProcessJob", "ProcessResult", "PushSpansRequest",
     "ResourceSpans", "ScopeSpans", "Span", "Status", "Resource",
     "KeyValue", "AnyValue", "trace_pb2", "tempo_pb2",
 ]

@@ -362,3 +362,64 @@ def test_membership_revival_rejoins_ring():
     finally:
         a.shutdown()
         b.shutdown()
+
+
+def test_metrics_generator_target_receives_forwarded_spans(topology):
+    """Standalone metrics-generator processes get span batches from the
+    distributor over the MetricsGenerator/PushSpans gRPC service, routed
+    per trace over the generator ring (reference tempo.proto:14-16 +
+    distributor metrics_generator forwarder)."""
+    cfg, mk, procs = topology
+    ing = mk("ingester", "ing-1")
+    seed = [ing.ml.gossip_addr]
+    gen = ModuleProcess(
+        cfg, "metrics-generator", instance_id="gen-1",
+        grpc_port=free_port(),
+        memberlist_cfg={"join": seed, "gossip_interval_s": 0.1,
+                        "suspect_timeout_s": 5.0},
+    )
+    procs.append(gen)
+    dist = mk("distributor", "dist-1", join=seed)
+
+    wait_for(lambda: dist.ready()
+             and len(dist.ml.members("metrics-generator")) == 1,
+             what="generator visible to distributor")
+
+    # traces with an explicit client→server parent link so the
+    # service-graph processor can PAIR an edge (make_trace spans carry
+    # no parent ids — spanmetrics alone would pass trivially)
+    for i in range(5):
+        tid = random_trace_id()
+        batches = []
+        client_sid = bytes([i + 1]) * 8
+        for svc, kind, sid, parent in (
+            ("shop", tempopb.Span.SPAN_KIND_CLIENT, client_sid, b""),
+            ("pay", tempopb.Span.SPAN_KIND_SERVER, bytes([99, i]) * 4,
+             client_sid),
+        ):
+            rs = tempopb.ResourceSpans()
+            kv = rs.resource.attributes.add()
+            kv.key = "service.name"
+            kv.value.string_value = svc
+            span = rs.scope_spans.add().spans.add()
+            span.trace_id = tid
+            span.span_id = sid
+            if parent:
+                span.parent_span_id = parent
+            span.name = f"op-{i}"
+            span.kind = kind
+            span.start_time_unix_nano = 1_600_000_000 * 10**9
+            span.end_time_unix_nano = span.start_time_unix_nano + 10**7
+            batches.append(rs)
+        dist.push("acme", batches)
+    dist.distributor.forward_flush()  # drain the async forwarder queue
+
+    def edge_paired():
+        exposition = gen.generator.collect("acme")
+        return ("traces_service_graph_request_total" in exposition
+                and 'client="shop"' in exposition)
+
+    wait_for(edge_paired, timeout_s=15,
+             what="service-graph edge paired on the generator target")
+    exposition = gen.generator.collect("acme")
+    assert "traces_spanmetrics_calls_total" in exposition
