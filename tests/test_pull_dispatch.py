@@ -393,3 +393,18 @@ def test_shuffle_shard_off_by_default():
     w = d.register_worker()
     assert d.eligible("anyone", w) and d.eligible("anyone", 999)
     d.stop()
+
+
+def test_dispatcher_queue_bound_raises_429_and_cleans_pending():
+    """The per-tenant sub-request memory bound propagates as
+    TooManyRequests (HTTP 429 at the API layer) and leaves no orphaned
+    pending entry."""
+    from tempo_tpu.modules.queue import TooManyRequests
+
+    d = PullDispatcher(max_queued_per_tenant=2)
+    d.submit("t", tempopb.ProcessJob(kind="search_tags"))
+    d.submit("t", tempopb.ProcessJob(kind="search_tags"))
+    with pytest.raises(TooManyRequests):
+        d.submit("t", tempopb.ProcessJob(kind="search_tags"))
+    assert len(d._pending) == 2  # the rejected job didn't leak
+    d.stop()
