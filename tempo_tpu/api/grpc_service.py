@@ -72,6 +72,12 @@ def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
         handlers.append(grpc.method_handlers_generic_handler(SERVICE_PUSHER, {
             "PushBytes": _unary(push_bytes, tempopb.PushBytesRequest,
                                 tempopb.PushResponse),
+            # the reference distributor calls PushBytesV2 for
+            # current-encoding segments (distributor.go:390); both names
+            # accept the same request here — this framework has no v1
+            # segment history to migrate from
+            "PushBytesV2": _unary(push_bytes, tempopb.PushBytesRequest,
+                                  tempopb.PushResponse),
         }))
 
     if ingester is not None:

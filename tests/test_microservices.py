@@ -423,3 +423,37 @@ def test_metrics_generator_target_receives_forwarded_spans(topology):
              what="service-graph edge paired on the generator target")
     exposition = gen.generator.collect("acme")
     assert "traces_spanmetrics_calls_total" in exposition
+
+
+def test_push_bytes_v2_method_name_accepted():
+    """The reference distributor dials Pusher/PushBytesV2 for
+    current-encoding segments; both method names serve the same
+    handler."""
+    import grpc
+
+    from tempo_tpu.api.grpc_service import make_module_grpc_server
+
+    got = []
+
+    class FakePusher:
+        def push_bytes(self, tenant, req):
+            got.append((tenant, list(req.ids)))
+
+    port = free_port()
+    server = make_module_grpc_server(f"127.0.0.1:{port}", pusher=FakePusher())
+    server.start()
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        for method in ("PushBytes", "PushBytesV2"):
+            rpc = ch.unary_unary(
+                f"/tempopb.Pusher/{method}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=tempopb.PushResponse.FromString)
+            req = tempopb.PushBytesRequest()
+            req.ids.append(b"\x01" * 16)
+            req.traces.append(b"seg")
+            rpc(req, metadata=(("x-scope-orgid", "t"),))
+        assert len(got) == 2 and all(t == "t" for t, _ in got)
+        ch.close()
+    finally:
+        server.stop(0)
