@@ -43,6 +43,7 @@ run ingester 3201 9096 7947
 run querier 3202 9097 7948
 run query-frontend 3203 9098 7949
 run compactor 3204 9099 7950
+run metrics-generator 3205 9100 7951
 echo "frontend API: http://127.0.0.1:3203  (OTLP gRPC ingest: 127.0.0.1:9095)"
 echo "stop: pkill -f tempo_tpu.cli.main"
 wait
