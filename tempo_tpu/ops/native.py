@@ -38,11 +38,16 @@ def _load():
                     _LIB = lib
                     return _LIB
                 except AttributeError:
-                    # stale .so missing a newer symbol — rebuild once, then
-                    # give up gracefully (fallback paths take over)
+                    # stale .so missing a newer REQUIRED symbol — rebuild
+                    # once, then give up gracefully (fallback paths take
+                    # over). The unlink is best-effort: a read-only
+                    # install must degrade, not crash the first caller
                     if not rebuilt:
                         rebuilt = True
-                        os.unlink(p)
+                        try:
+                            os.unlink(p)
+                        except OSError:
+                            pass
                         _try_build()
                         continue
                 except OSError:
@@ -80,6 +85,14 @@ def _bind(lib):
     lib.tt_zstd_compress.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
                                      ctypes.c_char_p, ctypes.c_size_t,
                                      ctypes.c_int]
+    # OPTIONAL symbol (added r4): a stale .so without it must still bind
+    # — zstd_decompress falls back to the grow loop, nothing is lost
+    try:
+        lib.tt_zstd_content_size.restype = ctypes.c_longlong
+        lib.tt_zstd_content_size.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_size_t]
+    except AttributeError:
+        pass
     lib.tt_xxhash64.restype = ctypes.c_ulonglong
     lib.tt_xxhash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_ulonglong]
     lib.tt_crc32c.restype = ctypes.c_uint
@@ -118,10 +131,28 @@ def zstd_compress(data: bytes, level: int = 3) -> bytes:
     return _roundtrip("tt_zstd_compress", data, len(data) + (len(data) >> 6) + 1024, level)
 
 
+# corrupt/hostile frame headers must not drive allocations: nothing we
+# write exceeds this (pages ~1 MiB, completion flush 30 MiB)
+_ZSTD_MAX_ONESHOT = 1 << 30
+
+
 def zstd_decompress(data: bytes) -> bytes:
-    # zstd frames carry their content size; the native side returns -2 only
-    # when the frame declares a size larger than our bound — grow just then.
-    # -1 (corrupt input) fails immediately.
+    # frames from our compressor declare their content size: allocate
+    # EXACTLY once. The 32x-guess-and-grow loop (which zeroed a 32 MB
+    # buffer per 1 MB page) remains for sizeless/concatenated foreign
+    # frames, stale libraries without the size symbol, and declared
+    # sizes a corrupt header inflated past the sanity cap.
+    size_fn = getattr(_load(), "tt_zstd_content_size", None)
+    if size_fn is not None:
+        size = size_fn(data, len(data))
+        if 0 <= size <= _ZSTD_MAX_ONESHOT:
+            try:
+                return _roundtrip("tt_zstd_decompress", data,
+                                  max(1, int(size)))
+            except NativeBufferTooSmall:
+                pass  # multi-frame input: header size < total output
+        elif size == -1:
+            raise RuntimeError("zstd decompress failed: not a zstd frame")
     bound = max(1 << 16, len(data) * 32)
     for _ in range(4):
         try:
