@@ -110,11 +110,19 @@ def cmd_search(be, args):
     from tempo_tpu.search import SearchResults
     from tempo_tpu.search.backend_search_block import BackendSearchBlock
 
+    from tempo_tpu.api.params import _duration_ms
+
     req = tempopb.SearchRequest()
     for pair in args.tags or []:
         k, _, v = pair.partition("=")
         req.tags[k] = v
     req.limit = args.limit
+    if args.min_duration:
+        req.min_duration_ms = _duration_ms(args.min_duration)
+    if args.max_duration:
+        req.max_duration_ms = _duration_ms(args.max_duration)
+    req.start = args.start
+    req.end = args.end
     results = SearchResults(limit=args.limit)
     for bid in be.list_blocks(args.tenant):
         try:
@@ -155,6 +163,11 @@ def main(argv=None) -> int:
     sp.add_argument("tenant")
     sp.add_argument("--tags", nargs="*")
     sp.add_argument("--limit", type=int, default=20)
+    sp.add_argument("--min-duration", default="",
+                    help="e.g. 100ms, 1.5s (api/params duration syntax)")
+    sp.add_argument("--max-duration", default="")
+    sp.add_argument("--start", type=int, default=0, help="unix seconds")
+    sp.add_argument("--end", type=int, default=0)
 
     args = p.parse_args(argv)
     be = LocalBackend(args.backend_path)

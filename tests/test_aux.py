@@ -194,6 +194,13 @@ def test_cli_block_tooling(tmp_path, capsys):
 
     assert cli.main(["--backend-path", str(tmp_path / "be"),
                      "search", "t1", "--tags", "component=db"]) == 0
+    capsys.readouterr()
+
+    # duration/window filters parse and apply (a 1h floor excludes all)
+    assert cli.main(["--backend-path", str(tmp_path / "be"),
+                     "search", "t1", "--min-duration", "3600s"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert not out.get("traces")
 
 
 # ---- vulture ----
