@@ -116,19 +116,33 @@ class TenantIndex:
     compacted: list = field(default_factory=list)        # list[CompactedBlockMeta]
 
     def to_bytes(self) -> bytes:
-        doc = {
-            "created_at": self.created_at or int(time.time()),
+        content = json.dumps({
             "metas": [asdict(m) for m in self.metas],
             "compacted": [
                 {"meta": asdict(c.meta), "compacted_time": c.compacted_time}
                 for c in self.compacted
             ],
-        }
-        return gzip.compress(json.dumps(doc).encode())
+        })
+        import hashlib
+
+        # content digest FIRST in the document: created_at changes on
+        # every builder cycle (it doubles as the builder heartbeat), so
+        # readers dedupe re-parses by this digest — extractable from the
+        # head of the gunzipped bytes without a full json parse
+        digest = hashlib.sha256(content.encode()).hexdigest()
+        head = json.dumps({
+            "content_digest": digest,
+            "created_at": self.created_at or int(time.time()),
+        })
+        return gzip.compress((head[:-1] + ", " + content[1:]).encode())
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "TenantIndex":
-        d = json.loads(gzip.decompress(data))
+        return cls.from_json_bytes(gzip.decompress(data))
+
+    @classmethod
+    def from_json_bytes(cls, text: bytes) -> "TenantIndex":
+        d = json.loads(text)
         return cls(
             created_at=d.get("created_at", 0),
             metas=[BlockMeta(**{

@@ -41,9 +41,17 @@ class Blocklist:
 
     def apply_poll_results(self, metas: dict, compacted: dict) -> None:
         with self._lock:
-            self._metas = {t: list(ms) for t, ms in metas.items()}
-            self._compacted = {t: list(cs) for t, cs in compacted.items()}
-            self._epoch += 1
+            new_m = {t: list(ms) for t, ms in metas.items()}
+            new_c = {t: list(cs) for t, cs in compacted.items()}
+            # bump the epoch ONLY on real change: every epoch-keyed memo
+            # downstream (frontend job templates, batcher plans) dies on
+            # a bump, so an unconditional bump made each steady-state
+            # poll re-pay the O(blocks) planning the memos exist to
+            # avoid. Metas are dataclasses; equality is field-wise.
+            if new_m != self._metas or new_c != self._compacted:
+                self._epoch += 1
+            self._metas = new_m
+            self._compacted = new_c
 
     def update(self, tenant: str, add=None, remove=None, add_compacted=None) -> None:
         """Staged update between polls (compaction results)."""

@@ -10,7 +10,10 @@ the index is stale or missing.
 
 from __future__ import annotations
 
+import gzip
+import re
 import time
+import zlib
 
 from tempo_tpu.backend.raw import RawBackend, BackendError, DoesNotExist
 from tempo_tpu.backend.types import (
@@ -21,6 +24,14 @@ from tempo_tpu.backend.types import (
 )
 from .pool import run_jobs
 
+# head of the builder-written index document: content digest (dedupes
+# reader re-parses) then created_at (the builder heartbeat). Coupled to
+# TenantIndex.to_bytes's layout — a round-trip test in test_db pins it,
+# so a serializer change fails loudly instead of silently disabling the
+# dedupe
+INDEX_HEAD_RE = re.compile(
+    rb'^\{"content_digest": "([0-9a-f]{64})", "created_at": (\d+)')
+
 
 class Poller:
     def __init__(self, backend: RawBackend, build_index: bool = True,
@@ -29,12 +40,21 @@ class Poller:
         self.build_index = build_index
         self.stale_index_s = stale_index_s
         self.concurrency = concurrency
+        # tenant → (raw index digest, parsed TenantIndex): a reader's
+        # steady-state poll re-reads an UNCHANGED index object — hash
+        # the bytes and reuse the parse instead of re-building 10K
+        # BlockMeta objects every 30s
+        self._index_cache: dict[str, tuple[bytes, TenantIndex]] = {}
 
     def poll(self) -> tuple[dict, dict]:
         """Returns ({tenant: [BlockMeta]}, {tenant: [CompactedBlockMeta]})."""
         metas: dict[str, list[BlockMeta]] = {}
         compacted: dict[str, list[CompactedBlockMeta]] = {}
-        for tenant in self.backend.list_tenants():
+        tenants = list(self.backend.list_tenants())
+        # deleted tenants must not pin their parsed indexes forever
+        for gone in set(self._index_cache) - set(tenants):
+            del self._index_cache[gone]
+        for tenant in tenants:
             m, c = self.poll_tenant(tenant)
             metas[tenant] = m
             compacted[tenant] = c
@@ -54,12 +74,33 @@ class Poller:
 
     def _read_index(self, tenant: str) -> TenantIndex | None:
         try:
-            idx = TenantIndex.from_bytes(
-                self.backend.read(tenant, None, NAME_TENANT_INDEX)
-            )
-        except (BackendError, ValueError):
+            raw = self.backend.read(tenant, None, NAME_TENANT_INDEX)
+        except BackendError:
             return None
-        if self.stale_index_s and time.time() - idx.created_at > self.stale_index_s:
+        try:
+            text = gzip.decompress(raw)
+        except (OSError, EOFError, zlib.error):
+            return None  # torn/corrupt index: fall back to direct poll
+        # extract content_digest + created_at from the document HEAD (the
+        # builder writes them first) — created_at advances every builder
+        # cycle as a heartbeat, so only the digest can dedupe re-parses
+        m = INDEX_HEAD_RE.match(text[:128])
+        created_at = None
+        idx = None
+        if m is not None:
+            digest, created_at = m.group(1), int(m.group(2))
+            hit = self._index_cache.get(tenant)
+            if hit is not None and hit[0] == digest:
+                idx = hit[1]
+        if idx is None:
+            try:
+                idx = TenantIndex.from_json_bytes(text)
+            except ValueError:
+                return None
+            if m is not None:
+                self._index_cache[tenant] = (digest, idx)
+            created_at = idx.created_at
+        if self.stale_index_s and time.time() - created_at > self.stale_index_s:
             return None
         return idx
 

@@ -704,3 +704,117 @@ def test_prewarm_stages_before_first_query(tmp_path):
     r = db.search("t1", req).response()
     assert len(r.traces) == 12
     assert obs.batch_cache_events.value(result="hit") > h0  # no staging
+
+
+# ---------------------------------------------------------------------------
+# steady-state poll economics (r4): unchanged corpus must not churn memos
+
+
+def test_blocklist_epoch_stable_when_poll_unchanged():
+    from tempo_tpu.backend.types import BlockMeta
+    from tempo_tpu.db.blocklist import Blocklist
+
+    bl = Blocklist()
+    metas = {"t1": [BlockMeta(tenant_id="t1", block_id="b1"),
+                    BlockMeta(tenant_id="t1", block_id="b2")]}
+    bl.apply_poll_results(metas, {"t1": []})
+    e1 = bl.epoch()
+    # identical content (fresh objects) -> same epoch: frontend job
+    # templates and batcher plans keyed on it stay valid
+    bl.apply_poll_results(
+        {"t1": [BlockMeta(tenant_id="t1", block_id="b1"),
+                BlockMeta(tenant_id="t1", block_id="b2")]}, {"t1": []})
+    assert bl.epoch() == e1
+    # real change bumps
+    bl.apply_poll_results(
+        {"t1": [BlockMeta(tenant_id="t1", block_id="b3")]}, {"t1": []})
+    assert bl.epoch() == e1 + 1
+
+
+def test_poller_reader_dedupes_index_parse(tmp_backend_dir):
+    import time as _t
+
+    from tempo_tpu.backend import LocalBackend
+    from tempo_tpu.backend.types import (BlockMeta, TenantIndex,
+                                         NAME_TENANT_INDEX)
+    from tempo_tpu.db.poller import Poller
+
+    be = LocalBackend(tmp_backend_dir)
+    metas = [BlockMeta(tenant_id="t1", block_id=f"b{i}") for i in range(5)]
+
+    def write_index(ts):
+        be.write("t1", None, NAME_TENANT_INDEX,
+                 TenantIndex(created_at=ts, metas=metas).to_bytes())
+
+    write_index(int(_t.time()))
+    reader = Poller(be, build_index=False)
+    m1, _ = reader.poll_tenant("t1")
+    # builder heartbeat: same CONTENT, new created_at → the reader must
+    # reuse its parse (identity), not rebuild 10K metas every 30s
+    write_index(int(_t.time()) + 1)
+    m2, _ = reader.poll_tenant("t1")
+    assert m2 is m1, "unchanged index content was re-parsed"
+    # content change invalidates
+    metas.append(BlockMeta(tenant_id="t1", block_id="b-new"))
+    write_index(int(_t.time()) + 2)
+    m3, _ = reader.poll_tenant("t1")
+    assert m3 is not m1 and len(m3) == 6
+
+
+def test_poller_staleness_honored_with_cached_content(tmp_backend_dir):
+    import time as _t
+
+    from tempo_tpu.backend import LocalBackend
+    from tempo_tpu.backend.types import (BlockMeta, TenantIndex,
+                                         NAME_TENANT_INDEX)
+    from tempo_tpu.db.poller import Poller
+
+    be = LocalBackend(tmp_backend_dir)
+    # ONE meta object reused across writes: BlockMeta() takes a random
+    # block id, and differing content would turn the second read into a
+    # cache MISS — the point is the cache-HIT + stale-heartbeat path
+    meta = BlockMeta(tenant_id="t1", block_id="b-fixed")
+    be.write("t1", None, NAME_TENANT_INDEX,
+             TenantIndex(created_at=int(_t.time()),
+                         metas=[meta]).to_bytes())
+    reader = Poller(be, build_index=False, stale_index_s=60)
+    assert reader._read_index("t1") is not None
+    # a DEAD builder: created_at stops advancing; even with the content
+    # cached (same digest), staleness must still trip — the heartbeat
+    # rides the document head, not the parse
+    be.write("t1", None, NAME_TENANT_INDEX,
+             TenantIndex(created_at=int(_t.time()) - 3600,
+                         metas=[meta]).to_bytes())
+    assert reader._read_index("t1") is None
+
+
+def test_tenant_index_head_format_pinned():
+    """The reader's head regex is byte-coupled to TenantIndex.to_bytes;
+    a serializer change must fail HERE, not silently disable the
+    re-parse dedupe."""
+    import gzip as _gzip
+
+    from tempo_tpu.backend.types import BlockMeta, TenantIndex
+    from tempo_tpu.db.poller import INDEX_HEAD_RE
+
+    b = TenantIndex(created_at=42,
+                    metas=[BlockMeta(tenant_id="t")]).to_bytes()
+    m = INDEX_HEAD_RE.match(_gzip.decompress(b)[:128])
+    assert m is not None, "index head no longer matches the reader regex"
+    assert int(m.group(2)) == 42
+
+
+def test_poller_torn_index_falls_back(tmp_backend_dir):
+    from tempo_tpu.backend import LocalBackend
+    from tempo_tpu.backend.types import (BlockMeta, TenantIndex,
+                                         NAME_TENANT_INDEX)
+    from tempo_tpu.db.poller import Poller
+
+    be = LocalBackend(tmp_backend_dir)
+    good = TenantIndex(created_at=1,
+                       metas=[BlockMeta(tenant_id="t1")]).to_bytes()
+    be.write("t1", None, NAME_TENANT_INDEX, good[:-8])  # torn gzip tail
+    reader = Poller(be, build_index=False)
+    assert reader._read_index("t1") is None  # graceful, not EOFError
+    m, c = reader.poll_tenant("t1")  # falls back to direct block poll
+    assert m == [] and c == []
