@@ -9,6 +9,7 @@ is the commit record for a block (SURVEY.md §1 invariant, §5 checkpoint).
 from __future__ import annotations
 
 import gzip
+import hashlib
 import json
 import time
 import uuid
@@ -123,8 +124,6 @@ class TenantIndex:
                 for c in self.compacted
             ],
         })
-        import hashlib
-
         # content digest FIRST in the document: created_at changes on
         # every builder cycle (it doubles as the builder heartbeat), so
         # readers dedupe re-parses by this digest — extractable from the

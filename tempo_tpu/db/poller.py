@@ -64,7 +64,10 @@ class Poller:
         if not self.build_index:
             idx = self._read_index(tenant)
             if idx is not None:
-                return idx.metas, idx.compacted
+                # shallow copies: consumers may sort/mutate their lists;
+                # the cached parse must stay pristine (its digest would
+                # still match, so corruption would never self-heal)
+                return list(idx.metas), list(idx.compacted)
             # stale/missing index: fall through to a direct poll
         m, c = self._poll_tenant_blocks(tenant)
         if self.build_index:

@@ -25,6 +25,12 @@ QUERY_MODE_ALL = "all"
 
 
 class Querier:
+    # blocks consulted by the tag endpoints' backend leg, newest first.
+    # The reference answers tags from INGESTERS only (querier.go); the
+    # block leg here is a richer answer but must not stage a 10K-block
+    # corpus through the 64-entry container LRU per tags call
+    TAG_BLOCKS_LIMIT = 100
+
     def __init__(self, db: TempoDB, ring: Ring, ingesters: dict,
                  overrides: Overrides | None = None,
                  external_endpoints: list | None = None,
@@ -231,6 +237,16 @@ class Querier:
 
     # ---- tags ----
 
+    def _tag_blocks(self, tenant: str):
+        """Newest blocks first, capped: recent blocks carry the live tag
+        universe; a full-corpus container sweep per tags call would
+        thrash the staging LRU at scale."""
+        import heapq
+
+        return heapq.nlargest(self.TAG_BLOCKS_LIMIT,
+                              self.db.blocklist.metas(tenant),
+                              key=lambda m: m.end_time or 0)
+
     def search_tags(self, tenant: str) -> tempopb.SearchTagsResponse:
         tags: set[str] = set()
         for ing in self.ingesters.values():
@@ -238,7 +254,7 @@ class Querier:
                 tags.update(ing.search_tags(tenant))
             except Exception:  # noqa: BLE001 — replica failure → partial tags
                 continue
-        for m in self.db.blocklist.metas(tenant):
+        for m in self._tag_blocks(tenant):
             try:
                 sp = self.db._search_block_for(m).staged()  # noqa: SLF001
                 tags.update(sp.pages.key_dict)
@@ -258,7 +274,7 @@ class Querier:
                     tenant, tag, lim.max_bytes_per_tag_values))
             except Exception:  # noqa: BLE001 — replica failure → partial values
                 continue
-        for m in self.db.blocklist.metas(tenant):
+        for m in self._tag_blocks(tenant):
             try:
                 sp = self.db._search_block_for(m).staged()  # noqa: SLF001
             except Exception:  # noqa: BLE001

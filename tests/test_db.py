@@ -750,15 +750,20 @@ def test_poller_reader_dedupes_index_parse(tmp_backend_dir):
     reader = Poller(be, build_index=False)
     m1, _ = reader.poll_tenant("t1")
     # builder heartbeat: same CONTENT, new created_at → the reader must
-    # reuse its parse (identity), not rebuild 10K metas every 30s
+    # reuse its PARSE (same meta objects inside a fresh list — callers
+    # may sort their copy without corrupting the cache)
     write_index(int(_t.time()) + 1)
     m2, _ = reader.poll_tenant("t1")
-    assert m2 is m1, "unchanged index content was re-parsed"
+    assert m2 is not m1 and m2[0] is m1[0], "unchanged index re-parsed"
+    # a consumer mutating its returned list must not poison the cache
+    m2.clear()
+    m2b, _ = reader.poll_tenant("t1")
+    assert len(m2b) == 5
     # content change invalidates
     metas.append(BlockMeta(tenant_id="t1", block_id="b-new"))
     write_index(int(_t.time()) + 2)
     m3, _ = reader.poll_tenant("t1")
-    assert m3 is not m1 and len(m3) == 6
+    assert m3[0] is not None and len(m3) == 6
 
 
 def test_poller_staleness_honored_with_cached_content(tmp_backend_dir):

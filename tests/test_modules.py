@@ -1237,3 +1237,29 @@ def test_corrupt_search_fragment_does_not_wedge_sweep(tmp_path):
     assert completed and completed[0].total_objects >= 1
     app.poll_tick()
     assert len(app.find_trace("t1", tid).trace.batches) > 0
+
+
+def test_tag_endpoints_cap_block_sweep(tmp_path):
+    """Tag queries consult the newest TAG_BLOCKS_LIMIT blocks, not the
+    whole corpus — a 10K-block tenant must not stage every container
+    through the 64-entry LRU per tags call."""
+    from tempo_tpu.modules.querier import Querier
+
+    db, _ = _frontend_db(tmp_path, n_blocks=6, per_block=10)
+    q = Querier(db, Ring(), {})
+    q.TAG_BLOCKS_LIMIT = 3
+    staged = []
+    orig = db._search_block_for
+
+    def counting(m):
+        staged.append(m.block_id)
+        return orig(m)
+
+    db._search_block_for = counting
+    resp = q.search_tags("t1")
+    assert resp.tag_names  # still answers
+    assert len(set(staged)) <= 3, staged
+    # the consulted blocks are the NEWEST by end_time
+    metas = sorted(db.blocklist.metas("t1"),
+                   key=lambda m: m.end_time or 0, reverse=True)
+    assert set(staged) <= {m.block_id for m in metas[:3]}
