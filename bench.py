@@ -24,6 +24,13 @@ import time
 
 import numpy as np
 
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tempo_tpu.utils.jaxenv import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms(required=True)  # bench WILL use jax: fail loudly
+
 
 def build_corpus(n_entries: int, E: int = 1024, C: int = 4, seed: int = 7):
     """Synthesize ColumnarPages-shaped arrays directly (fast, numpy) —
@@ -610,7 +617,36 @@ def bench_high_cardinality(n_entries, cardinality, iters):
     return rate, int(count), compile_ms
 
 
+def _watchdog(limit_s: float = 1500.0):
+    """A wedged accelerator tunnel hangs the first device op in C code
+    (uninterruptible); without this the bench emits NOTHING and the
+    harness records silence. Emit an honest failure line and hard-exit
+    instead. 0 disables (the convention the other BENCH_* knobs use)."""
+    import threading
+
+    if limit_s <= 0:
+        class _Noop:
+            def cancel(self):
+                pass
+        return _Noop()
+
+    def fire():
+        print(json.dumps({
+            "metric": "columnar_tag_scan_throughput", "value": 0,
+            "unit": "traces/s", "vs_baseline": 0,
+            "error": f"bench watchdog: no completion within {limit_s}s — "
+                     "device tunnel likely unhealthy",
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(limit_s, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
+    watchdog = _watchdog(float(os.environ.get("BENCH_WATCHDOG_S", 1500)))
     n_entries = int(os.environ.get("BENCH_ENTRIES", 1_000_000))
     iters = int(os.environ.get("BENCH_ITERS", 20))
     n_blocks = int(os.environ.get("BENCH_BLOCKS", 100))
@@ -656,6 +692,7 @@ def main():
         int(os.environ.get("BENCH_LARGE_ITERS", 3)))
         if large_blocks else None)
 
+    watchdog.cancel()
     print(json.dumps({
         "metric": "columnar_tag_scan_throughput",
         "value": round(tpu_rate),

@@ -23,20 +23,12 @@ import signal
 import threading
 import uuid
 
-# honor JAX_PLATFORMS via jax.config BEFORE anything touches a device:
-# in images whose sitecustomize registers a TPU PJRT plugin, the env var
-# alone does not stop jax from handshaking the plugin at backend init —
-# a cpu-targeted process (tests, CPU-only targets) would then hang the
-# moment the accelerator tunnel is unhealthy. jax.config.update is the
-# filter that actually prevents the plugin init (same trick as
-# tests/conftest.py).
-if os.environ.get("JAX_PLATFORMS"):
-    try:
-        import jax
+# BEFORE anything touches a device (see utils/jaxenv.py: the env var
+# alone does not stop a registered TPU plugin from handshaking its
+# tunnel; jax stays optional for write-only targets → required=False)
+from tempo_tpu.utils.jaxenv import honor_jax_platforms
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    except Exception:  # noqa: BLE001 — jax optional for write-only targets
-        pass
+honor_jax_platforms()
 
 from tempo_tpu.api import HTTPApi, make_grpc_server, serve_http
 from tempo_tpu.modules import App

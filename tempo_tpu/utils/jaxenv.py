@@ -1,0 +1,35 @@
+"""Make JAX_PLATFORMS actually stick.
+
+In images whose sitecustomize registers a TPU PJRT plugin, the env var
+alone does not stop jax from handshaking the plugin's tunnel at backend
+init — a cpu-targeted process then hangs on its first device op
+whenever the tunnel is unhealthy. `jax.config.update("jax_platforms",
+...)` is the filter that really prevents the plugin init; this helper
+applies it from the env var, once, for every entry point (cli/main,
+bench.py, __graft_entry__ — tests/conftest.py and parallel/multihost.py
+carry their own variants with extra device-count settings).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def honor_jax_platforms(required: bool = False) -> None:
+    """Apply JAX_PLATFORMS (if set) through jax.config. `required=True`
+    surfaces failures loudly — entry points that WILL use jax must not
+    silently proceed into the hang this guard exists to prevent."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+    except Exception as e:  # noqa: BLE001
+        msg = f"warning: could not apply JAX_PLATFORMS={want!r} ({e}); " \
+              "device init may target an unintended platform"
+        print(msg, file=sys.stderr)
+        if required:
+            raise
