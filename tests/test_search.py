@@ -368,3 +368,50 @@ def test_compile_cache_skips_dictionary_probe():
     # uncached path still works (no cache_on)
     cq3 = pipeline.compile_query(pages.key_dict, pages.val_dict, req)
     assert (cq3.term_keys == cq1.term_keys).all()
+
+
+def test_engine_randomized_differential_vs_oracle():
+    """Property fuzz: random corpora × random predicates × random page
+    geometry must agree EXACTLY with the host oracle — fixed query lists
+    miss edge interactions (empty windows, dur bounds at the sample
+    values, substring terms matching zero/all dictionary entries)."""
+    rng = random.Random(1234)
+    for round_ in range(25):
+        entries = _corpus(n=rng.randint(1, 300), seed=rng.randint(0, 10**6))
+        E = rng.choice([8, 64, 256])
+        C = rng.choice([4, 8, 16])
+        pages = ColumnarPages.build(entries, PageGeometry(E, C))
+
+        tags = {}
+        for _ in range(rng.randint(0, 3)):
+            k = rng.choice(["service.name", "http.status_code", "region",
+                            "component", "nope.key"])
+            v = rng.choice(["front", "frontend", "cart", "5", "500", "us",
+                            "db", "zz-none", ""])
+            if v:
+                tags[k] = v
+        kw = {}
+        if rng.random() < 0.5:
+            kw["min_duration_ms"] = rng.choice([1, 500, 5_000, 30_000])
+        if rng.random() < 0.5:
+            kw["max_duration_ms"] = rng.choice([100, 5_000, 60_000])
+        if rng.random() < 0.5:
+            kw["start"] = 1_600_000_000 + rng.randint(-50, 400)
+            kw["end"] = kw["start"] + rng.randint(0, 300)
+        req = _mk_req(tags, **kw)
+        req.limit = 1000
+
+        expected = {sd.trace_id for sd in entries
+                    if search_data_matches(sd, req)}
+        cq = compile_query(pages.key_dict, pages.val_dict, req)
+        if cq is None:
+            assert not expected, (round_, tags, kw)
+            continue
+        eng = ScanEngine(top_k=1024)
+        count, inspected, scores, idx = eng.scan(pages, cq)
+        assert count == len(expected), (round_, tags, kw)
+        assert inspected == len(entries)
+        sp = stage(pages)
+        got = {bytes.fromhex(m.trace_id)
+               for m in eng.results(sp, cq, scores, idx)}
+        assert got == expected, (round_, tags, kw)
