@@ -823,3 +823,57 @@ def test_poller_torn_index_falls_back(tmp_backend_dir):
     assert reader._read_index("t1") is None  # graceful, not EOFError
     m, c = reader.poll_tenant("t1")  # falls back to direct block poll
     assert m == [] and c == []
+
+
+def test_serving_path_randomized_differential(tmp_path):
+    """End-to-end fuzz: random traces across several blocks, random
+    predicates, `TempoDB.search` must return exactly the proto-oracle
+    match set — extraction, container build, batch planning, staging,
+    kernel, and merge all in the loop."""
+    import random as _random
+
+    from tempo_tpu.model.matches import matches as proto_matches
+
+    rng = _random.Random(77)
+    be = LocalBackend(str(tmp_path / "be"))
+    db = TempoDB(be, str(tmp_path / "wal"),
+                 TempoDBConfig(compaction_window_s=10**10,
+                               retention_s=10**10))
+    codec = codec_for("v2")
+    traces = {}
+    for blk in range(4):
+        objs, search_entries = [], []
+        for i in range(rng.randint(5, 40)):
+            tid = random_trace_id()
+            tr = make_trace(tid, seed=rng.randint(0, 10**6))
+            traces[tid] = tr
+            from tempo_tpu.model.matches import trace_range_ns
+            s_ns, e_ns = trace_range_ns(tr)
+            objs.append((tid, codec.marshal(tr, s_ns // 10**9, e_ns // 10**9),
+                         s_ns // 10**9, e_ns // 10**9))
+            search_entries.append(extract_search_data(tid, tr))
+        order = sorted(range(len(objs)), key=lambda k: objs[k][0])
+        db.write_block_direct(
+            "t1", [objs[k] for k in order],
+            search_entries=[search_entries[k] for k in order])
+    db.poll()
+
+    from tests.test_search import _mk_req
+    for round_ in range(12):
+        tags = {}
+        for _ in range(rng.randint(0, 2)):
+            k = rng.choice(["service.name", "component", "http.status_code",
+                            "region"])
+            tags[k] = rng.choice(["front", "db", "cart", "5", "us", "zz-no"])
+        kw = {}
+        if rng.random() < 0.4:
+            kw["min_duration_ms"] = rng.choice([1, 1000, 20_000])
+        if rng.random() < 0.4:
+            kw["max_duration_ms"] = rng.choice([500, 30_000])
+        req = _mk_req(tags, **kw)
+        req.limit = 10_000
+        expected = {tid.hex() for tid, tr in traces.items()
+                    if proto_matches(tr, req)}
+        got = {m.trace_id for m in db.search("t1", req).response().traces}
+        assert got == expected, (round_, tags, kw,
+                                 len(got), len(expected))
