@@ -285,9 +285,19 @@ def decode_record_batches(data: bytes, expect_base: int | None = None
     out = []
     r = Reader(data)
     while r.remaining() >= 61:  # minimal batch header
+        batch_start_records = len(out)
         try:
             base_offset = r.i64()
             batch_len = r.i32()
+            if batch_len < 49:
+                # batchLen lives OUTSIDE the CRC'd region; a negative or
+                # sub-header value is garbage, and `r.pos = end` with
+                # end <= the batch's own start would REWIND the cursor —
+                # re-parsing the same bytes forever (fuzz-found hang)
+                if out:
+                    return out
+                raise CorruptBatchError(
+                    "kafka: implausible batch length", next_offset=None)
             if r.remaining() < batch_len:
                 break  # truncated tail
             end = r.pos + batch_len
@@ -367,7 +377,26 @@ def decode_record_batches(data: bytes, expect_base: int | None = None
                 out.append((base_offset + off_delta, key, value))
             r.pos = end
         except EOFError:
+            # half-decoded records from the torn batch are NOT valid
+            # output — returning them would deliver garbage and commit
+            # offsets past bytes that never decoded
+            del out[batch_start_records:]
             break
+        except CorruptBatchError:
+            raise  # the CRC path's own, fully-annotated error
+        except (struct.error, ValueError, IndexError, OverflowError):
+            # structurally malformed batch whose corruption dodged the
+            # CRC (the length prefix and baseOffset live OUTSIDE the
+            # CRC'd region): same policy as a CRC mismatch — drop this
+            # batch's half-decoded records, deliver any good PRIOR
+            # batches first, else surface the documented error so the
+            # consumer's poison-skip engages instead of refetching the
+            # same offset forever
+            del out[batch_start_records:]
+            if out:
+                return out
+            raise CorruptBatchError("kafka: malformed record batch "
+                                    "structure", next_offset=None)
     return out
 
 

@@ -137,10 +137,27 @@ class TenantIndex:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "TenantIndex":
-        return cls.from_json_bytes(gzip.decompress(data))
+        import zlib
+
+        try:
+            text = gzip.decompress(data)
+        except (OSError, EOFError, zlib.error) as e:
+            # normalize: callers treat ValueError as "index unreadable"
+            raise ValueError(f"corrupt tenant index: {e}") from e
+        return cls.from_json_bytes(text)
 
     @classmethod
     def from_json_bytes(cls, text: bytes) -> "TenantIndex":
+        try:
+            return cls._from_json_bytes(text)
+        except (KeyError, TypeError, AttributeError,
+                json.JSONDecodeError) as e:
+            # shape-corrupt JSON normalizes to the ValueError contract
+            # (readers fall back to a direct block poll on it)
+            raise ValueError(f"corrupt tenant index: {e}") from e
+
+    @classmethod
+    def _from_json_bytes(cls, text: bytes) -> "TenantIndex":
         d = json.loads(text)
         return cls(
             created_at=d.get("created_at", 0),

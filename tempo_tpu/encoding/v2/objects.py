@@ -15,7 +15,8 @@ _HDR = struct.Struct("<II")
 MAX_OBJECT_SIZE = 1 << 30
 
 
-class ObjectFramingError(Exception):
+class ObjectFramingError(ValueError):  # callers catch ValueError (WAL find,
+    # strict unmarshal consumers): corruption must land in that contract
     pass
 
 

@@ -1,0 +1,185 @@
+"""Hostile-bytes fuzz over every wire decoder: random garbage and
+mutations of valid payloads must raise the decoder's DOCUMENTED error
+types (or return gracefully) — never hang, never corrupt state, never
+escape with an undeclared exception class that would 500 an ingest
+endpoint that promises 400s for malformed bodies."""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.utils.ids import random_trace_id
+from tempo_tpu.utils.test_data import make_trace
+
+
+def _mutations(valid: bytes, rng, n=40):
+    """Truncations, bit flips, and splices of a valid payload."""
+    out = []
+    for _ in range(n):
+        b = bytearray(valid)
+        op = rng.randrange(3)
+        if op == 0 and len(b) > 1:
+            b = b[: rng.randrange(1, len(b))]
+        elif op == 1 and b:
+            for _ in range(rng.randint(1, 8)):
+                b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+        else:
+            i = rng.randrange(len(b) + 1)
+            b[i:i] = rng.randbytes(rng.randint(1, 64))
+        out.append(bytes(b))
+    out += [b"", rng.randbytes(3), rng.randbytes(200)]
+    return out
+
+
+def test_fuzz_object_file_unmarshal():
+    from tempo_tpu.encoding.v2.objects import marshal_object, unmarshal_objects
+
+    rng = random.Random(9)
+    valid = b"".join(marshal_object(random_trace_id(), rng.randbytes(50))
+                     for _ in range(5))
+    for payload in _mutations(valid, rng):
+        # tolerant mode: always terminates, yields a (possibly empty)
+        # prefix, never raises
+        list(unmarshal_objects(payload, tolerate_truncation=True))
+        # strict mode may raise, but only ValueError
+        try:
+            list(unmarshal_objects(payload))
+        except ValueError:
+            pass
+
+
+def test_fuzz_kafka_record_batches():
+    from tempo_tpu.api.kafka import (
+        CorruptBatchError, decode_record_batches, encode_record_batch,
+    )
+
+    rng = random.Random(10)
+    valid = encode_record_batch(
+        [(None, b"value-%d" % i) for i in range(4)], base_offset=7)
+    for payload in _mutations(valid, rng):
+        try:
+            decode_record_batches(payload)
+        except CorruptBatchError:
+            pass  # the one documented failure class
+
+
+def test_fuzz_jaeger_thrift():
+    from tempo_tpu.api.jaeger import jaeger_thrift_http_to_batches
+    from tempo_tpu.api.thriftproto import ThriftError
+
+    rng = random.Random(11)
+    for payload in _mutations(rng.randbytes(120), rng, n=25):
+        try:
+            jaeger_thrift_http_to_batches(payload)
+        except (ThriftError, KeyError, TypeError, AttributeError,
+                ValueError, EOFError):
+            pass  # api/http treats these as 400s
+
+
+def test_fuzz_zipkin_json():
+    from tempo_tpu.api.receivers import zipkin_json_to_batches
+
+    rng = random.Random(12)
+    valid = json.dumps([{
+        "traceId": random_trace_id().hex(), "id": "1" * 16, "name": "op",
+        "timestamp": 1, "duration": 2,
+        "localEndpoint": {"serviceName": "svc"},
+    }]).encode()
+    for payload in _mutations(valid, rng, n=25):
+        try:
+            zipkin_json_to_batches(payload)
+        except (json.JSONDecodeError, KeyError, TypeError, AttributeError,
+                ValueError):
+            pass  # 400 classes per api/http._ingest
+
+
+def test_fuzz_otlp_protobuf():
+    from google.protobuf.message import DecodeError
+
+    from tempo_tpu.api.receivers import otlp_http_to_batches
+
+    rng = random.Random(13)
+    valid = make_trace(random_trace_id(), seed=1).SerializeToString()
+    for payload in _mutations(valid, rng, n=25):
+        try:
+            otlp_http_to_batches(payload)
+        except (DecodeError, ValueError):
+            pass
+
+
+def test_fuzz_search_data_decode():
+    from tempo_tpu.search.data import decode_search_data, encode_search_data
+    from tempo_tpu.search import extract_search_data
+
+    rng = random.Random(14)
+    tid = random_trace_id()
+    valid = encode_search_data(extract_search_data(tid, make_trace(tid, seed=2)))
+    for payload in _mutations(valid, rng, n=25):
+        try:
+            decode_search_data(payload, tid)
+        except Exception as e:  # noqa: BLE001 — classify below
+            # the live-trace fold catches Exception; what matters is the
+            # class is a sane decode error, not e.g. MemoryError from a
+            # hostile length prefix
+            assert not isinstance(e, MemoryError), type(e)
+
+
+def test_fuzz_tenant_index():
+    from tempo_tpu.backend.types import BlockMeta, TenantIndex
+
+    rng = random.Random(15)
+    valid = TenantIndex(created_at=1,
+                        metas=[BlockMeta(tenant_id="t")]).to_bytes()
+    for payload in _mutations(valid, rng, n=25):
+        try:
+            TenantIndex.from_bytes(payload)
+        except (ValueError, OSError, EOFError, KeyError, TypeError,
+                AttributeError):
+            pass  # poller treats any of these as index-missing
+
+
+def test_kafka_negative_batch_length_cannot_hang():
+    """Fuzz-found: a negative batchLen rewound the parse cursor and spun
+    forever. Decode must terminate (bounded) with the documented error."""
+    import struct
+    import threading
+
+    from tempo_tpu.api.kafka import CorruptBatchError, decode_record_batches
+
+    payload = b"\x00" * 8 + struct.pack(">i", -12) + b"\x00" * 49
+    result = {}
+
+    def run():
+        try:
+            result["out"] = decode_record_batches(payload)
+        except CorruptBatchError as e:
+            result["err"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=5)
+    assert not t.is_alive(), "decode_record_batches hung on negative length"
+    assert "err" in result  # documented error, not garbage output
+
+
+def test_kafka_torn_batch_never_delivers_partial_records():
+    """A batch whose record section is corrupt must not leak half-decoded
+    records (they carry mis-parsed offsets and values)."""
+    from tempo_tpu.api.kafka import (
+        CorruptBatchError, decode_record_batches, encode_record_batch,
+    )
+
+    good = encode_record_batch([(None, b"a"), (None, b"b")], base_offset=10)
+    bad = bytearray(encode_record_batch(
+        [(None, b"v0"), (None, b"v1"), (None, b"v2")], base_offset=100))
+    # corrupt the records section but FIX the CRC so only structure fails
+    # (simulates producer-side corruption under a recomputed checksum):
+    # easiest equivalent — truncate mid-records at the wire level
+    torn = bytes(good) + bytes(bad[: len(bad) - 5])
+    out = decode_record_batches(torn)
+    offsets = [o for o, _, _ in out]
+    assert offsets == [10, 11], offsets  # the good batch only, intact
