@@ -169,6 +169,8 @@ class HTTPApi:
             rest = path[len(PATH_SEARCH_TAG_VALUES) + 1:]
             if rest.endswith("/values"):
                 tag = rest[: -len("/values")]
+                if not tag:
+                    return 400, {"error": "empty tag name"}
                 resp = self.app.queriers[0].search_tag_values(tenant, tag)
                 return 200, json_format.MessageToDict(resp)
         if path.startswith("/jaeger/api/"):

@@ -448,3 +448,22 @@ storage:
     cfg2, _ = load_config(text="{}")
     assert cfg2.db.search_host_cache_bytes is None
     assert cfg2.frontend.batch_jobs_per_request is None
+
+
+def test_http_garbage_query_params_are_client_errors(app):
+    """Hostile/garbage query params must map to 400s (or safe defaults),
+    never 500 — the parse layer's int()/duration errors are client
+    errors."""
+    api = HTTPApi(app)
+    hdr = {"X-Scope-OrgID": "t1"}
+    for path, query in [
+        ("/api/search", {"limit": "not-a-number"}),
+        ("/api/search", {"start": "1e99"}),
+        ("/api/search", {"minDuration": "banana"}),
+        ("/api/search", {"maxDuration": "-5ms"}),
+        ("/api/traces/zzzz-not-hex", {}),
+        ("/api/traces/" + "f" * 4096, {}),  # absurd length
+        ("/api/search/tag//values", {}),
+    ]:
+        code, body = api.handle("GET", path, query, hdr)
+        assert code in (400, 404), (path, query, code, body)
