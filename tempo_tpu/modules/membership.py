@@ -154,17 +154,35 @@ class Memberlist:
                     "members": {m.id: m.wire() for m in self._members.values()}}
 
     def merge(self, remote: dict) -> None:
+        # defensive against a hostile/broken peer: a malformed snapshot
+        # must be IGNORED, not raise — an escaped TypeError here would
+        # kill the gossip tick thread and silently mute this node
+        if not isinstance(remote, dict):
+            return
+        members = remote.get("members")
+        if not isinstance(members, dict):
+            return
         now = time.monotonic()
         with self._lock:
-            for mid, rec in remote.get("members", {}).items():
+            for mid, rec in members.items():
                 if mid == self.id:
                     # someone else's view of me: only LEFT at a higher
                     # counter matters (refute by outliving it — we bump our
                     # own counter every tick)
                     continue
+                if not isinstance(mid, str) or not isinstance(rec, dict):
+                    continue
                 known = self._members.get(mid)
-                rm = Member(**{k: v for k, v in rec.items()
-                               if k in Member.__dataclass_fields__})
+                try:
+                    rm = Member(**{k: v for k, v in rec.items()
+                                   if k in Member.__dataclass_fields__})
+                    rm.heartbeat = int(rm.heartbeat)
+                    if not isinstance(rm.state, str) \
+                            or not isinstance(rm.role, str) \
+                            or not isinstance(rm.gossip_addr, str):
+                        continue
+                except (TypeError, ValueError):
+                    continue  # type-poisoned record: skip it, keep the rest
                 if known is None:
                     rm.local_seen = now
                     self._members[mid] = rm
@@ -242,7 +260,8 @@ class Memberlist:
             _gossip_rounds.inc()
             try:
                 self._exchange(addr)
-            except (OSError, json.JSONDecodeError):
+            except (OSError, json.JSONDecodeError, UnicodeDecodeError,
+                    ValueError):
                 _gossip_errors.inc()
 
     def _resolved_seeds(self) -> list[str]:
@@ -280,7 +299,8 @@ class Memberlist:
         for addr in peers[:self.fanout]:
             try:
                 self._exchange(addr)
-            except (OSError, json.JSONDecodeError):
+            except (OSError, json.JSONDecodeError, UnicodeDecodeError,
+                    ValueError):
                 pass
         self.shutdown()
 

@@ -183,3 +183,62 @@ def test_kafka_torn_batch_never_delivers_partial_records():
     out = decode_record_batches(torn)
     offsets = [o for o, _, _ in out]
     assert offsets == [10, 11], offsets  # the good batch only, intact
+
+
+def test_fuzz_gossip_survives_hostile_peer():
+    """Garbage bytes on the gossip port and type-poisoned snapshots must
+    not kill the node: the tick thread stays alive, healthy state stays
+    intact, and a real peer still converges afterwards."""
+    import socket as _socket
+    import time as _time
+
+    from tempo_tpu.modules.membership import Memberlist
+
+    a = Memberlist("a", "ingester", gossip_interval_s=0.1,
+                   suspect_timeout_s=5.0)
+    try:
+        host, port = a.gossip_addr.rsplit(":", 1)
+        rng = random.Random(31)
+        payloads = [
+            b"\xff\xfe garbage\n",
+            b"[]\n",
+            b'"just-a-string"\n',
+            b'{"members": []}\n',
+            b'{"members": {"x": 42}}\n',
+            b'{"members": {"x": {"id": "x", "role": null, '
+            b'"gossip_addr": 9, "heartbeat": "NaN"}}}\n',
+            json.dumps({"members": {"evil": {
+                "id": "evil", "role": "ingester",
+                "gossip_addr": "127.0.0.1:1", "heartbeat": [1, 2],
+                "state": {"deep": "wrong"}}}}).encode() + b"\n",
+            rng.randbytes(500) + b"\n",
+        ]
+        for p in payloads:
+            with _socket.create_connection((host, int(port)), timeout=2) as s:
+                s.sendall(p)
+                try:
+                    s.recv(4096)
+                except OSError:
+                    pass
+        # hostile snapshots through merge() directly too (gossip-loop path)
+        a.merge("nope")
+        a.merge({"members": {"y": {"id": "y", "role": "ingester",
+                                   "gossip_addr": "z", "heartbeat": None}}})
+        _time.sleep(0.3)
+        assert a._thread.is_alive(), "gossip tick thread died"
+        assert a.ring("ingester").healthy_count() == 1  # just ourselves
+
+        # a REAL peer still joins and converges after the abuse
+        b = Memberlist("b", "ingester", join=[a.gossip_addr],
+                       gossip_interval_s=0.1, suspect_timeout_s=5.0)
+        try:
+            deadline = _time.monotonic() + 10
+            while _time.monotonic() < deadline:
+                if a.ring("ingester").healthy_count() == 2:
+                    break
+                _time.sleep(0.05)
+            assert a.ring("ingester").healthy_count() == 2
+        finally:
+            b.shutdown()
+    finally:
+        a.shutdown()
