@@ -176,12 +176,22 @@ class Memberlist:
                 try:
                     rm = Member(**{k: v for k, v in rec.items()
                                    if k in Member.__dataclass_fields__})
-                    rm.heartbeat = int(rm.heartbeat)
-                    if not isinstance(rm.state, str) \
-                            or not isinstance(rm.role, str) \
-                            or not isinstance(rm.gossip_addr, str):
+                    # id must be a str, MATCH its map key, and not forge
+                    # our own identity under a different key — snapshots
+                    # re-key by m.id, so a forged id would overwrite our
+                    # self-record in every outgoing snapshot (e.g. a
+                    # hostile LEFT@999 evicting us cluster-wide)
+                    if rm.id != mid or rm.id == self.id:
                         continue
-                except (TypeError, ValueError):
+                    rm.heartbeat = int(rm.heartbeat)
+                    if rm.state not in (STATE_ACTIVE, STATE_LEFT):
+                        continue  # unknown states could never expire
+                    if not all(isinstance(v, str) for v in (
+                            rm.role, rm.gossip_addr, rm.grpc_addr,
+                            rm.http_addr)):
+                        continue  # poisoned addrs reach client factories
+                except (TypeError, ValueError, OverflowError):
+                    # OverflowError: json Infinity → int(float('inf'))
                     continue  # type-poisoned record: skip it, keep the rest
                 if known is None:
                     rm.local_seen = now
@@ -260,8 +270,8 @@ class Memberlist:
             _gossip_rounds.inc()
             try:
                 self._exchange(addr)
-            except (OSError, json.JSONDecodeError, UnicodeDecodeError,
-                    ValueError):
+            except (OSError, ValueError):
+                # ValueError covers JSONDecodeError + UnicodeDecodeError
                 _gossip_errors.inc()
 
     def _resolved_seeds(self) -> list[str]:
@@ -299,8 +309,7 @@ class Memberlist:
         for addr in peers[:self.fanout]:
             try:
                 self._exchange(addr)
-            except (OSError, json.JSONDecodeError, UnicodeDecodeError,
-                    ValueError):
+            except (OSError, ValueError):
                 pass
         self.shutdown()
 

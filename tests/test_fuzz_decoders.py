@@ -242,3 +242,35 @@ def test_fuzz_gossip_survives_hostile_peer():
             b.shutdown()
     finally:
         a.shutdown()
+
+
+def test_gossip_rejects_identity_forgery_and_poison_types():
+    """The review's thread-killers: json Infinity heartbeats, unhashable
+    ids, forged self-records, unknown states, and poisoned addresses are
+    all skipped — and the node keeps advertising ITSELF as ACTIVE."""
+    from tempo_tpu.modules.membership import Memberlist
+
+    a = Memberlist("me", "ingester", gossip_interval_s=5, suspect_timeout_s=5)
+    try:
+        a.merge({"members": {
+            "x1": {"id": "x1", "role": "r", "gossip_addr": "h:1",
+                   "heartbeat": float("inf")},
+            "x2": {"id": [1, 2], "role": "r", "gossip_addr": "h:1",
+                   "heartbeat": 1},
+            "x3": {"id": "me", "role": "ingester", "gossip_addr": "h:1",
+                   "heartbeat": 999, "state": "LEFT"},   # forged self
+            "x4": {"id": "x4", "role": "r", "gossip_addr": "h:1",
+                   "heartbeat": 1, "state": "ZOMBIE"},
+            "x5": {"id": "x5", "role": "r", "gossip_addr": "h:1",
+                   "grpc_addr": {"deep": "wrong"}, "heartbeat": 1},
+            "ok": {"id": "ok", "role": "r", "gossip_addr": "h:2",
+                   "heartbeat": 1},
+        }})
+        ids = {m.id for m in a.members(alive_only=False)}
+        assert ids == {"me", "ok"}, ids
+        me = [m for m in a.members(alive_only=False) if m.id == "me"][0]
+        assert me.state == "ACTIVE"   # forgery did not mark us LEFT
+        # snapshot must be buildable (no unhashable ids slipped in)
+        a._snapshot()
+    finally:
+        a.shutdown()
