@@ -274,3 +274,26 @@ def test_gossip_rejects_identity_forgery_and_poison_types():
         a._snapshot()
     finally:
         a.shutdown()
+
+
+def test_fuzz_dns_response_parse():
+    """Hostile DNS responses (the seed-resolution path feeding the gossip
+    thread) must fail as ValueError only — the class its callers catch."""
+    from tempo_tpu.utils.dns import encode_query, parse_response
+
+    rng = random.Random(41)
+    q = encode_query("seed.example.com", 1, txid=0x1234)
+    for payload in _mutations(q + rng.randbytes(64), rng, n=40):
+        try:
+            parse_response(payload, txid=0x1234)
+        except ValueError:
+            pass
+    # compression-pointer loop specifically (classic DNS parser bomb)
+    bomb = bytearray(q)
+    bomb[2] |= 0x80  # response flag
+    bomb += b"\xc0\x0c\x00\x01\x00\x01\x00\x00\x00\x3c\x00\x04\x7f\x00\x00\x01"
+    loop = bytes(bomb[:12]) + b"\xc0\x0c" + bytes(bomb[14:])
+    try:
+        parse_response(bytes(loop), txid=0x1234)
+    except ValueError:
+        pass
