@@ -40,6 +40,12 @@ def serve_worker(worker: SearchWorker, host: str = "0.0.0.0", port: int = 0):
             req = tempopb.SearchBlockRequest()
             try:
                 req.ParseFromString(self.rfile.read(length))
+            except Exception as e:  # noqa: BLE001 — malformed body
+                # 400, not 500: the hedging caller retries 5xx, and a
+                # body that never parsed will never parse
+                self.send_error(400, str(e))
+                return
+            try:
                 resp = worker.handle(req)
             except Exception as e:  # noqa: BLE001 — one job, one error
                 self.send_error(500, str(e))

@@ -327,6 +327,18 @@ def test_serverless_worker_and_external_querier(tmp_path):
                     prefer_self=0, external_hedge_after_s=5.0)
         resp = q.search_block(req)
         assert len(resp.traces) == 10
+
+        # malformed body → 400 (a hedging caller must not retry it)
+        import urllib.error
+        import urllib.request
+
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/search-block",
+            data=b"\xff\xfenot-a-proto-message-at-all" * 3,
+            headers={"Content-Type": "application/protobuf"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=5)
+        assert ei.value.code == 400
     finally:
         server.shutdown()
 
