@@ -194,6 +194,11 @@ class MemcachedCache(_NetCache):
         if not line.startswith(b"VALUE "):
             raise OSError(f"memcached: unexpected {line[:40]!r}")
         nbytes = int(line.split()[3])
+        # a hostile/broken server declaring a huge length must degrade
+        # (counted wire error), not drive an allocation that OOMs the
+        # reader — cached objects are bounded page/index blobs
+        if not 0 <= nbytes <= (256 << 20):
+            raise ValueError(f"memcached: implausible value length {nbytes}")
         val = _read_n(s, buf, nbytes)
         _read_n(s, buf, 2)          # \r\n after data
         end = _read_line(s, buf)
@@ -231,6 +236,9 @@ class RedisCache(_NetCache):
         n = int(line[1:])
         if n == -1:
             return None
+        if not 0 <= n <= (256 << 20):  # same hostile-length stance as
+            raise ValueError(           # the memcached client
+                f"redis: implausible bulk length {n}")
         val = _read_n(s, buf, n)
         _read_n(s, buf, 2)
         return val

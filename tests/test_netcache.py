@@ -202,3 +202,34 @@ def test_unsafe_keys_are_hashed(memcached):
     assert c.fetch(long_key) == b"v"
     assert safe_cache_key("plain/key") == "plain/key"  # safe keys untouched
     c.stop()
+
+
+def test_hostile_value_lengths_degrade_to_miss():
+    """A cache server declaring an absurd value length must count as a
+    wire error (miss), not drive a giant allocation."""
+    import socketserver
+    import threading
+
+    from tempo_tpu.backend.netcache import MemcachedCache, RedisCache
+
+    class EvilMemcached(socketserver.StreamRequestHandler):
+        def handle(self):
+            self.rfile.readline()
+            self.wfile.write(b"VALUE k 0 99999999999999\r\n")
+
+    class EvilRedis(socketserver.StreamRequestHandler):
+        def handle(self):
+            self.rfile.read(1)
+            self.wfile.write(b"$99999999999999\r\n")
+
+    for cls, handler in ((MemcachedCache, EvilMemcached),
+                         (RedisCache, EvilRedis)):
+        srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), handler)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            c = cls([f"127.0.0.1:{srv.server_address[1]}"])
+            assert c.fetch("k") is None  # degraded, no MemoryError
+        finally:
+            srv.shutdown()
+            srv.server_close()
