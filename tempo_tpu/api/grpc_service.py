@@ -218,7 +218,14 @@ def _unary(fn, req_cls, resp_cls):
         parent = tracing.extract_traceparent(md)
         with tracing.start_span(f"grpc {fn.__name__}",
                                 kind=tracing.KIND_SERVER, parent=parent):
-            return fn(request, context)
+            try:
+                return fn(request, context)
+            except ValueError as e:
+                # client-data errors (invalid tenant id, bad arguments)
+                # must be INVALID_ARGUMENT — UNKNOWN reads as retryable
+                # to standard exporters, which would re-send the same
+                # bad request forever
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
     return grpc.unary_unary_rpc_method_handler(
         traced,
@@ -228,11 +235,12 @@ def _unary(fn, req_cls, resp_cls):
 
 
 def _tenant_from(context) -> str:
-    from .params import DEFAULT_TENANT
+    from .params import DEFAULT_TENANT, validate_tenant
 
     for k, v in context.invocation_metadata() or ():
         if k.lower() == "x-scope-orgid":
-            return v
+            return validate_tenant(v)  # ValueError → call fails, not a
+            # path traversal into the block store
     return DEFAULT_TENANT
 
 

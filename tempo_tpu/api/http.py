@@ -56,9 +56,13 @@ class HTTPApi:
         self.multitenancy = multitenancy
 
     def tenant(self, headers) -> str:
+        from .params import validate_tenant
+
         if not self.multitenancy:
             return DEFAULT_TENANT
-        return headers.get(HEADER_TENANT) or DEFAULT_TENANT
+        # ValueError → the handle() 400 path: a tenant id is the one
+        # header that reaches filesystem joins
+        return validate_tenant(headers.get(HEADER_TENANT) or DEFAULT_TENANT)
 
     def handle(self, method: str, path: str, query: dict, headers,
                body: bytes = b"") -> tuple[int, dict | str]:

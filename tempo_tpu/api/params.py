@@ -21,6 +21,20 @@ PATH_ECHO = "/api/echo"
 HEADER_TENANT = "X-Scope-OrgID"
 DEFAULT_TENANT = "single-tenant"
 
+# tenant ids travel from an attacker-controllable header into object
+# paths (LocalBackend: <root>/<tenant>/<block>/...), so they are
+# validated at every boundary — same stance as the reference's
+# weaveworks tenant rules (no separators, no relative components). The
+# rule lives in utils/pathsafe so the backend's defense-in-depth check
+# can never drift from this one.
+
+
+def validate_tenant(tenant: str) -> str:
+    """The tenant id or ValueError (HTTP 400 / gRPC INVALID_ARGUMENT)."""
+    from tempo_tpu.utils.pathsafe import check_path_component
+
+    return check_path_component(tenant, "tenant id")
+
 
 def _parse_tags(val: str) -> dict[str, str]:
     """logfmt-ish `k=v k2=v2` tag encoding (reference search tags param)."""

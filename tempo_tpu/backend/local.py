@@ -19,6 +19,17 @@ class LocalBackend(RawBackend):
         os.makedirs(path, exist_ok=True)
 
     def _p(self, tenant: str, block_id: str | None, name: str = "") -> str:
+        # defense in depth behind the API-layer tenant validation: no
+        # component may escape the root (tenant arrives from a request
+        # header; block/name are internal but cheap to pin too). Shared
+        # rule with params.validate_tenant via utils/pathsafe.
+        from tempo_tpu.utils.pathsafe import check_path_component
+
+        check_path_component(tenant, "tenant")
+        if block_id:
+            check_path_component(block_id, "block id")
+        if name:
+            check_path_component(name, "object name")
         parts = [self.path, tenant]
         if block_id:
             parts.append(block_id)
@@ -27,6 +38,8 @@ class LocalBackend(RawBackend):
         return os.path.join(*parts)
 
     def write(self, tenant, block_id, name, data: bytes) -> None:
+        self._p(tenant, block_id, name)  # validates NAME too (an
+        # absolute name would win the later os.path.join outright)
         d = self._p(tenant, block_id)
         os.makedirs(d, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=d, prefix=f".{name}.")
@@ -44,6 +57,7 @@ class LocalBackend(RawBackend):
         that becomes visible atomically at close_append (the write()
         temp+rename contract, extended to incremental writers)."""
         if tracker is None:
+            self._p(tenant, block_id, name)  # validate name up front
             d = self._p(tenant, block_id)
             os.makedirs(d, exist_ok=True)
             fd, tmp = tempfile.mkstemp(dir=d, prefix=f".{name}.append.")

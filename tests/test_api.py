@@ -467,3 +467,69 @@ def test_http_garbage_query_params_are_client_errors(app):
     ]:
         code, body = api.handle("GET", path, query, hdr)
         assert code in (400, 404), (path, query, code, body)
+
+
+def test_tenant_path_traversal_rejected(tmp_path):
+    """X-Scope-OrgID is attacker-controllable and flows into filesystem
+    paths: traversal attempts must 400 at the API and raise at the
+    backend, and nothing may be written outside the backend root."""
+    import os
+
+    from tempo_tpu.backend import LocalBackend
+
+    app2 = App(AppConfig(
+        backend={"backend": "local", "local": {"path": str(tmp_path / "be")}},
+        wal_dir=str(tmp_path / "wal")))
+    api = HTTPApi(app2, multitenancy=True)
+    evil = ["../../../../tmp/evil", "..", "a/b", "a\\b", "t\x00x", "x" * 200]
+    for tenant in evil:
+        code, _ = api.handle(
+            "POST", "/v1/traces",
+            {}, {"X-Scope-OrgID": tenant},
+            make_trace(random_trace_id(), seed=1).SerializeToString())
+        assert code == 400, (tenant, code)
+        code, _ = api.handle("GET", "/api/search", {"limit": "5"},
+                             {"X-Scope-OrgID": tenant})
+        assert code == 400, (tenant, code)
+    # backend defense in depth
+    be = LocalBackend(str(tmp_path / "be2"))
+    import pytest as _pytest
+    for tenant in ("../esc", "a/b", ".."):
+        with _pytest.raises(ValueError):
+            be.write(tenant, "blk", "meta.json", b"{}")
+    assert not os.path.exists(str(tmp_path / "esc"))
+    # normal tenants unaffected
+    be.write("ok-tenant_1", "blk", "meta.json", b"{}")
+
+
+def test_grpc_invalid_tenant_is_invalid_argument(tmp_path):
+    """An invalid X-Scope-OrgID over gRPC must fail INVALID_ARGUMENT —
+    UNKNOWN reads as retryable to standard OTLP exporters."""
+    import socket
+
+    import grpc
+
+    from tempo_tpu.api.grpc_service import make_module_grpc_server
+
+    class P:
+        def push_bytes(self, tenant, req):
+            pass
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = make_module_grpc_server(f"127.0.0.1:{port}", pusher=P())
+    server.start()
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        rpc = ch.unary_unary(
+            "/tempopb.Pusher/PushBytes",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=tempopb.PushResponse.FromString)
+        with pytest.raises(grpc.RpcError) as ei:
+            rpc(tempopb.PushBytesRequest(),
+                metadata=(("x-scope-orgid", "../../etc"),))
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        ch.close()
+    finally:
+        server.stop(0)
