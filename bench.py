@@ -926,8 +926,14 @@ def _assemble(results: dict) -> dict:
         },
     }
     if not ok:
-        doc["error"] = (single or {}).get(
+        err = (single or {}).get(
             "error", "headline phase 'single' did not run")
+        if err.startswith("skipped: not selected"):
+            # an explicit BENCH_PHASES subset without the headline is a
+            # deliberate partial run, not a device failure
+            doc["partial"] = err
+        else:
+            doc["error"] = err
     degraded = results.get("degraded")
     if degraded:
         doc["degraded"] = degraded
@@ -1089,6 +1095,15 @@ def orchestrate() -> int:
         with open(os.path.join(ckpt_dir, "partial.json"), "w") as f:
             json.dump(_assemble(results), f)
 
+    if "single" not in phase_order and "single" not in results:
+        # deliberate partial selection: success = every SELECTED phase ok
+        results["single"] = {"error": "skipped: not selected "
+                                      "(BENCH_PHASES)"}
+        sel_ok = all(not _failed(results.get(p, {"error": "missing"}))
+                     for p in phase_order)
+        if not sel_ok:
+            return emit_and_exit(3)
+        return emit_and_exit(4 if results.get("degraded") else 0)
     ok = not _failed(results.get("single", {"error": "missing"}))
     return emit_and_exit(0 if ok and not results.get("degraded")
                          else (4 if ok else 3))

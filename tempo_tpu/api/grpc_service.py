@@ -18,6 +18,7 @@ from __future__ import annotations
 import grpc
 
 from tempo_tpu import tempopb
+from tempo_tpu.api.params import InvalidArgument
 
 SERVICE_PUSHER = "tempopb.Pusher"
 SERVICE_QUERIER = "tempopb.Querier"
@@ -220,12 +221,17 @@ def _unary(fn, req_cls, resp_cls):
                                 kind=tracing.KIND_SERVER, parent=parent):
             try:
                 return fn(request, context)
-            except ValueError as e:
+            except InvalidArgument as e:
                 # client-data errors (invalid tenant id, bad arguments)
                 # must be INVALID_ARGUMENT — UNKNOWN reads as retryable
                 # to standard exporters, which would re-send the same
                 # bad request forever
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except ValueError as e:
+                # every OTHER ValueError here is server-side (corrupt
+                # WAL entry, object framing): INTERNAL, never a verdict
+                # on the request itself (ADVICE r4)
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
 
     return grpc.unary_unary_rpc_method_handler(
         traced,

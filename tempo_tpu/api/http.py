@@ -27,9 +27,18 @@ from .params import (
     PATH_SEARCH_TAGS,
     PATH_SEARCH_TAG_VALUES,
     PATH_TRACES,
+    InvalidArgument,
     parse_search_request,
     parse_trace_by_id_params,
 )
+
+
+def _hex_trace_id(s: str) -> bytes:
+    """URL trace ids are client input: bad hex is a 400, not a 500."""
+    try:
+        return hex_to_trace_id(s)
+    except ValueError as e:
+        raise InvalidArgument(str(e)) from None
 
 
 def _route_template(path: str) -> str:
@@ -51,9 +60,16 @@ def _route_template(path: str) -> str:
 class HTTPApi:
     """Routes HTTP requests onto an App (modules/app.py)."""
 
-    def __init__(self, app, multitenancy: bool = True):
+    def __init__(self, app, multitenancy: bool = True,
+                 debug_endpoints: bool = True):
         self.app = app
         self.multitenancy = multitenancy
+        # /debug/* dumps full stacks (file paths, internals) to anyone
+        # who can reach the port; deployments keep it off the public
+        # port unless server.debug_endpoints says otherwise (ADVICE r4).
+        # Library/test default stays on — there is no network exposure
+        # until someone serves this object.
+        self.debug_endpoints = debug_endpoints
 
     def tenant(self, headers) -> str:
         from .params import validate_tenant
@@ -79,7 +95,11 @@ class HTTPApi:
                     code, resp = self._ingest(path, body, headers)
                 else:
                     code, resp = self._route(method, path, query, headers)
-            except ValueError as e:
+            except InvalidArgument as e:
+                # ONLY the dedicated client-data type maps to 400; a
+                # plain ValueError (corrupt WAL entry, object framing)
+                # is server-side and falls through to the 500 handler —
+                # same split as the gRPC layer (ADVICE r4)
                 code, resp = 400, {"error": str(e)}
             except TooManyRequests as e:
                 # tenant's fair-queue is full (reference frontend v1
@@ -115,7 +135,10 @@ class HTTPApi:
             else:
                 batches = zipkin_json_to_batches(body)
         except (DecodeError, KeyError, TypeError, AttributeError,
-                ThriftError, _json.JSONDecodeError) as e:
+                ThriftError, ValueError, _json.JSONDecodeError) as e:
+            # ValueError here is a DECODER error (bad hex id, non-array
+            # zipkin body) — client payload, unlike the serving path
+            # where bare ValueError means server-side corruption
             return 400, {"error": f"malformed payload: {type(e).__name__}: {e}"}
         if batches:
             self.app.push(tenant, batches)
@@ -136,6 +159,9 @@ class HTTPApi:
         if path == "/flush":
             completed = self.app.flush_tick(force=True)
             return 200, {"completed_blocks": len(completed)}
+        if path.startswith("/debug/") and not self.debug_endpoints:
+            return 404, {"error": "debug endpoints disabled "
+                                  "(server.debug_endpoints: true enables)"}
         if path == "/debug/threads":
             # faulthandler-style all-thread stack dump (reference pprof
             # goroutine profile role, cmd/tempo/main.go:54-115): the
@@ -152,7 +178,7 @@ class HTTPApi:
             return 200, "shutting down"
 
         if path.startswith(PATH_TRACES + "/"):
-            trace_id = hex_to_trace_id(path[len(PATH_TRACES) + 1:])
+            trace_id = _hex_trace_id(path[len(PATH_TRACES) + 1:])
             mode, bs, be = parse_trace_by_id_params(query)
             resp = self.app.find_trace(tenant, trace_id)
             if not resp.trace.batches:
@@ -197,7 +223,7 @@ class HTTPApi:
             return 200, bridge.search(tenant, query)
         if sub.startswith("/traces/"):
             data = bridge.trace_by_id(tenant,
-                                      hex_to_trace_id(sub[len("/traces/"):]))
+                                      _hex_trace_id(sub[len("/traces/"):]))
             if data is None:
                 return 404, {"errors": [{"msg": "trace not found"}]}
             return 200, data

@@ -149,21 +149,26 @@ class JaegerQueryBridge:
         return {"data": [trace_to_jaeger(resp.trace)]}
 
     def search(self, tenant: str, query: dict) -> dict:
-        req = tempopb.SearchRequest()
-        if query.get("service"):
-            req.tags["service.name"] = query["service"]
-        if query.get("operation"):
-            req.tags["name"] = query["operation"]
-        # jaeger sends start/end in µs epoch
-        if query.get("start"):
-            req.start = int(int(query["start"]) // 1_000_000)
-        if query.get("end"):
-            req.end = int(int(query["end"]) // 1_000_000) + 1
-        if query.get("minDuration"):
-            req.min_duration_ms = _duration_ms(query["minDuration"])
-        if query.get("maxDuration"):
-            req.max_duration_ms = _duration_ms(query["maxDuration"])
-        req.limit = int(query.get("limit", 20))
+        from .params import InvalidArgument
+
+        try:
+            req = tempopb.SearchRequest()
+            if query.get("service"):
+                req.tags["service.name"] = query["service"]
+            if query.get("operation"):
+                req.tags["name"] = query["operation"]
+            # jaeger sends start/end in µs epoch
+            if query.get("start"):
+                req.start = int(int(query["start"]) // 1_000_000)
+            if query.get("end"):
+                req.end = int(int(query["end"]) // 1_000_000) + 1
+            if query.get("minDuration"):
+                req.min_duration_ms = _duration_ms(query["minDuration"])
+            if query.get("maxDuration"):
+                req.max_duration_ms = _duration_ms(query["maxDuration"])
+            req.limit = int(query.get("limit", 20))
+        except ValueError as e:
+            raise InvalidArgument(f"bad jaeger search params: {e}") from None
         sresp = self.app.search(tenant, req)
 
         def fetch(meta):

@@ -855,6 +855,7 @@ class KafkaReceiver:
         self._started = time.time()
         # peer index → (last heartbeat value, monotonic time it changed)
         self._peer_seen: dict[int, tuple[int, float]] = {}
+        self._warned_blind = False  # one blind-liveness warning per life
         self.records_consumed = 0
         self.decode_errors = 0
         self.offset_resets = 0
@@ -931,6 +932,31 @@ class KafkaReceiver:
                 live.append(i)
             elif mono - prev[1] <= c.liveness_timeout_s:
                 live.append(i)
+        if c.members > 1 and live == [c.member_index] \
+                and self._last_beat > 0:
+            # Liveness says "everyone but me is gone". Before adopting
+            # the whole topic, distinguish dead peers from a BLIND
+            # readback path (broker that never serves the synthetic
+            # heartbeat partition, or offset state wiped mid-flight) by
+            # reading back our OWN heartbeat: if that is unreadable
+            # despite our commits succeeding, every member is reaching
+            # this same conclusion at once — silent group-wide duplicate
+            # consumption (ADVICE r4). Hold the static split and warn.
+            own_ok = False
+            try:
+                own_ok = self.client.fetch_offset(
+                    c.group_id, c.topic,
+                    _HEARTBEAT_PART_BASE + c.member_index) >= 0
+            except Exception:  # noqa: BLE001 — coordinator unreachable
+                pass
+            if not own_ok:
+                if not self._warned_blind:
+                    self._log.warning(
+                        "kafka group %s: own heartbeat does not read back "
+                        "from the coordinator — liveness is blind; holding "
+                        "the static %d-way split", c.group_id, c.members)
+                    self._warned_blind = True
+                live = list(range(c.members))
         if self._live != live:
             self._log.info("kafka group %s liveness: members %s of %d",
                            c.group_id, live, c.members)

@@ -29,11 +29,23 @@ DEFAULT_TENANT = "single-tenant"
 # can never drift from this one.
 
 
+class InvalidArgument(ValueError):
+    """Client-data error: HTTP 400 / gRPC INVALID_ARGUMENT. A dedicated
+    type so the gRPC layer can map ONLY genuine client mistakes to
+    non-retryable INVALID_ARGUMENT — server-side data errors that also
+    surface as ValueError (corrupt WAL entries, object framing) must
+    stay INTERNAL, not be pinned on the caller (ADVICE r4)."""
+
+
 def validate_tenant(tenant: str) -> str:
-    """The tenant id or ValueError (HTTP 400 / gRPC INVALID_ARGUMENT)."""
+    """The tenant id, or InvalidArgument (HTTP 400 / gRPC
+    INVALID_ARGUMENT)."""
     from tempo_tpu.utils.pathsafe import check_path_component
 
-    return check_path_component(tenant, "tenant id")
+    try:
+        return check_path_component(tenant, "tenant id")
+    except ValueError as e:
+        raise InvalidArgument(str(e)) from None
 
 
 def _parse_tags(val: str) -> dict[str, str]:
@@ -51,17 +63,22 @@ def _encode_tags(tags) -> str:
 
 
 def parse_search_request(query: dict[str, str]) -> tempopb.SearchRequest:
-    req = tempopb.SearchRequest()
-    for k, v in _parse_tags(query.get("tags", "")).items():
-        req.tags[k] = v
-    if "minDuration" in query:
-        req.min_duration_ms = _duration_ms(query["minDuration"])
-    if "maxDuration" in query:
-        req.max_duration_ms = _duration_ms(query["maxDuration"])
-    req.limit = int(query.get("limit", 0) or 0)
-    req.start = int(query.get("start", 0) or 0)
-    req.end = int(query.get("end", 0) or 0)
-    return req
+    try:
+        req = tempopb.SearchRequest()
+        for k, v in _parse_tags(query.get("tags", "")).items():
+            req.tags[k] = v
+        if "minDuration" in query:
+            req.min_duration_ms = _duration_ms(query["minDuration"])
+        if "maxDuration" in query:
+            req.max_duration_ms = _duration_ms(query["maxDuration"])
+        req.limit = int(query.get("limit", 0) or 0)
+        req.start = int(query.get("start", 0) or 0)
+        req.end = int(query.get("end", 0) or 0)
+        return req
+    except ValueError as e:
+        # query-param parse failures are CLIENT errors (400), never the
+        # 500 a bare ValueError now maps to on the serving path
+        raise InvalidArgument(f"bad search params: {e}") from None
 
 
 def build_search_request(req: tempopb.SearchRequest) -> str:

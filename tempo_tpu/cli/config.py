@@ -124,6 +124,10 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         # jaeger agent UDP ingest (compact/binary thrift emitBatch);
         # 0/absent = disabled, 6831 is the jaeger default
         "jaeger_agent_port": server.get("jaeger_agent_port", 0),
+        # /debug/* (stack dumps, scan internals) off by default on the
+        # serving port; flip on for a triage session or bind a separate
+        # admin ingress to a debug-enabled target (ADVICE r4)
+        "debug_endpoints": server.get("debug_endpoints", False),
         "multitenancy": doc.get("multitenancy_enabled", True),
         # memberlist: {bind: "host:port", join: [addr, ...], advertise_host,
         # gossip_interval_s, suspect_timeout_s} — multi-process gossip

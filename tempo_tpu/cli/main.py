@@ -83,7 +83,8 @@ def main(argv=None) -> int:
     if args.target == "all":
         app = App(cfg)
         app.run_maintenance()
-        api = HTTPApi(app, multitenancy=runtime["multitenancy"])
+        api = HTTPApi(app, multitenancy=runtime["multitenancy"],
+                      debug_endpoints=runtime["debug_endpoints"])
         http_server = serve_http(api, port=http_port)
         threading.Thread(target=http_server.serve_forever, daemon=True).start()
         grpc_server = make_grpc_server(app, f"0.0.0.0:{grpc_port}")
@@ -121,7 +122,8 @@ def main(argv=None) -> int:
         http_port=http_port,
         memberlist_cfg=runtime["memberlist"],
     )
-    api = HTTPApi(proc, multitenancy=runtime["multitenancy"])
+    api = HTTPApi(proc, multitenancy=runtime["multitenancy"],
+                  debug_endpoints=runtime["debug_endpoints"])
     http_server = serve_http(api, port=http_port)
     threading.Thread(target=http_server.serve_forever, daemon=True).start()
     jaeger_agent = None

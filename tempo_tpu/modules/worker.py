@@ -18,7 +18,9 @@ can only approximate with health probes and retries.
 from __future__ import annotations
 
 import itertools
+import os
 import queue as _queue
+import socket
 import threading
 import time
 
@@ -38,6 +40,8 @@ _worker_streams = Gauge("tempo_frontend_pull_worker_streams",
 
 SERVICE_FRONTEND = "tempopb.Frontend"
 PROCESS_METHOD = f"/{SERVICE_FRONTEND}/Process"
+
+_querier_id_seq = itertools.count(1)  # default PullWorker identities
 
 
 class JobFailed(Exception):
@@ -77,11 +81,18 @@ class PullDispatcher:
         # cache. 0 = off. Eligibility is rendezvous-hashed over the LIVE
         # stream set, so worker death self-heals the shard
         self.max_queriers_per_tenant = max_queriers_per_tenant
-        # (epoch, worker-id tuple): replaced wholesale under _lock on
-        # membership change, read WITHOUT the lock by the accept path —
-        # which runs under the queue's condition variable, where a
-        # dispatcher-lock acquire would serialize all dispatch traffic
-        self._shard_view: tuple[int, tuple[int, ...]] = (0, ())
+        # (epoch, distinct querier ids, stream-id → querier-id snapshot):
+        # replaced wholesale under _lock on membership change, read
+        # WITHOUT the lock by the accept path — which runs under the
+        # queue's condition variable, where a dispatcher-lock acquire
+        # would serialize all dispatch traffic. Eligibility ranks over
+        # QUERIER ids (one per querier process, sent as stream metadata),
+        # not stream ids, so parallelism>1 doesn't shrink a tenant's
+        # shard below max_queriers_per_tenant distinct queriers — the
+        # reference's per-querier shuffle-shard semantics
+        # (modules/frontend/v1/frontend.go getOrCreateQueue).
+        self._shard_view: tuple[int, tuple[str, ...], dict[int, str]] = (
+            0, (), {})
         # tenant → (epoch, eligible frozenset); bounded
         from collections import OrderedDict
         self._shard_cache: OrderedDict[str, tuple] = OrderedDict()
@@ -98,7 +109,7 @@ class PullDispatcher:
         self._ids = itertools.count(1)
         self._workers = 0
         self._worker_seq = itertools.count(1)
-        self._worker_ids: set[int] = set()
+        self._worker_qids: dict[int, str] = {}  # stream id → querier id
         self.max_redeliveries = max_redeliveries
         self.stopped = False
         self.delivered = 0   # results handed back to waiters
@@ -147,22 +158,23 @@ class PullDispatcher:
 
     # ---- stream-servicer-facing ----
 
-    def register_worker(self) -> int:
+    def register_worker(self, querier_id: str | None = None) -> int:
+        """querier_id identifies the querier PROCESS (stream metadata);
+        all of its streams shard as one unit. Absent (old clients), each
+        stream counts as its own querier — the pre-metadata behavior."""
         with self._lock:
             self._workers += 1
             wid = next(self._worker_seq)
-            self._worker_ids.add(wid)
-            self._shard_view = (self._shard_view[0] + 1,
-                                tuple(self._worker_ids))
+            self._worker_qids[wid] = querier_id or f"stream-{wid}"
+            self._update_shard_view()
             _worker_streams.set(self._workers, instance=self.instance)
             return wid
 
     def unregister_worker(self, worker_id: int) -> None:
         with self._lock:
             self._workers -= 1
-            self._worker_ids.discard(worker_id)
-            self._shard_view = (self._shard_view[0] + 1,
-                                tuple(self._worker_ids))
+            self._worker_qids.pop(worker_id, None)
+            self._update_shard_view()
             _worker_streams.set(self._workers, instance=self.instance)
         if self.max_queriers_per_tenant > 0:
             # survivors inherit the dead worker's tenants NOW: blocked
@@ -170,27 +182,34 @@ class PullDispatcher:
             # poll timeout on already-queued jobs
             self._queue.kick()
 
+    def _update_shard_view(self) -> None:  # callers hold self._lock
+        self._shard_view = (self._shard_view[0] + 1,
+                            tuple(sorted(set(self._worker_qids.values()))),
+                            dict(self._worker_qids))
+
     def eligible(self, tenant: str, worker_id: int) -> bool:
-        """Querier shuffle-shard: is this worker in the tenant's top-S
-        rendezvous set over the LIVE streams? With sharding off, fewer
-        workers than S, or an unknown id, everyone is eligible. Cached
-        per tenant against the membership epoch, and lock-free on the
-        hot path (this runs inside the queue's condition variable)."""
+        """Querier shuffle-shard: is this stream's QUERIER in the
+        tenant's top-S rendezvous set over the live querier processes?
+        With sharding off, fewer queriers than S, or an unknown id,
+        everyone is eligible. Cached per tenant against the membership
+        epoch, and lock-free on the hot path (this runs inside the
+        queue's condition variable)."""
         s = self.max_queriers_per_tenant
         if s <= 0:
             return True
-        epoch, ids = self._shard_view  # atomic tuple read, no lock
-        if len(ids) <= s or worker_id not in ids:
+        epoch, qids, wid_map = self._shard_view  # atomic tuple read
+        qid = wid_map.get(worker_id)
+        if qid is None or len(qids) <= s:
             return True
         hit = self._shard_cache.get(tenant)
         if hit is not None and hit[0] == epoch:
-            return worker_id in hit[1]
-        ranked = sorted(ids, key=lambda w: fnv1a_32(f"{tenant}/{w}".encode()))
+            return qid in hit[1]
+        ranked = sorted(qids, key=lambda q: fnv1a_32(f"{tenant}/{q}".encode()))
         shard = frozenset(ranked[:s])
         self._shard_cache[tenant] = (epoch, shard)
         while len(self._shard_cache) > 4096:
             self._shard_cache.popitem(last=False)
-        return worker_id in shard
+        return qid in shard
 
     def next_job(self, timeout: float | None = None,
                  worker_id: int | None = None):
@@ -259,7 +278,8 @@ def make_frontend_pull_handler(dispatcher: PullDispatcher):
     import grpc
 
     def process(request_iterator, context):
-        wid = dispatcher.register_worker()
+        md = dict(context.invocation_metadata() or ())
+        wid = dispatcher.register_worker(md.get("querier-id"))
         entry = None
         try:
             while True:
@@ -434,10 +454,16 @@ class PullWorker:
     a restarted frontend gets its workers back without operator action."""
 
     def __init__(self, querier, frontend_address: str, parallelism: int = 2,
-                 reconnect_backoff_s: float = 1.0):
+                 reconnect_backoff_s: float = 1.0,
+                 querier_id: str | None = None):
         self.querier = querier
         self.address = frontend_address
         self.backoff_s = reconnect_backoff_s
+        # one id per querier PROCESS (shared by all this worker's streams)
+        # so the frontend's shuffle-shard counts queriers, not streams;
+        # standalone PullWorkers (no manager) default to a unique id each
+        self.querier_id = querier_id or (
+            f"{socket.gethostname()}-{os.getpid()}-{next(_querier_id_seq)}")
         self._stop = threading.Event()
         self._threads = []
         self._calls_lock = threading.Lock()
@@ -471,7 +497,8 @@ class PullWorker:
                             return
                         yield item
 
-                call = rpc(req_iter())
+                call = rpc(req_iter(),
+                           metadata=(("querier-id", self.querier_id),))
                 with self._calls_lock:
                     if self._stop.is_set():
                         call.cancel()
@@ -550,6 +577,10 @@ class PullWorkerManager:
         self.querier = querier
         self.ml = memberlist
         self.parallelism = parallelism
+        # one identity for this querier process, shared across every
+        # frontend's PullWorker — the unit the shuffle-shard counts
+        self.querier_id = (f"{socket.gethostname()}-{os.getpid()}-"
+                           f"{next(_querier_id_seq)}")
         self._workers: dict[str, PullWorker] = {}
         self._stop = threading.Event()
         # serializes refresh() against stop() so a refresh racing the
@@ -580,7 +611,8 @@ class PullWorkerManager:
             for addr in want:
                 if addr not in self._workers:
                     self._workers[addr] = PullWorker(
-                        self.querier, addr, parallelism=self.parallelism)
+                        self.querier, addr, parallelism=self.parallelism,
+                        querier_id=self.querier_id)
 
     def stop(self) -> None:
         self._stop.set()

@@ -394,6 +394,19 @@ def test_debug_threads_dumps_all_stacks(app):
     assert "test_debug_threads_dumps_all_stacks" in body  # our own frame
 
 
+def test_debug_endpoints_gated_off(app):
+    """ADVICE r4: /debug/* exposes stacks and internals; deployments
+    (cli/config.py server.debug_endpoints, default false) can turn the
+    routes off — they answer 404, everything else still works."""
+    api = HTTPApi(app, debug_endpoints=False)
+    for p in ("/debug/threads", "/debug/scan"):
+        code, body = api.handle("GET", p, {}, {})
+        assert code == 404, (p, code)
+        assert "disabled" in body["error"]
+    code, _ = api.handle("GET", "/ready", {}, {})
+    assert code in (200, 503)
+
+
 def test_debug_scan_reports_stage_breakdown(app):
     api = HTTPApi(app)
     tid = random_trace_id()
@@ -530,6 +543,41 @@ def test_grpc_invalid_tenant_is_invalid_argument(tmp_path):
             rpc(tempopb.PushBytesRequest(),
                 metadata=(("x-scope-orgid", "../../etc"),))
         assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        ch.close()
+    finally:
+        server.stop(0)
+
+
+def test_grpc_server_side_valueerror_is_internal(tmp_path):
+    """ADVICE r4: a plain ValueError from the handler (corrupt WAL
+    entry, object framing) is server-side — it must surface INTERNAL,
+    not be reclassified as a non-retryable client INVALID_ARGUMENT."""
+    import socket
+
+    import grpc
+
+    from tempo_tpu.api.grpc_service import make_module_grpc_server
+
+    class P:
+        def push_bytes(self, tenant, req):
+            raise ValueError("corrupt wal entry at offset 42")
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = make_module_grpc_server(f"127.0.0.1:{port}", pusher=P())
+    server.start()
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        rpc = ch.unary_unary(
+            "/tempopb.Pusher/PushBytes",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=tempopb.PushResponse.FromString)
+        with pytest.raises(grpc.RpcError) as ei:
+            rpc(tempopb.PushBytesRequest(),
+                metadata=(("x-scope-orgid", "fine-tenant"),))
+        assert ei.value.code() == grpc.StatusCode.INTERNAL
+        assert "corrupt wal entry" in ei.value.details()
         ch.close()
     finally:
         server.stop(0)

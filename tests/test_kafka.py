@@ -477,6 +477,27 @@ def test_revived_member_reclaims_partitions(broker):
     client.close()
 
 
+def test_blind_heartbeats_hold_static_split(broker):
+    """ADVICE r4: if our own heartbeat never reads back (a broker that
+    accepts but does not serve the synthetic partition), every member
+    would see only itself live and adopt the whole topic. The receiver
+    must detect the blind readback and hold the static split instead."""
+    import time as _t
+
+    cfg = KafkaReceiverConfig(
+        [broker.addr], start_at="earliest", member_index=0, members=2,
+        heartbeat_interval_s=0.05, liveness_timeout_s=0.2)
+    rx = KafkaReceiver(cfg, lambda t, b: None)
+    rx.poll_once()  # commits a heartbeat (so the blind check is armed)
+    # broker "loses" every heartbeat readback from here on
+    rx.client.fetch_offset = lambda *a, **k: -1
+    _t.sleep(0.3)  # past the startup grace
+    rx._live_checked = 0.0
+    assert rx._live_members() == [0, 1]  # static roster, not self-only
+    assert set(rx._my_partitions({0: 1, 1: 1})) == {0}
+    rx.stop()
+
+
 def test_sticky_reassignment_moves_only_dead_members_share(broker):
     """members=3, member 1 dead: members 0 and 2 keep their static
     partitions; only member 1's fold onto survivors."""

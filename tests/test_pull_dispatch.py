@@ -388,6 +388,48 @@ def test_shuffle_shard_heals_on_worker_death():
         d.stop()
 
 
+def test_shuffle_shard_counts_queriers_not_streams():
+    """ADVICE r4: with parallelism=2 (two streams per querier process),
+    a tenant capped at S queriers must still spread over S DISTINCT
+    querier processes — and every stream of an eligible querier is
+    eligible."""
+    d = PullDispatcher(max_queriers_per_tenant=2)
+    streams = {}  # querier id → its two stream ids
+    for q in ("qA", "qB", "qC", "qD"):
+        streams[q] = [d.register_worker(q), d.register_worker(q)]
+    try:
+        for t in ("tenant-a", "tenant-b", "tenant-c"):
+            elig_q = {q for q, wids in streams.items()
+                      if any(d.eligible(t, w) for w in wids)}
+            assert len(elig_q) == 2, (t, elig_q)
+            for q, wids in streams.items():
+                # both streams of a querier agree — all-or-nothing
+                assert d.eligible(t, wids[0]) == d.eligible(t, wids[1])
+        # querier death (both streams) heals the shard
+        victim = sorted(streams)[0]
+        for w in streams.pop(victim):
+            d.unregister_worker(w)
+        for t in ("tenant-a", "tenant-b", "tenant-c"):
+            elig_q = {q for q, wids in streams.items()
+                      if any(d.eligible(t, w) for w in wids)}
+            assert len(elig_q) == 2, (t, elig_q)
+    finally:
+        d.stop()
+
+
+def test_pull_worker_streams_share_querier_identity(frontend_server):
+    """E2E: one PullWorker with parallelism=2 opens two streams that
+    register under ONE querier id (sent as stream metadata)."""
+    d, addr = frontend_server
+    w = PullWorker(FakeQuerier("q1"), addr, parallelism=2)
+    try:
+        wait_for(lambda: d.workers() >= 2, what="both streams connect")
+        qids = set(d._worker_qids.values())
+        assert qids == {w.querier_id}, qids
+    finally:
+        w.stop()
+
+
 def test_shuffle_shard_off_by_default():
     d = PullDispatcher()
     w = d.register_worker()
