@@ -419,7 +419,16 @@ def bench_scale(n_blocks, entries_per_block, iters):
         http_p95 = http_lat[min(len(http_lat) - 1,
                                 int(len(http_lat) * 0.95))] * 1e3
 
+        # VERDICT r4 #3: cold RESTART against the same corpus — a brand
+        # new process with the persistent XLA compile cache + header
+        # snapshot (saved below) answering its first query. Same batch
+        # config so the kernel shapes (and thus cache keys) match.
+        db.save_host_state()
+        restart = _measure_restart(td, "bench",
+                                   db.cfg.search_max_batch_pages)
+
         return {
+            **restart,
             "blocks": n_blocks,
             "entries_per_block": entries_per_block,
             "total_entries": total,
@@ -439,6 +448,53 @@ def bench_scale(n_blocks, entries_per_block, iters):
             # request, residual latency = the relay sync floor
             "http_dispatches_per_request": round(http_dispatches_per_req, 2),
         }
+
+
+_RESTART_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, sys.argv[1])
+from tempo_tpu.utils.jaxenv import honor_jax_platforms
+honor_jax_platforms(required=True)
+from tempo_tpu import tempopb
+from tempo_tpu.backend.local import LocalBackend
+from tempo_tpu.db import TempoDB, TempoDBConfig
+td, tenant, batch_pages = sys.argv[2], sys.argv[3], int(sys.argv[4])
+db = TempoDB(LocalBackend(td + "/blocks"), td + "/wal",
+             TempoDBConfig(search_max_batch_pages=batch_pages))
+t0 = time.perf_counter(); db.poll()
+poll_ms = (time.perf_counter() - t0) * 1e3
+req = tempopb.SearchRequest()
+req.tags["service.name"] = "svc-001"
+req.tags["http.status_code"] = "500"
+req.limit = 20
+t0 = time.perf_counter()
+r = db.search(tenant, req)
+q_ms = (time.perf_counter() - t0) * 1e3
+print(json.dumps({"restart_poll_ms": round(poll_ms, 1),
+                  "restart_first_query_ms": round(q_ms, 1),
+                  "restart_inspected": r.metrics.inspected_traces}))
+"""
+
+
+def _measure_restart(td: str, tenant: str, batch_pages: int) -> dict:
+    """First-query cost of a brand-new PROCESS over an existing corpus:
+    persistent compile cache + header snapshot make this seconds, not
+    the ~31 s re-pay (r4 scale_10k.first_query_ms). Returns {} on any
+    child failure — the restart number is additive, never fatal."""
+    import subprocess
+
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _RESTART_CHILD, _HERE, td, tenant,
+             str(batch_pages)],
+            capture_output=True, text=True, timeout=600)
+        for line in reversed(p.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        return {"restart_error": f"rc={p.returncode}: "
+                                 f"{(p.stderr or '')[-300:]}"}
+    except Exception as e:  # noqa: BLE001
+        return {"restart_error": repr(e)}
 
 
 def bench_scale_large(n_blocks, entries_per_block, iters):
@@ -972,6 +1028,12 @@ def orchestrate() -> int:
 
     results: dict = {}
     extra_env: dict = {}
+    # one persistent XLA compile cache across every phase child (and
+    # the scale phase's restart sub-child): later phases replay shared
+    # kernel compiles from disk instead of re-paying them
+    if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        extra_env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+            ckpt_dir, "xla-cache")
 
     def emit_and_exit(rc: int) -> int:
         doc = _assemble(results)

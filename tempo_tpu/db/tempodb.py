@@ -9,6 +9,7 @@ predicates (id-range shard + time window).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -72,6 +73,12 @@ class TempoDBConfig:
     search_prewarm_on_poll: bool = False
     # shard batches over the device mesh when >1 device is visible
     auto_mesh: bool = True
+    # restartable host state (VERDICT r4 #3): None = auto (persistent
+    # XLA compile cache + header snapshot under <wal_dir>/host-state);
+    # "" disables; a path overrides the location. A cold restart then
+    # replays compiles from disk and loads header rollups without one
+    # backend read per block.
+    host_state_dir: str | None = None
 
 
 class TempoDB:
@@ -122,6 +129,19 @@ class TempoDB:
         # (search_blocks)
         self._breq_jobs_cache = BoundedCache(32)
         self._search_lock = threading.Lock()
+        # restartable host state: header snapshot + persistent XLA
+        # compile cache. Auto default lives under the WAL dir — per-node
+        # durable storage that already must survive restarts. The
+        # snapshot sits in a SUBDIR because WAL replay deletes unknown
+        # files in its root.
+        sd = self.cfg.host_state_dir
+        self._state_dir = (os.path.join(wal_dir, "host-state")
+                          if sd is None else (sd or None))
+        if self._state_dir:
+            from tempo_tpu.utils.jaxenv import enable_compile_cache
+
+            enable_compile_cache(os.path.join(self._state_dir, "xla-cache"))
+            self._load_host_state()
 
     def _ensure_mesh(self) -> None:
         if self._mesh_resolved:
@@ -225,6 +245,7 @@ class TempoDB:
         else:
             self.stop_prewarm()
             self.batcher.invalidate(live)
+        self.save_host_state()
 
     def prewarm(self, tenants: list[str], background: bool = True,
                 reinvalidate: set | None = None) -> "threading.Thread | int":
@@ -274,6 +295,10 @@ class TempoDB:
                         continue
                 groups = self.batcher.plan(jobs)
                 staged += self.batcher.prewarm(groups, stop=stop)
+            # job planning above read EVERY live block's header — persist
+            # the now-complete rollup set for the next process
+            if not stop.is_set():
+                self.save_host_state()
             return staged
 
         if not background:
@@ -336,12 +361,65 @@ class TempoDB:
         with self._search_lock:
             bsb = self._search_blocks.get(meta.block_id)
             if bsb is None:
-                bsb = BackendSearchBlock(self.backend, meta)
+                bsb = BackendSearchBlock(
+                    self.backend, meta,
+                    header=self._headers.get(meta.block_id))
                 self._search_blocks[meta.block_id] = bsb
                 # bounded HBM cache: evict oldest staged blocks
                 while len(self._search_blocks) > self.cfg.search_cache_blocks:
                     self._search_blocks.pop(next(iter(self._search_blocks)))
             return bsb
+
+    def _snapshot_path(self) -> str | None:
+        return (os.path.join(self._state_dir, "search-headers.json.gz")
+                if self._state_dir else None)
+
+    def _load_host_state(self) -> None:
+        """Load the header-rollup snapshot a previous process saved —
+        job planning over a 10K-block tenant then costs zero backend
+        header reads on the first query after a restart. Stale entries
+        (blocks since deleted) are pruned by the next poll()."""
+        import gzip
+        import json as _json
+
+        path = self._snapshot_path()
+        if not path:
+            return
+        try:
+            with open(path, "rb") as f:
+                doc = _json.loads(gzip.decompress(f.read()))
+            headers = doc["headers"] if doc.get("v") == 1 else {}
+        except (OSError, EOFError, ValueError, KeyError, TypeError):
+            return  # torn/corrupt snapshot: a cache, rebuild lazily
+        with self._search_lock:
+            for bid, hdr in headers.items():
+                if isinstance(bid, str) and isinstance(hdr, dict):
+                    self._headers[bid] = hdr
+            while len(self._headers) > self._headers_max:
+                self._headers.popitem(last=False)
+
+    def save_host_state(self) -> None:
+        """Snapshot the header cache next to the WAL (atomic rename).
+        Called after every poll and prewarm; cheap (~100 KB gz at 10K
+        blocks), so no debouncing needed."""
+        import gzip
+        import json as _json
+
+        path = self._snapshot_path()
+        if not path:
+            return
+        with self._search_lock:
+            doc = {"v": 1, "headers": dict(self._headers)}
+        try:
+            os.makedirs(self._state_dir, exist_ok=True)
+            blob = gzip.compress(
+                _json.dumps(doc).encode(), compresslevel=1)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # snapshot is an optimization, never a failure
 
     def _header_for(self, m: BlockMeta) -> dict:
         """Block search-header rollup, cached by block id (immutable once

@@ -160,8 +160,13 @@ class App:
     # ---- lifecycle ----
 
     def run_maintenance(self) -> None:
-        def loop(tick_s, fn):
+        def loop(tick_s, fn, immediate=False):
             def body():
+                if immediate:  # restart must not serve an empty
+                    try:       # blocklist for a full poll interval
+                        fn()
+                    except Exception:  # noqa: BLE001
+                        pass
                 while not self._stop.wait(tick_s):
                     try:
                         fn()
@@ -172,7 +177,7 @@ class App:
             self._threads.append(t)
 
         loop(self.cfg.flush_tick_s, self.flush_tick)
-        loop(self.cfg.poll_tick_s, self.poll_tick)
+        loop(self.cfg.poll_tick_s, self.poll_tick, immediate=True)
         loop(self.cfg.compaction_tick_s, self.compaction_tick)
         loop(5.0, self.heartbeat_tick)
         if self.remote_write is not None:

@@ -385,8 +385,13 @@ class ModuleProcess:
     # ---- maintenance ----
 
     def _start_loops(self) -> None:
-        def loop(tick_s, fn):
+        def loop(tick_s, fn, immediate=False):
             def body():
+                if immediate:  # a restarted reader must not serve an
+                    try:       # empty blocklist for a full interval
+                        fn()
+                    except Exception:  # noqa: BLE001
+                        self.log.exception("%s maintenance", self.target)
                 while not self._stop.wait(tick_s):
                     try:
                         fn()
@@ -399,7 +404,7 @@ class ModuleProcess:
         if self.target == "ingester":
             loop(self.cfg.flush_tick_s, self.flush_tick)
         if self.target in ("querier", "query-frontend", "compactor"):
-            loop(self.cfg.poll_tick_s, self.db.poll)
+            loop(self.cfg.poll_tick_s, self.db.poll, immediate=True)
         if self.target == "compactor":
             loop(self.cfg.compaction_tick_s, self._compaction_tick)
 

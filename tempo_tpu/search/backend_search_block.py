@@ -65,10 +65,13 @@ def write_search_block(backend: RawBackend, meta: BlockMeta,
 
 
 class BackendSearchBlock:
-    def __init__(self, backend: RawBackend, meta: BlockMeta):
+    def __init__(self, backend: RawBackend, meta: BlockMeta,
+                 header: dict | None = None):
+        """header: an already-fetched rollup (TempoDB's header cache /
+        restart snapshot) — saves one backend GET per container open."""
         self.backend = backend
         self.meta = meta
-        self._header: dict | None = None
+        self._header: dict | None = header
         self._pages: ColumnarPages | None = None
         self._staged: StagedPages | None = None
         self._lock = __import__("threading").Lock()

@@ -391,7 +391,7 @@ class BlockBatcher:
             per-block compile + metric sums. `skip` is the header-prune
             list (already computed for the pre-staging fast path)."""
             mq = compile_multi([b for b in cached.batch.blocks], req,
-                               skip=skip)
+                               skip=skip, cache_on=cached.batch)
             if mq is None:
                 return {"all_skip": True, "skipped": len(group)}
             # dictionary-pruned jobs (term key -1 across all terms) count

@@ -325,6 +325,29 @@ def test_multiblock_per_block_dictionaries_differ():
     assert got == {(b"\x01" * 16).hex(), (b"\x02" * 16).hex()}
 
 
+def test_compile_multi_skipped_group_wider_ranges():
+    """code-review r5: a dict group whose EVERY row is header-skipped may
+    compile more disjoint value-id ranges than the unskipped width —
+    assembly must clamp both axes, and the skipped rows end masked."""
+    from tempo_tpu.search.multiblock import compile_multi
+
+    a = SearchData(trace_id=b"\x01" * 16, start_s=10, end_s=20, dur_ms=5)
+    a.kvs = {"k": {"svcA"}}
+    # disjoint dictionary ids for the substring "svc" → R_cq = 2 ranges
+    b = SearchData(trace_id=b"\x02" * 16, start_s=10, end_s=20, dur_ms=5)
+    b.kvs = {"k": {"asvcq"}, "m": {"bbb"}, "n": {"csvcq"}}
+    blocks = [ColumnarPages.build([a], PageGeometry(4, 8)),
+              ColumnarPages.build([b], PageGeometry(4, 8))]
+    req = _mk_req({"k": "svc"})
+    req.limit = 10
+    mq = compile_multi(blocks, req, skip=[False, True])
+    assert mq is not None
+    assert (mq.term_keys[1] == -1).all()          # skipped row masked
+    assert (mq.val_ranges[1, :, :, 0] == 1).all()  # empty [1,0] ranges
+    assert (mq.val_ranges[1, :, :, 1] == 0).all()
+    assert (mq.term_keys[0] != -1).any()           # live row intact
+
+
 def test_compile_cache_skips_dictionary_probe():
     """Per-(block, tag-set) compile cache (VERDICT r2 #1): the second
     compilation of the same tags against the same block skips the
