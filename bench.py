@@ -551,8 +551,13 @@ def bench_scale_large(n_blocks, entries_per_block, iters):
             list(ex.map(write_block, range(n_blocks)))
         build_s = time.perf_counter() - t0
 
+        # 8192-page groups (~100-200 MB staged): the eviction quantum.
+        # r4 used 32768 → 720 MB groups whose relay-bound re-stage cost
+        # ~19 s; smaller groups put an evicted-group query at low
+        # seconds for a few extra (async-enqueued) dispatches per query
         db = TempoDB(be, td + "/wal", TempoDBConfig(
-            search_max_batch_pages=32768,
+            search_max_batch_pages=int(os.environ.get(
+                "BENCH_LARGE_BATCH_PAGES", 8192)),
             search_batch_cache_bytes=13 << 30,   # v5e HBM is 16 GB
             search_host_cache_bytes=48 << 30,
         ))

@@ -78,6 +78,19 @@ def stack_host(blocks: list[ColumnarPages],
     """Concatenate uniform-geometry blocks along the page axis on host."""
     E = blocks[0].geometry.entries_per_page
     C = max(b.geometry.kv_per_entry for b in blocks)
+    # narrow the kv columns to the smallest dtype the dictionaries allow:
+    # the kernel compares against int32 term tables with XLA promoting
+    # inline (no widened copy materializes), so the RESIDENT format can
+    # be this narrow — the kv pair is ~70% of a batch's bytes, and both
+    # HBM footprint and an evicted group's re-stage time (H2D-bound
+    # through the axon relay at ~50 MB/s) shrink proportionally
+    # (VERDICT r4 #2). Dtype chosen BEFORE stacking so concatenate
+    # produces the narrow array directly (no full-width transient).
+    def _narrow(n):
+        return (np.int8 if n <= 127          # -1 sentinel stays in range
+                else np.int16 if n <= 32_767 else np.int32)
+    kv_dtype = {"kv_key": _narrow(max(len(b.key_dict) for b in blocks)),
+                "kv_val": _narrow(max(len(b.val_dict) for b in blocks))}
     arrays = {name: [] for name in ("kv_key", "kv_val", "entry_start",
                                     "entry_end", "entry_dur", "entry_valid")}
     page_block = []
@@ -90,9 +103,12 @@ def stack_host(blocks: list[ColumnarPages],
         P = b.n_pages
         for name in arrays:
             arr = getattr(b, name)
-            if name in ("kv_key", "kv_val") and arr.shape[2] < C:
-                pad = np.full((P, E, C - arr.shape[2]), -1, dtype=np.int32)
-                arr = np.concatenate([arr, pad], axis=2)
+            if name in ("kv_key", "kv_val"):
+                arr = arr.astype(kv_dtype[name], copy=False)
+                if arr.shape[2] < C:
+                    pad = np.full((P, E, C - arr.shape[2]), -1,
+                                  dtype=kv_dtype[name])
+                    arr = np.concatenate([arr, pad], axis=2)
             arrays[name].append(arr)
         page_block.extend([bi] * P)
         total += P

@@ -325,6 +325,49 @@ def test_multiblock_per_block_dictionaries_differ():
     assert got == {(b"\x01" * 16).hex(), (b"\x02" * 16).hex()}
 
 
+def test_stack_host_narrows_kv_dtypes():
+    """VERDICT r4 #2: small dictionaries stack as int8/int16 so HBM
+    bytes and the evicted-group re-stage shrink; results stay identical
+    to the int32 path (the kernel promotes inline)."""
+    import numpy as np
+
+    from tempo_tpu.search.multiblock import (
+        MultiBlockEngine, compile_multi, stack_blocks, stack_host,
+    )
+
+    blocks = [ColumnarPages.build(_corpus(40, seed=s), PageGeometry(8, 8))
+              for s in range(3)]
+    host = stack_host(blocks)
+    assert host.cat["kv_key"].dtype == np.int8
+    assert host.cat["kv_val"].dtype in (np.int8, np.int16)
+    # padded slots keep the -1 sentinel through the cast
+    assert (host.cat["kv_key"] >= -1).all()
+
+    # NB: not the ("service.name", "front") pair — the global compile
+    # cache is keyed by (dict fingerprint, tag-sig) and
+    # test_compile_cache_skips_dictionary_probe asserts that pair cold
+    req = _mk_req({"service.name": "ront"})
+    req.limit = 1000
+    mq = compile_multi(blocks, req)
+    eng = MultiBlockEngine()
+    count, inspected, scores, idx = eng.scan(stack_blocks(blocks), mq)
+    expected = sum(
+        1 for s in range(3) for sd in _corpus(40, seed=s)
+        if any("ront" in v for v in sd.kvs.get("service.name", ())))
+    assert int(count) == expected
+
+
+def test_stack_host_wide_dicts_stay_int32():
+    import numpy as np
+
+    from tempo_tpu.search.multiblock import stack_host
+
+    b = ColumnarPages.build(_corpus(20), PageGeometry(8, 8))
+    b.val_dict = b.val_dict + [f"v{i:07d}" for i in range(40_000)]
+    host = stack_host([b])
+    assert host.cat["kv_val"].dtype == np.int32
+
+
 def test_compile_multi_skipped_group_wider_ranges():
     """code-review r5: a dict group whose EVERY row is header-skipped may
     compile more disjoint value-id ranges than the unskipped width —
