@@ -265,3 +265,588 @@ unsigned int tt_crc32c(const char* data, size_t len, unsigned int crc) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// OTLP ingest fast path: regroup-by-trace + search-data extraction + time
+// range in ONE native pass over SERIALIZED ResourceSpans — the role the
+// reference's Go distributor hot loop fills (distributor.go:442-516 +
+// requestsByTraceID), where our Python per-span object walk was the
+// measured ingest ceiling (VERDICT r4 #4).
+//
+// Input:  concatenated [u32le len][ResourceSpans bytes] records.
+// Output: u32 n_traces, u32 n_spans, then per trace:
+//           16B padded trace id, u32 start_s, u32 end_s,
+//           u32 seg_len  + seg   (8B v2 header + Trace proto bytes),
+//           u32 sd_len   + sd    (search-data wire format, data.py:191)
+// Returns bytes written; -2 malformed proto; -3 output too small (caller
+// grows and retries); -4 invalid trace id (caller falls back to the
+// Python path so the user-visible error is identical).
+
+#include <algorithm>
+#include <array>
+#include <charconv>
+#include <cstdio>
+#include <map>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Range { size_t off, len; };  // into the input buffer
+
+static bool rd_varint(const uint8_t* p, size_t n, size_t& off, uint64_t& v) {
+  v = 0;
+  int shift = 0;
+  while (off < n && shift < 64) {
+    uint8_t b = p[off++];
+    v |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) return true;
+    shift += 7;
+  }
+  return false;
+}
+
+// skip one field's value given its wire type; LEN returns the payload range
+static bool rd_skip(const uint8_t* p, size_t n, size_t& off, uint32_t wt,
+                    Range* payload) {
+  uint64_t v;
+  switch (wt) {
+    case 0: return rd_varint(p, n, off, v);
+    case 1: if (off + 8 > n) return false; off += 8; return true;
+    case 5: if (off + 4 > n) return false; off += 4; return true;
+    case 2: {
+      // v can be a full 64-bit value from a hostile 10-byte varint:
+      // compare against the REMAINING bytes so `off + v` cannot wrap
+      if (!rd_varint(p, n, off, v) || v > n - off) return false;
+      if (payload) *payload = {off, (size_t)v};
+      off += v;
+      return true;
+    }
+    default: return false;
+  }
+}
+
+// python repr() of a double, byte-for-byte: shortest round-trip digits
+// (std::to_chars scientific), re-formatted by CPython's rule — FIXED
+// notation when the decimal exponent is in [-4, 16), scientific with a
+// 2-digit signed exponent otherwise. to_chars alone picks scientific
+// whenever strictly shorter (2e5 → "2e+05" where Python says
+// "200000.0"), which broke search-data parity (code-review r5).
+static std::string py_double_repr(double d) {
+  if (d != d) return "nan";
+  if (d == __builtin_inf()) return "inf";
+  if (d == -__builtin_inf()) return "-inf";
+  char buf[64];
+  auto res = std::to_chars(buf, buf + sizeof(buf), d,
+                           std::chars_format::scientific);
+  std::string s(buf, res.ptr);  // [-]D[.DDDD]e±EE — shortest digits
+  bool neg = s[0] == '-';
+  size_t i = neg ? 1 : 0;
+  size_t epos = s.find('e', i);
+  std::string digits;
+  for (size_t j = i; j < epos; j++)
+    if (s[j] != '.') digits += s[j];
+  int exp = atoi(s.c_str() + epos + 1);
+  std::string out = neg ? "-" : "";
+  if (exp >= -4 && exp < 16) {
+    if (exp >= (int)digits.size() - 1) {        // integral: pad + ".0"
+      out += digits;
+      out.append(exp - (digits.size() - 1), '0');
+      out += ".0";
+    } else if (exp >= 0) {                      // point inside digits
+      out += digits.substr(0, exp + 1) + "." + digits.substr(exp + 1);
+    } else {                                    // leading zeros
+      out += "0.";
+      out.append(-exp - 1, '0');
+      out += digits;
+    }
+  } else {                                      // python scientific
+    out += digits.substr(0, 1);
+    if (digits.size() > 1) out += "." + digits.substr(1);
+    char e[8];
+    snprintf(e, sizeof(e), "e%+03d", exp);
+    out += e;
+  }
+  return out;
+}
+
+// AnyValue → string per data.py _any_value_str (empty = unindexed type)
+static bool anyvalue_str(const uint8_t* p, Range r, std::string& out) {
+  size_t off = r.off, end = r.off + r.len;
+  out.clear();
+  // last occurrence wins (proto3 oneof semantics on the wire)
+  while (off < end) {
+    uint64_t tag;
+    if (!rd_varint(p, end, off, tag)) return false;
+    uint32_t f = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    Range pay{0, 0};
+    size_t before = off;
+    if (f == 1 && wt == 2) {           // string_value
+      if (!rd_skip(p, end, off, wt, &pay)) return false;
+      out.assign((const char*)p + pay.off, pay.len);
+    } else if (f == 2 && wt == 0) {    // bool_value
+      uint64_t v; if (!rd_varint(p, end, off, v)) return false;
+      out = v ? "true" : "false";
+    } else if (f == 3 && wt == 0) {    // int_value (zigzag? no — int64)
+      uint64_t v; if (!rd_varint(p, end, off, v)) return false;
+      char b[24];
+      auto res = std::to_chars(b, b + sizeof(b), (long long)v);
+      out.assign(b, res.ptr);
+    } else if (f == 4 && wt == 1) {    // double_value
+      if (off + 8 > end) return false;
+      double d; memcpy(&d, p + off, 8); off += 8;
+      out = py_double_repr(d);
+    } else {
+      if (!rd_skip(p, end, off, wt, nullptr)) return false;
+      out.clear();                     // array/kvlist/bytes → unindexed
+    }
+    (void)before;
+  }
+  return true;
+}
+
+// utf-8 character count (python len(str)) — budget accounting must match
+static size_t u8len(const std::string& s) {
+  size_t n = 0;
+  for (unsigned char c : s) n += (c & 0xC0) != 0x80;
+  return n;
+}
+
+static size_t varint_size(uint64_t v) {
+  size_t n = 1;
+  while (v >= 0x80) { v >>= 7; n++; }
+  return n;
+}
+
+// per-span summary row for the metrics-generator feed: the generator
+// thread consumes these (56B fixed records + a string table) instead of
+// re-walking the proto objects — both the second walk and most of its
+// GIL steal from the ingest ack path disappear (VERDICT r4 #4)
+struct RowTmp {
+  uint32_t trace_idx, svc_idx, name_idx, kind, status, flags;
+  uint64_t start_ns, end_ns;
+  uint8_t span_id[8], parent_id[8];
+};
+
+struct ScopeOut {
+  std::vector<Range> passthrough;  // scope + schema_url fields, verbatim
+  std::vector<Range> spans;        // span payloads (field 2 LEN values)
+  size_t body_size = 0;            // computed at emit
+};
+
+struct BatchOut {
+  std::vector<Range> passthrough;  // resource + schema_url, verbatim
+  std::vector<ScopeOut> scopes;
+  size_t body_size = 0;
+};
+
+struct TraceOut {
+  std::array<uint8_t, 16> tid{};
+  std::vector<BatchOut> batches;
+  std::map<std::string, std::set<std::string>> kvs;
+  long long budget = 0;
+  uint64_t min_start = ~0ull, max_end = 0;
+  bool have_root = false;
+  uint64_t root_start = 0, first_start = 0;
+  std::string root_svc, root_name, first_svc, first_name;
+  bool have_first = false;
+};
+
+static void kv_add(TraceOut& t, const std::string& k, const std::string& v) {
+  if (v.empty()) return;
+  long long cost = (long long)(u8len(k) + u8len(v));
+  if (t.budget < cost) return;
+  auto& s = t.kvs[k];
+  if (s.insert(v).second) t.budget -= cost;
+  else if (s.size() == 0) t.kvs.erase(k);  // unreachable; keep -Wall quiet
+}
+
+static void put_u32(std::string& out, uint32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);
+  out.append(b, 4);
+}
+
+static void put_u16s(std::string& out, const std::string& s) {
+  size_t n = std::min(s.size(), (size_t)0xFFFF);
+  uint16_t len = (uint16_t)n;
+  char b[2];
+  memcpy(b, &len, 2);
+  out.append(b, 2);
+  out.append(s.data(), n);
+}
+
+}  // namespace
+
+extern "C" {
+
+long long tt_ingest_regroup(const char* src_c, size_t src_len,
+                            long long max_search_bytes,
+                            char* dst, size_t dst_cap) {
+  const uint8_t* p = (const uint8_t*)src_c;
+  std::vector<TraceOut> traces;
+  std::unordered_map<std::string, int> tid_idx;  // padded tid → index
+  uint64_t n_spans = 0;
+  std::vector<RowTmp> rows;                      // generator summaries
+  std::vector<std::string> strtab;
+  std::unordered_map<std::string, uint32_t> str_idx;
+  auto intern = [&](const std::string& s) -> uint32_t {
+    auto it = str_idx.find(s);
+    if (it != str_idx.end()) return it->second;
+    uint32_t i = (uint32_t)strtab.size();
+    strtab.push_back(s);
+    str_idx.emplace(s, i);
+    return i;
+  };
+
+  size_t off = 0;
+  while (off < src_len) {
+    if (off + 4 > src_len) return -2;
+    uint32_t blen;
+    memcpy(&blen, p + off, 4);
+    off += 4;
+    if (off + blen > src_len) return -2;
+    size_t bend = off + blen;
+
+    // ---- one ResourceSpans ----
+    std::vector<Range> rs_passthrough;
+    std::string svc;                       // resource service.name
+    std::vector<std::pair<std::string, std::string>> res_kvs;
+    std::vector<Range> scope_payloads;
+    {
+      size_t o = off;
+      while (o < bend) {
+        size_t field_start = o;
+        uint64_t tag;
+        if (!rd_varint(p, bend, o, tag)) return -2;
+        uint32_t f = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+        Range pay{0, 0};
+        if (!rd_skip(p, bend, o, wt, &pay)) return -2;
+        if (f == 2 && wt == 2) {           // scope_spans
+          scope_payloads.push_back(pay);
+        } else {
+          rs_passthrough.push_back({field_start, o - field_start});
+          if (f == 1 && wt == 2) {         // resource → attributes
+            size_t ro = pay.off, rend = pay.off + pay.len;
+            while (ro < rend) {
+              uint64_t rtag;
+              if (!rd_varint(p, rend, ro, rtag)) return -2;
+              Range rpay{0, 0};
+              if (!rd_skip(p, rend, ro, (uint32_t)(rtag & 7), &rpay)) return -2;
+              if ((rtag >> 3) == 1 && (rtag & 7) == 2) {  // KeyValue
+                size_t ko = rpay.off, kend = rpay.off + rpay.len;
+                std::string key, val;
+                while (ko < kend) {
+                  uint64_t ktag;
+                  if (!rd_varint(p, kend, ko, ktag)) return -2;
+                  Range kpay{0, 0};
+                  if (!rd_skip(p, kend, ko, (uint32_t)(ktag & 7), &kpay))
+                    return -2;
+                  if ((ktag >> 3) == 1 && (ktag & 7) == 2)
+                    key.assign((const char*)p + kpay.off, kpay.len);
+                  else if ((ktag >> 3) == 2 && (ktag & 7) == 2) {
+                    if (!anyvalue_str(p, kpay, val)) return -2;
+                  }
+                }
+                res_kvs.emplace_back(key, val);
+                if (key == "service.name") svc = val;  // last wins (py parity)
+              }
+            }
+          }
+        }
+      }
+    }
+
+    // per-batch dest map: tid index → BatchOut index (id()-keyed regroup)
+    std::unordered_map<int, int> batch_dest;
+
+    for (const Range& sp : scope_payloads) {
+      // ---- one ScopeSpans ----
+      std::vector<Range> sc_passthrough;
+      std::vector<Range> span_payloads;
+      size_t o = sp.off, send = sp.off + sp.len;
+      while (o < send) {
+        size_t field_start = o;
+        uint64_t tag;
+        if (!rd_varint(p, send, o, tag)) return -2;
+        uint32_t f = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+        Range pay{0, 0};
+        if (!rd_skip(p, send, o, wt, &pay)) return -2;
+        if (f == 2 && wt == 2) span_payloads.push_back(pay);
+        else sc_passthrough.push_back({field_start, o - field_start});
+      }
+
+      // tid idx → (batch idx, scope idx); a packed-int encoding here
+      // overflowed at ~2148 scopes and crashed on valid input
+      // (code-review r5) — pay for the pair
+      std::unordered_map<int, std::pair<int, int>> scope_dest;
+
+      for (const Range& spn : span_payloads) {
+        // ---- one Span ----
+        size_t so = spn.off, ssend = spn.off + spn.len;
+        Range tid_r{0, 0}, name_r{0, 0};
+        Range span_id_r{0, 0}, parent_r{0, 0};
+        bool have_parent = false;
+        uint64_t start_ns = 0, end_ns = 0, kind = 0;
+        uint32_t status_code = 0;
+        std::vector<std::pair<std::string, std::string>> span_kvs;
+        while (so < ssend) {
+          uint64_t tag;
+          if (!rd_varint(p, ssend, so, tag)) return -2;
+          uint32_t f = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+          Range pay{0, 0};
+          if (f == 7 && wt == 1) {
+            if (so + 8 > ssend) return -2;
+            memcpy(&start_ns, p + so, 8); so += 8;
+            continue;
+          }
+          if (f == 8 && wt == 1) {
+            if (so + 8 > ssend) return -2;
+            memcpy(&end_ns, p + so, 8); so += 8;
+            continue;
+          }
+          if (f == 6 && wt == 0) {                 // kind
+            if (!rd_varint(p, ssend, so, kind)) return -2;
+            continue;
+          }
+          if (!rd_skip(p, ssend, so, wt, &pay)) return -2;
+          if (f == 1 && wt == 2) tid_r = pay;
+          else if (f == 2 && wt == 2) span_id_r = pay;
+          else if (f == 4 && wt == 2 && pay.len > 0) {
+            have_parent = true;
+            parent_r = pay;
+          }
+          else if (f == 5 && wt == 2) name_r = pay;
+          else if (f == 9 && wt == 2) {            // attributes KeyValue
+            size_t ko = pay.off, kend = pay.off + pay.len;
+            std::string key, val;
+            while (ko < kend) {
+              uint64_t ktag;
+              if (!rd_varint(p, kend, ko, ktag)) return -2;
+              Range kpay{0, 0};
+              if (!rd_skip(p, kend, ko, (uint32_t)(ktag & 7), &kpay))
+                return -2;
+              if ((ktag >> 3) == 1 && (ktag & 7) == 2)
+                key.assign((const char*)p + kpay.off, kpay.len);
+              else if ((ktag >> 3) == 2 && (ktag & 7) == 2) {
+                if (!anyvalue_str(p, kpay, val)) return -2;
+              }
+            }
+            span_kvs.emplace_back(key, val);
+          } else if (f == 15 && wt == 2) {         // status → code
+            size_t to = pay.off, tend = pay.off + pay.len;
+            while (to < tend) {
+              uint64_t ttag;
+              if (!rd_varint(p, tend, to, ttag)) return -2;
+              if ((ttag >> 3) == 3 && (ttag & 7) == 0) {
+                uint64_t v;
+                if (!rd_varint(p, tend, to, v)) return -2;
+                status_code = (uint32_t)v;
+              } else {
+                Range tpay{0, 0};
+                if (!rd_skip(p, tend, to, (uint32_t)(ttag & 7), &tpay))
+                  return -2;
+              }
+            }
+          }
+        }
+        if (tid_r.len == 0 || tid_r.len > 16) return -4;
+
+        std::string padded(16, '\0');
+        memcpy(&padded[16 - tid_r.len], p + tid_r.off, tid_r.len);
+        auto it = tid_idx.find(padded);
+        int ti;
+        if (it == tid_idx.end()) {
+          ti = (int)traces.size();
+          tid_idx.emplace(padded, ti);
+          traces.emplace_back();
+          memcpy(traces[ti].tid.data(), padded.data(), 16);
+          traces[ti].budget = max_search_bytes;
+        } else {
+          ti = it->second;
+        }
+        n_spans++;
+        // NOTE: `traces` may reallocate on emplace above — take the
+        // reference AFTER any potential growth
+        TraceOut& T = traces[ti];
+
+        auto sd_it = scope_dest.find(ti);
+        ScopeOut* SO;
+        if (sd_it == scope_dest.end()) {
+          auto bd_it = batch_dest.find(ti);
+          int bi;
+          if (bd_it == batch_dest.end()) {
+            bi = (int)T.batches.size();
+            T.batches.emplace_back();
+            T.batches[bi].passthrough = rs_passthrough;
+            batch_dest.emplace(ti, bi);
+            for (auto& kv : res_kvs) kv_add(T, kv.first, kv.second);
+          } else {
+            bi = bd_it->second;
+          }
+          BatchOut& B = T.batches[bi];
+          int si = (int)B.scopes.size();
+          B.scopes.emplace_back();
+          B.scopes[si].passthrough = sc_passthrough;
+          scope_dest.emplace(ti, std::make_pair(bi, si));
+          SO = &B.scopes[si];
+        } else {
+          SO = &T.batches[sd_it->second.first].scopes[sd_it->second.second];
+        }
+        SO->spans.push_back(spn);
+
+        if (start_ns < T.min_start) T.min_start = start_ns;
+        if (end_ns > T.max_end) T.max_end = end_ns;
+
+        std::string name((const char*)p + name_r.off, name_r.len);
+        if (!name.empty()) {
+          long long cost = 4 + (long long)u8len(name);
+          if (T.budget >= cost) {
+            auto& s = T.kvs["name"];
+            if (s.insert(name).second) T.budget -= cost;
+          }
+        }
+        if (status_code == 2 && T.budget >= 9) {   // STATUS_CODE_ERROR
+          auto& s = T.kvs["error"];
+          if (s.insert("true").second) T.budget -= 9;
+        }
+        for (auto& kv : span_kvs) kv_add(T, kv.first, kv.second);
+
+        if (!have_parent) {
+          if (!T.have_root || start_ns < T.root_start) {
+            T.have_root = true;
+            T.root_start = start_ns;
+            T.root_svc = svc;
+            T.root_name = name;
+          }
+        } else if (!T.have_first || start_ns < T.first_start) {
+          T.have_first = true;
+          T.first_start = start_ns;
+          T.first_svc = svc;
+          T.first_name = name;
+        }
+
+        RowTmp row{};
+        row.trace_idx = (uint32_t)ti;
+        row.svc_idx = intern(svc);
+        row.name_idx = intern(name);
+        row.kind = (uint32_t)kind;
+        row.status = status_code;
+        row.flags = have_parent ? 1u : 0u;
+        row.start_ns = start_ns;
+        row.end_ns = end_ns;
+        if (span_id_r.len && span_id_r.len <= 8)   // right-align, zero-pad
+          memcpy(row.span_id + (8 - span_id_r.len), p + span_id_r.off,
+                 span_id_r.len);
+        if (parent_r.len && parent_r.len <= 8)
+          memcpy(row.parent_id + (8 - parent_r.len), p + parent_r.off,
+                 parent_r.len);
+        rows.push_back(row);
+      }
+    }
+    off = bend;
+  }
+
+  // ---- emit ----
+  std::string out;
+  out.reserve(src_len + (traces.size() * 256) + 64);
+  put_u32(out, (uint32_t)traces.size());
+  put_u32(out, (uint32_t)n_spans);
+  for (auto& T : traces) {
+    uint64_t start_ns = T.max_end ? T.min_start : 0;
+    uint64_t end_ns = T.max_end;
+    uint32_t start_s = (uint32_t)((start_ns / 1000000000ull) & 0xFFFFFFFF);
+    uint32_t end_s = (uint32_t)((end_ns / 1000000000ull) & 0xFFFFFFFF);
+    uint64_t dur_ms = end_ns ? (end_ns - start_ns) / 1000000ull : 0;
+    if (dur_ms > 0xFFFFFFFFull) dur_ms = 0xFFFFFFFFull;
+
+    out.append((const char*)T.tid.data(), 16);
+    put_u32(out, start_s);
+    put_u32(out, end_s);
+
+    // segment: 8B header + Trace{repeated ResourceSpans batches = 1}
+    size_t seg_size = 8;
+    for (auto& B : T.batches) {
+      size_t body = 0;
+      for (auto& r : B.passthrough) body += r.len;
+      for (auto& S : B.scopes) {
+        size_t sbody = 0;
+        for (auto& r : S.passthrough) sbody += r.len;
+        for (auto& r : S.spans) sbody += 1 + varint_size(r.len) + r.len;
+        S.body_size = sbody;
+        body += 1 + varint_size(sbody) + sbody;
+      }
+      B.body_size = body;
+      seg_size += 1 + varint_size(body) + body;
+    }
+    put_u32(out, (uint32_t)seg_size);
+    char hdr[8];
+    memcpy(hdr, &start_s, 4);
+    memcpy(hdr + 4, &end_s, 4);
+    out.append(hdr, 8);
+    auto emit_varint = [&out](uint64_t v) {
+      while (v >= 0x80) { out.push_back((char)(v | 0x80)); v >>= 7; }
+      out.push_back((char)v);
+    };
+    for (auto& B : T.batches) {
+      out.push_back((char)0x0A);               // Trace.batches (field 1 LEN)
+      emit_varint(B.body_size);
+      for (auto& r : B.passthrough)
+        out.append((const char*)p + r.off, r.len);
+      for (auto& S : B.scopes) {
+        out.push_back((char)0x12);             // ResourceSpans.scope_spans
+        emit_varint(S.body_size);
+        for (auto& r : S.passthrough)
+          out.append((const char*)p + r.off, r.len);
+        for (auto& r : S.spans) {
+          out.push_back((char)0x12);           // ScopeSpans.spans
+          emit_varint(r.len);
+          out.append((const char*)p + r.off, r.len);
+        }
+      }
+    }
+
+    // search data (data.py encode_search_data wire format)
+    std::string sd;
+    put_u32(sd, start_s);
+    put_u32(sd, end_s);
+    put_u32(sd, (uint32_t)dur_ms);
+    const std::string& rsvc = T.have_root ? T.root_svc
+                              : (T.have_first ? T.first_svc : std::string());
+    const std::string& rname = T.have_root ? T.root_name
+                               : (T.have_first ? T.first_name : std::string());
+    put_u16s(sd, rsvc);
+    put_u16s(sd, rname);
+    uint16_t nk = (uint16_t)std::min(T.kvs.size(), (size_t)0xFFFF);
+    sd.append((const char*)&nk, 2);
+    size_t ki = 0;
+    for (auto& kv : T.kvs) {                   // std::map: sorted keys
+      if (ki++ >= nk) break;
+      put_u16s(sd, kv.first);
+      uint16_t nv = (uint16_t)std::min(kv.second.size(), (size_t)0xFFFF);
+      sd.append((const char*)&nv, 2);
+      size_t vi = 0;
+      for (auto& v : kv.second) {              // std::set: sorted values
+        if (vi++ >= nv) break;
+        put_u16s(sd, v);
+      }
+    }
+    put_u32(out, (uint32_t)sd.size());
+    out += sd;
+  }
+
+  // ---- span summaries (generator feed): string table + 56B rows ----
+  put_u32(out, (uint32_t)strtab.size());
+  for (auto& s : strtab) put_u16s(out, s);
+  put_u32(out, (uint32_t)rows.size());
+  static_assert(sizeof(RowTmp) == 56, "summary row layout is the ABI");
+  for (auto& r : rows) out.append((const char*)&r, sizeof(RowTmp));
+
+  if (out.size() > dst_cap) return -3;
+  memcpy(dst, out.data(), out.size());
+  return (long long)out.size();
+}
+
+}  // extern "C"

@@ -108,7 +108,7 @@ class App:
                 headers=rw.get("headers", {}),
             )
         self.distributor = Distributor(self.ring, self.ingesters, self.overrides,
-                                       forwarder=self.generator.push_spans,
+                                       forwarder=self.generator.forward,
                                        write_quorum=self.cfg.write_quorum)
         self.queriers = [
             Querier(self.reader_db, self.ring, self.ingesters, self.overrides,

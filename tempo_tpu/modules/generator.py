@@ -19,10 +19,14 @@ network egress in this environment; a remote-write client slots in where
 
 from __future__ import annotations
 
+import struct
 import threading
 import time
 
 from tempo_tpu import tempopb
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
 from tempo_tpu.observability.metrics import Registry, Counter, Histogram
 
 LATENCY_BUCKETS_S = (0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
@@ -36,6 +40,7 @@ class SpanMetricsProcessor:
         self.latency = Histogram("traces_spanmetrics_latency",
                                  "span latency (s)",
                                  buckets=LATENCY_BUCKETS_S, registry=registry)
+        self._series: dict[tuple, tuple] = {}  # bound-handle cache
 
     # enum int → name, resolved once: proto .Name() does a descriptor
     # lookup per call, and this runs per SPAN on the ack path
@@ -50,18 +55,49 @@ class SpanMetricsProcessor:
             if kv.key == "service.name":
                 svc = kv.value.string_value
         kind_names, status_names = self._KIND_NAMES, self._STATUS_NAMES
+        series = self._series  # (svc, name, kind, status) → bound handles
         for ss in batch.scope_spans:
             for span in ss.spans:
-                labels = dict(
-                    service=svc, span_name=span.name,
-                    span_kind=kind_names.get(span.kind, str(span.kind)),
-                    status_code=status_names.get(span.status.code,
-                                                 str(span.status.code)),
-                )
-                self.calls.inc(**labels)
+                sk = (svc, span.name, span.kind, span.status.code)
+                hit = series.get(sk)
+                if hit is None:
+                    labels = dict(
+                        service=svc, span_name=span.name,
+                        span_kind=kind_names.get(span.kind, str(span.kind)),
+                        status_code=status_names.get(span.status.code,
+                                                     str(span.status.code)),
+                    )
+                    hit = series[sk] = (self.calls.labels(**labels),
+                                        self.latency.labels(**labels))
+                    while len(series) > 65_536:  # runaway-cardinality cap
+                        series.pop(next(iter(series)))
+                c, h = hit
+                c.inc()
                 dur_s = max(0, span.end_time_unix_nano
                             - span.start_time_unix_nano) / 1e9
-                self.latency.observe(dur_s, **labels)
+                h.observe(dur_s)
+
+    def consume_rows(self, strs, rows, tids) -> None:
+        """Native summary-row feed — same series as consume()."""
+        kind_names, status_names = self._KIND_NAMES, self._STATUS_NAMES
+        series = self._series
+        for (_ti, svc_i, name_i, kind, status, _flags,
+             start, end, _sid, _pid) in rows:
+            sk = (strs[svc_i], strs[name_i], kind, status)
+            hit = series.get(sk)
+            if hit is None:
+                labels = dict(
+                    service=sk[0], span_name=sk[1],
+                    span_kind=kind_names.get(kind, str(kind)),
+                    status_code=status_names.get(status, str(status)),
+                )
+                hit = series[sk] = (self.calls.labels(**labels),
+                                    self.latency.labels(**labels))
+                while len(series) > 65_536:
+                    series.pop(next(iter(series)))
+            c, h = hit
+            c.inc()
+            h.observe(max(0, end - start) / 1e9)
 
 
 class ServiceGraphProcessor:
@@ -95,10 +131,14 @@ class ServiceGraphProcessor:
             for span in ss.spans:
                 if span.kind == tempopb.Span.SPAN_KIND_CLIENT:
                     key = (bytes(span.trace_id), bytes(span.span_id))
-                    self._pair(key, "client", svc, span, now)
+                    self._pair(key, "client", svc,
+                               (span.status.code, span.start_time_unix_nano,
+                                span.end_time_unix_nano), now)
                 elif span.kind == tempopb.Span.SPAN_KIND_SERVER:
                     key = (bytes(span.trace_id), bytes(span.parent_span_id))
-                    self._pair(key, "server", svc, span, now)
+                    self._pair(key, "server", svc,
+                               (span.status.code, span.start_time_unix_nano,
+                                span.end_time_unix_nano), now)
         # amortize: an O(store) expiry sweep per BATCH was a steady tax
         # on the ack path; unpaired edges only need to age out at wait_s
         # granularity, so sweep at most once per wait_s/4
@@ -106,7 +146,27 @@ class ServiceGraphProcessor:
             self._last_expire = now
             self._expire(now)
 
-    def _pair(self, key, kind, svc, span, now) -> None:
+    def consume_rows(self, strs, rows, tids) -> None:
+        """Native summary-row feed: same pairing store as consume().
+        Span/parent ids arrive zero-padded to 8 bytes — both sides of a
+        pair use the same padding, so keys match (OTLP span ids are 8
+        bytes on the wire anyway)."""
+        now = time.monotonic()
+        for (ti, svc_i, _name_i, kind, status, _flags,
+             start, end, sid, pid) in rows:
+            if kind == 3:    # SPAN_KIND_CLIENT
+                self._pair((tids[ti], sid), "client", strs[svc_i],
+                           (status, start, end), now)
+            elif kind == 2:  # SPAN_KIND_SERVER
+                self._pair((tids[ti], pid), "server", strs[svc_i],
+                           (status, start, end), now)
+        if now - self._last_expire >= self.wait_s / 4:
+            self._last_expire = now
+            self._expire(now)
+
+    def _pair(self, key, kind, svc, surrogate, now) -> None:
+        """surrogate: (status_code, start_ns, end_ns) — all the edge
+        emission needs; storing it beats serializing whole spans."""
         with self._lock:
             other = self._store.get(key)
             if other is None or other[0] == kind:
@@ -121,28 +181,24 @@ class ServiceGraphProcessor:
                         del self._store[k]
                     self.expired += len(dead)
                 if len(self._store) < self.max_items:
-                    self._store[key] = (
-                        kind, svc, span.SerializeToString(), now
-                    )
+                    self._store[key] = (kind, svc, surrogate, now)
                 return
             del self._store[key]
-        o_kind, o_svc, o_span_b, _ = other
-        o_span = tempopb.Span()
-        o_span.ParseFromString(o_span_b)
+        o_kind, o_svc, o_sur, _ = other
         if kind == "client":
-            client_svc, server_svc, client_span = svc, o_svc, span
-            server_span = o_span
+            client_svc, server_svc = svc, o_svc
+            c_status, c_start, c_end = surrogate
+            s_status = o_sur[0]
         else:
-            client_svc, server_svc, client_span = o_svc, svc, o_span
-            server_span = span
+            client_svc, server_svc = o_svc, svc
+            c_status, c_start, c_end = o_sur
+            s_status = surrogate[0]
         labels = dict(client=client_svc, server=server_svc)
         self.requests.inc(**labels)
-        if (client_span.status.code == tempopb.Status.STATUS_CODE_ERROR
-                or server_span.status.code == tempopb.Status.STATUS_CODE_ERROR):
+        ERR = tempopb.Status.STATUS_CODE_ERROR
+        if c_status == ERR or s_status == ERR:
             self.failed.inc(**labels)
-        dur_s = max(0, client_span.end_time_unix_nano
-                    - client_span.start_time_unix_nano) / 1e9
-        self.latency.observe(dur_s, **labels)
+        self.latency.observe(max(0, c_end - c_start) / 1e9, **labels)
 
     def _expire(self, now) -> None:
         with self._lock:
@@ -203,6 +259,42 @@ class MetricsGenerator:
         for batch in batches:
             for p in procs:
                 p.consume(batch)
+
+    def forward(self, tenant: str, payload) -> None:
+        """Distributor forwarder entry: parsed batches, or the native
+        walker's ("summaries", blob, tids) fast feed — fixed 56-byte
+        rows decoded here (off the ack path) instead of a second proto
+        walk per span."""
+        if (isinstance(payload, tuple) and payload
+                and payload[0] == "summaries"):
+            self.push_summary_blob(tenant, payload[1], payload[2])
+        else:
+            self.push_spans(tenant, payload)
+
+    forward.accepts_summaries = True  # distributor capability probe
+
+    _ROW = struct.Struct("<6IQQ8s8s")  # native RowTmp layout (the ABI)
+
+    def push_summary_blob(self, tenant: str, blob: bytes,
+                          tids: list) -> None:
+        reg, procs = self._instance(tenant)
+        if reg.over_limit():
+            self.dropped_over_limit += 1
+            return
+        (n_str,) = _U32.unpack_from(blob, 0)
+        off = 4
+        strs = []
+        for _ in range(n_str):
+            (ln,) = _U16.unpack_from(blob, off)
+            off += 2
+            strs.append(blob[off:off + ln].decode("utf-8", "replace"))
+            off += ln
+        (n_rows,) = _U32.unpack_from(blob, off)
+        off += 4
+        rows = list(self._ROW.iter_unpack(
+            blob[off:off + n_rows * self._ROW.size]))
+        for p in procs:
+            p.consume_rows(strs, rows, tids)
 
     def tenants(self) -> list[str]:
         with self._lock:

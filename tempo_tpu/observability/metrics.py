@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
+from bisect import bisect_left
 
 
 class _Metric:
@@ -52,8 +53,26 @@ class Counter(_Metric):
         with self._lock:
             self._series[k] = self._series.get(k, 0) + n
 
+    def labels(self, **labels) -> "_BoundCounter":
+        """Precomputed-key handle for per-span hot paths: the sorted
+        label-tuple build per inc() was measurable on the ingest ack
+        path (profiled r5) — cache the handle, pay it once."""
+        return _BoundCounter(self, self._key(labels))
+
     def value(self, **labels) -> float:
         return self._series.get(self._key(labels), 0)
+
+
+class _BoundCounter:
+    __slots__ = ("_m", "_k")
+
+    def __init__(self, m, k):
+        self._m, self._k = m, k
+
+    def inc(self, n: float = 1) -> None:
+        m = self._m
+        with m._lock:
+            m._series[self._k] = m._series.get(self._k, 0) + n
 
 
 class Gauge(_Metric):
@@ -78,14 +97,23 @@ class Histogram(_Metric):
         self._sums: dict[tuple, float] = {}
 
     def observe(self, v: float, **labels) -> None:
-        k = self._key(labels)
+        self._observe_key(self._key(labels), v)
+
+    def _observe_key(self, k: tuple, v: float) -> None:
+        # counts holds per-BIN tallies (bin i = first bucket >= v, last =
+        # +Inf only); expose()/samples() cumsum into the prometheus
+        # cumulative-le form. One bisect + one increment beats the old
+        # O(buckets) cumulative walk on the per-span ingest path.
+        i = bisect_left(self.buckets, v)
         with self._lock:
-            counts = self._counts.setdefault(k, [0] * (len(self.buckets) + 1))
-            for i, b in enumerate(self.buckets):
-                if v <= b:
-                    counts[i] += 1
-            counts[-1] += 1  # +Inf
+            counts = self._counts.get(k)
+            if counts is None:
+                counts = self._counts[k] = [0] * (len(self.buckets) + 1)
+            counts[i] += 1
             self._sums[k] = self._sums.get(k, 0) + v
+
+    def labels(self, **labels) -> "_BoundHistogram":
+        return _BoundHistogram(self, self._key(labels))
 
     def time(self, **labels):
         return _Timer(self, labels)
@@ -98,17 +126,18 @@ class Histogram(_Metric):
                 base = dict(key)
                 cum = 0
                 for i, b in enumerate(self.buckets):
-                    cum = counts[i]
+                    cum += counts[i]
                     lbl = ",".join(f'{k}="{v}"' for k, v in
                                    sorted({**base, "le": b}.items()))
                     lines.append(f"{self.name}_bucket{{{lbl}}} {cum}")
+                total = cum + counts[-1]
                 lbl = ",".join(f'{k}="{v}"' for k, v in
                                sorted({**base, "le": "+Inf"}.items()))
-                lines.append(f"{self.name}_bucket{{{lbl}}} {counts[-1]}")
+                lines.append(f"{self.name}_bucket{{{lbl}}} {total}")
                 blbl = ",".join(f'{k}="{v}"' for k, v in key)
                 suffix = f"{{{blbl}}}" if blbl else ""
                 lines.append(f"{self.name}_sum{suffix} {self._sums.get(key, 0)}")
-                lines.append(f"{self.name}_count{suffix} {counts[-1]}")
+                lines.append(f"{self.name}_count{suffix} {total}")
         return "\n".join(lines)
 
     def samples(self) -> list:
@@ -116,16 +145,29 @@ class Histogram(_Metric):
         with self._lock:
             for key, counts in sorted(self._counts.items()):
                 base = dict(key)
+                cum = 0
                 for i, b in enumerate(self.buckets):
+                    cum += counts[i]
                     out.append((f"{self.name}_bucket",
                                 tuple(sorted({**base, "le": str(b)}.items())),
-                                counts[i]))
+                                cum))
+                total = cum + counts[-1]
                 out.append((f"{self.name}_bucket",
                             tuple(sorted({**base, "le": "+Inf"}.items())),
-                            counts[-1]))
+                            total))
                 out.append((f"{self.name}_sum", key, self._sums.get(key, 0)))
-                out.append((f"{self.name}_count", key, counts[-1]))
+                out.append((f"{self.name}_count", key, total))
         return out
+
+
+class _BoundHistogram:
+    __slots__ = ("_m", "_k")
+
+    def __init__(self, m, k):
+        self._m, self._k = m, k
+
+    def observe(self, v: float) -> None:
+        self._m._observe_key(self._k, v)
 
 
 class _Timer:

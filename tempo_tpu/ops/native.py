@@ -97,6 +97,15 @@ def _bind(lib):
     lib.tt_xxhash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_ulonglong]
     lib.tt_crc32c.restype = ctypes.c_uint
     lib.tt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint]
+    # OPTIONAL symbol (added r5): stale .so must still bind
+    try:
+        lib.tt_ingest_regroup.restype = ctypes.c_longlong
+        lib.tt_ingest_regroup.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_longlong,
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
+    except AttributeError:
+        pass
     lib.tt_substr_scan.restype = ctypes.c_longlong
     lib.tt_substr_scan.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
@@ -190,6 +199,61 @@ def xxhash64(data: bytes, seed: int = 0) -> int:
 def crc32c(data: bytes, crc: int = 0) -> int:
     lib = _load()
     return int(lib.tt_crc32c(data, len(data), crc))
+
+
+class InvalidTraceId(ValueError):
+    """Native walker saw a span with a 0- or >16-byte trace id; the
+    caller re-runs the Python path so the user-visible error matches."""
+
+
+def ingest_regroup(batch_blobs: list, max_search_bytes: int):
+    """Native single-pass regroup + search-data extraction over
+    SERIALIZED ResourceSpans (tt_ingest_regroup). Returns
+    (n_spans, [(padded_tid, start_s, end_s, segment, search_data)],
+    summaries) where `summaries` is the raw per-span feed for the
+    metrics generator (string table + 56B rows; decoded off the ack
+    path by generator.push_summary_blob). None when the loaded .so
+    predates the symbol (stale build) — callers fall back to the
+    Python walk."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tt_ingest_regroup"):
+        return None
+    src = b"".join(_LEN32.pack(len(b)) + b for b in batch_blobs)
+    cap = max(4096, len(src) * 2 + 1024)
+    while True:
+        dst = ctypes.create_string_buffer(cap)
+        got = lib.tt_ingest_regroup(src, len(src), max_search_bytes,
+                                    dst, cap)
+        if got == -3:
+            cap *= 2
+            continue
+        if got == -4:
+            raise InvalidTraceId("invalid trace id length")
+        if got < 0:
+            raise RuntimeError(f"tt_ingest_regroup failed ({got})")
+        buf = dst.raw[:got]
+        break
+    n_traces, n_spans = _LEN32.unpack_from(buf, 0)[0], \
+        _LEN32.unpack_from(buf, 4)[0]
+    out = []
+    off = 8
+    for _ in range(n_traces):
+        tid = buf[off:off + 16]
+        start_s, end_s = struct.unpack_from("<II", buf, off + 16)
+        off += 24
+        (seg_len,) = _LEN32.unpack_from(buf, off)
+        off += 4
+        seg = buf[off:off + seg_len]
+        off += seg_len
+        (sd_len,) = _LEN32.unpack_from(buf, off)
+        off += 4
+        sd = buf[off:off + sd_len]
+        off += sd_len
+        out.append((tid, start_s, end_s, seg, sd))
+    return n_spans, out, buf[off:]
+
+
+_LEN32 = struct.Struct("<I")
 
 
 def substr_scan(packed: bytes, offsets, needle: bytes):
