@@ -138,7 +138,30 @@ def cmd_search(be, args):
     print(json_format.MessageToJson(resp))
 
 
+def cmd_import_ref(be, args) -> int:
+    """Import a Go-written v2 block directory into this backend
+    (db/importer.py — VERDICT r4 #5 migration path)."""
+    import tempfile
+
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.db.importer import dir_reader, import_reference_block
+
+    with tempfile.TemporaryDirectory() as wal:
+        db = TempoDB(be, wal, TempoDBConfig(host_state_dir=""))
+        meta = import_reference_block(dir_reader(args.src_dir), db,
+                                      args.tenant)
+    print(json.dumps({"imported_block": meta.block_id,
+                      "objects": meta.total_objects}))
+    return 0
+
+
 def main(argv=None) -> int:
+    # JAX_PLATFORMS must apply through jax.config BEFORE any device op
+    # (a registered TPU plugin otherwise handshakes its tunnel even for
+    # cpu-targeted runs and hangs when it is unhealthy — utils/jaxenv.py)
+    from tempo_tpu.utils.jaxenv import honor_jax_platforms
+
+    honor_jax_platforms()
     p = argparse.ArgumentParser("tempo-tpu-cli")
     p.add_argument("--backend-path", required=True)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -159,6 +182,11 @@ def main(argv=None) -> int:
     sp = sub.add_parser("gen-bloom")
     sp.add_argument("tenant")
     sp.add_argument("block")
+    sp = sub.add_parser("import-ref",
+                        help="one-way import of a reference-format v2 "
+                             "block directory (meta.json + data + index)")
+    sp.add_argument("tenant")
+    sp.add_argument("src_dir")
     sp = sub.add_parser("search")
     sp.add_argument("tenant")
     sp.add_argument("--tags", nargs="*")
@@ -175,6 +203,7 @@ def main(argv=None) -> int:
         "list-blocks": cmd_list_blocks, "view-block": cmd_view_block,
         "find": cmd_find, "gen-index": cmd_gen_index,
         "gen-bloom": cmd_gen_bloom, "search": cmd_search,
+        "import-ref": cmd_import_ref,
     }[args.cmd]
     return fn(be, args) or 0
 
