@@ -177,6 +177,13 @@ class HTTPApi:
             threading.Thread(target=self.app.shutdown, daemon=True).start()
             return 200, "shutting down"
 
+        # content negotiation (reference querier/frontend internal proto
+        # marshalling, frontend.go:121-127): a client that Accepts
+        # application/protobuf gets the wire message, not its JSON form
+        accept = (headers.get("Accept") or "") if hasattr(headers, "get") \
+            else ""
+        want_proto = "application/protobuf" in accept
+
         if path.startswith(PATH_TRACES + "/"):
             trace_id = _hex_trace_id(path[len(PATH_TRACES) + 1:])
             mode, bs, be = parse_trace_by_id_params(query)
@@ -184,6 +191,8 @@ class HTTPApi:
             if not resp.trace.batches:
                 return 404, {"error": "trace not found"}
             code = 206 if resp.metrics.failed_blocks else 200
+            if want_proto:
+                return code, resp.trace.SerializeToString()
             return code, json_format.MessageToDict(resp.trace)
         if path == PATH_SEARCH:
             req = parse_search_request(query)
@@ -191,6 +200,8 @@ class HTTPApi:
             # tolerated block failures = partial answer (reference
             # frontend.go:144-146 semantics, extended to search)
             code = 206 if resp.metrics.failed_blocks else 200
+            if want_proto:
+                return code, resp.SerializeToString()
             return code, json_format.MessageToDict(resp)
         if path == PATH_SEARCH_TAGS:
             resp = self.app.queriers[0].search_tags(tenant)
@@ -219,6 +230,8 @@ class HTTPApi:
             return 200, bridge.operations(tenant, svc)
         if sub == "/operations":
             return 200, bridge.operations(tenant, query.get("service", ""))
+        if sub == "/dependencies":
+            return 200, bridge.dependencies()
         if sub == "/traces":
             return 200, bridge.search(tenant, query)
         if sub.startswith("/traces/"):
@@ -325,6 +338,23 @@ class HTTPApi:
         return current
 
 
+def _accepts_gzip(header: str | None) -> bool:
+    """RFC 9110 Accept-Encoding: gzip only when listed with q > 0 —
+    `gzip;q=0` is an explicit refusal, not a match."""
+    for token in (header or "").lower().split(","):
+        parts = [p.strip() for p in token.split(";")]
+        if parts[0] != "gzip":
+            continue
+        for p in parts[1:]:
+            if p.startswith("q="):
+                try:
+                    return float(p[2:]) > 0
+                except ValueError:
+                    return False
+        return True
+    return False
+
+
 def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
     """Blocking stdlib server; returns the server object when used via
     threading (tests call .shutdown())."""
@@ -370,7 +400,12 @@ def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
             self._reply(code, out)
 
         def _reply(self, code, body):
-            if isinstance(body, (dict, list)):
+            if isinstance(body, (bytes, bytearray)):
+                # negotiated protobuf (Accept: application/protobuf on
+                # the query routes) — reference frontend.go:121-127
+                data = bytes(body)
+                ctype = "application/protobuf"
+            elif isinstance(body, (dict, list)):
                 data = json.dumps(body).encode()
                 ctype = "application/json"
             else:
@@ -378,6 +413,17 @@ def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
                 ctype = "text/plain"
             self.send_response(code)
             self.send_header("Content-Type", ctype)
+            # the body varies on negotiation headers — shared caches
+            # must key on them or serve the wrong representation
+            self.send_header("Vary", "Accept, Accept-Encoding")
+            # response compression (reference gzips frontend responses);
+            # tiny payloads skip it — the header+CPU outweighs the bytes
+            if _accepts_gzip(self.headers.get("Accept-Encoding")) \
+                    and len(data) >= 256:
+                import gzip as _gzip
+
+                data = _gzip.compress(data, compresslevel=5)
+                self.send_header("Content-Encoding", "gzip")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)

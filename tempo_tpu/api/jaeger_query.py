@@ -114,6 +114,13 @@ def trace_to_jaeger(trace: "tempopb.Trace") -> dict:
     return {"traceID": trace_id_hex, "spans": spans, "processes": processes}
 
 
+def _envelope(data, errors=None) -> dict:
+    """Jaeger structuredResponse: the UI reads data/total/limit/offset/
+    errors (jaeger query-service http_handler structuredResponse)."""
+    return {"data": data, "total": len(data), "limit": 0, "offset": 0,
+            "errors": errors}
+
+
 class JaegerQueryBridge:
     """Serves the Jaeger query-service API from an App."""
 
@@ -122,7 +129,7 @@ class JaegerQueryBridge:
 
     def services(self, tenant: str) -> dict:
         resp = self.app.queriers[0].search_tag_values(tenant, "service.name")
-        return {"data": sorted(resp.tag_values)}
+        return _envelope(sorted(resp.tag_values))
 
     OPERATIONS_SCAN_LIMIT = 200
 
@@ -133,20 +140,20 @@ class JaegerQueryBridge:
         would pollute the UI dropdown with other services' operations."""
         if not service:
             resp = self.app.queriers[0].search_tag_values(tenant, "name")
-            return {"data": sorted(resp.tag_values)}
+            return _envelope(sorted(resp.tag_values))
         req = tempopb.SearchRequest()
         req.tags["service.name"] = service
         req.limit = self.OPERATIONS_SCAN_LIMIT
         sresp = self.app.search(tenant, req)
         ops = {m.root_trace_name for m in sresp.traces
                if m.root_trace_name and m.root_service_name == service}
-        return {"data": sorted(ops)}
+        return _envelope(sorted(ops))
 
     def trace_by_id(self, tenant: str, trace_id: bytes):
         resp = self.app.find_trace(tenant, trace_id)
         if not resp.trace.batches:
             return None
-        return {"data": [trace_to_jaeger(resp.trace)]}
+        return _envelope([trace_to_jaeger(resp.trace)])
 
     def search(self, tenant: str, query: dict) -> dict:
         from .params import InvalidArgument
@@ -166,6 +173,20 @@ class JaegerQueryBridge:
                 req.min_duration_ms = _duration_ms(query["minDuration"])
             if query.get("maxDuration"):
                 req.max_duration_ms = _duration_ms(query["maxDuration"])
+            if query.get("tags"):
+                # jaeger-ui sends a JSON object; logfmt from older
+                # clients (the jaeger query-service accepts both)
+                import json as _json
+
+                try:
+                    pairs = _json.loads(query["tags"]).items()
+                except (ValueError, AttributeError):
+                    pairs = (p.split("=", 1) for p in query["tags"].split()
+                             if "=" in p)
+                for k, v in pairs:
+                    req.tags[str(k)] = str(v)
+            # `lookback` arrives alongside explicit start/end (the UI
+            # computes the window client-side) — nothing to apply
             req.limit = int(query.get("limit", 20))
         except ValueError as e:
             raise InvalidArgument(f"bad jaeger search params: {e}") from None
@@ -180,4 +201,12 @@ class JaegerQueryBridge:
         # search's newest-first ordering
         order = {m.trace_id: i for i, m in enumerate(sresp.traces)}
         hydrated.sort(key=lambda j: order.get(j["traceID"], 1 << 30))
-        return {"data": hydrated}
+        return _envelope(hydrated)
+
+    def dependencies(self) -> dict:
+        """The UI unconditionally fetches /api/dependencies for its
+        System Architecture tab. Edge data lives in the metrics-
+        generator's service-graph series here (reference parity:
+        tempo-query also returns an empty set — dependencies come from
+        a separate job in Jaeger deployments)."""
+        return _envelope([])

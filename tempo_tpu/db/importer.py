@@ -29,9 +29,9 @@ migrate without replay. Format studied from the spec, not translated:
 
 Compression caveat: page payloads decompress per ``meta.encoding``.
 zstd / gzip / zlib / none are bit-standard formats and import directly;
-the reference's "snappy"/"s2" page streams use the golang framing
-variant, which this importer does not speak — re-encode such blocks to
-zstd with the reference's own tooling first (documented in PARITY.md).
+the reference's "snappy"/"s2" (golang framing) and "lz4-*" (pierrec
+frame) streams are rejected up-front — re-encode such blocks to zstd
+with the reference's own tooling first (documented in PARITY.md).
 """
 
 from __future__ import annotations
@@ -66,11 +66,24 @@ class RefBlockMeta:
     total_objects: int
 
 
+_IMPORTABLE_ENCODINGS = {"none", "gzip", "zlib", "zstd"}
+
+
 def parse_ref_meta(raw: bytes) -> RefBlockMeta:
     try:
         doc = json.loads(raw)
     except ValueError as e:
         raise ImportError_(f"bad meta.json: {e}") from None
+    enc = str(doc.get("encoding", "none"))
+    if enc not in _IMPORTABLE_ENCODINGS:
+        # the reference's snappy/s2 and lz4-* page streams use golang
+        # framing variants (pierrec/lz4 frames, golang snappy framing)
+        # this importer does not speak — fail up-front with the remedy,
+        # never mid-block with a codec error (code-review r5)
+        raise ImportError_(
+            f"block encoding {enc!r} is not importable — re-encode the "
+            f"block to zstd with the reference's tooling first "
+            f"(supported: {sorted(_IMPORTABLE_ENCODINGS)})")
     return RefBlockMeta(
         block_id=str(doc.get("blockID", "")),
         encoding=str(doc.get("encoding", "none")),
@@ -150,11 +163,13 @@ def iter_page_objects(page_bytes: bytes, encoding: str):
         off += obj_total
 
 
-def iter_reference_block(read):
+def iter_reference_block(read, meta: RefBlockMeta | None = None):
     """Yield (trace_id, our-v2 segment bytes, start_s, end_s,
     tempopb.Trace) for every object in a reference block. `read(name)`
-    returns the raw bytes of "meta.json" / "data" / "index"."""
-    meta = parse_ref_meta(read("meta.json"))
+    returns the raw bytes of "meta.json" / "data" / "index"; pass an
+    already-parsed `meta` to skip a second fetch (remote readers)."""
+    if meta is None:
+        meta = parse_ref_meta(read("meta.json"))
     index = parse_index(read("index"), meta.index_page_size,
                         meta.total_records)
     data = read("data")
@@ -199,7 +214,7 @@ def import_reference_block(read, db, tenant: str):
     meta = parse_ref_meta(read("meta.json"))
     objects = []
     entries = []
-    for oid, seg, start_s, end_s, trace in iter_reference_block(read):
+    for oid, seg, start_s, end_s, trace in iter_reference_block(read, meta):
         tid = pad_trace_id(oid)
         objects.append((tid, seg, start_s, end_s))
         entries.append(extract_search_data(tid, trace))

@@ -220,3 +220,19 @@ def test_partial_import_refused(tmp_path):
     db = _mk_db(tmp_path, "imp")
     with pytest.raises(ImportError_, match="partial"):
         import_reference_block(dir_reader(str(src)), db, "t1")
+
+
+def test_unsupported_encoding_fails_fast(tmp_path):
+    """code-review r5: golang-framed codecs (lz4-*, snappy, s2) must be
+    rejected up-front with the re-encode remedy, not fail mid-block."""
+    traces = [(random_trace_id(), None)]
+    traces = [(tid, make_trace(tid, seed=0)) for tid, _ in traces]
+    src = tmp_path / "refblock"
+    write_ref_block(src, traces, encoding="zstd")
+    meta = json.loads((src / "meta.json").read_text())
+    for enc in ("lz4-1M", "lz4", "snappy", "s2"):
+        meta["encoding"] = enc
+        (src / "meta.json").write_text(json.dumps(meta))
+        db = _mk_db(tmp_path, f"imp-{enc}")
+        with pytest.raises(ImportError_, match="re-encode"):
+            import_reference_block(dir_reader(str(src)), db, "t1")

@@ -85,3 +85,96 @@ def test_concurrent_write_read_smoke(tmp_path):
     app.poll_tick()
     missing = [t for t in written if not app.find_trace("smoke", t).trace.batches]
     assert not missing
+
+
+def test_gzip_and_proto_negotiation(tmp_path):
+    """VERDICT r4 #8: Accept-Encoding gzip compresses query responses
+    (with measurable byte savings) and Accept: application/protobuf
+    returns the wire message — reference frontend.go:121-127 parity."""
+    import gzip as _gzip
+
+    from tempo_tpu import tempopb
+
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal")))
+    server = serve_http(HTTPApi(app), host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        tids = [random_trace_id() for _ in range(20)]
+        for i, tid in enumerate(tids):
+            app.push("neg", list(make_trace(tid, seed=i).batches))
+        app.flush_tick(force=True)
+        app.poll_tick()
+        base = f"http://127.0.0.1:{port}/api/search?limit=50"
+
+        def fetch(headers):
+            req = urllib.request.Request(
+                base, headers={"X-Scope-OrgID": "neg", **headers})
+            with urllib.request.urlopen(req) as r:
+                return r.status, dict(r.headers), r.read()
+
+        # plain JSON
+        st, hdrs, plain = fetch({})
+        assert st == 200 and hdrs.get("Content-Encoding") is None
+        assert len(json.loads(plain)["traces"]) == 20
+
+        # gzip: decodes to the same JSON, on-wire bytes shrink
+        st, hdrs, gz = fetch({"Accept-Encoding": "gzip"})
+        assert st == 200 and hdrs["Content-Encoding"] == "gzip"
+        assert len(gz) < len(plain) // 2, (len(gz), len(plain))
+        assert json.loads(_gzip.decompress(gz)) == json.loads(plain)
+
+        # protobuf negotiation: parseable SearchResponse, same traces
+        st, hdrs, pb = fetch({"Accept": "application/protobuf"})
+        assert st == 200
+        assert hdrs["Content-Type"] == "application/protobuf"
+        resp = tempopb.SearchResponse()
+        resp.ParseFromString(pb)
+        assert len(resp.traces) == 20
+
+        # both: gzipped protobuf
+        st, hdrs, gzpb = fetch({"Accept": "application/protobuf",
+                                "Accept-Encoding": "gzip"})
+        assert hdrs["Content-Encoding"] == "gzip"
+        resp2 = tempopb.SearchResponse()
+        resp2.ParseFromString(_gzip.decompress(gzpb))
+        assert len(resp2.traces) == 20
+
+        # trace-by-id proto
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/traces/{trace_id_to_hex(tids[0])}",
+            headers={"X-Scope-OrgID": "neg",
+                     "Accept": "application/protobuf"})
+        with urllib.request.urlopen(req) as r:
+            tr = tempopb.Trace()
+            tr.ParseFromString(r.read())
+            assert tr.batches
+    finally:
+        server.shutdown()
+        app.shutdown()
+
+
+def test_gzip_refused_with_q0(tmp_path):
+    """`Accept-Encoding: gzip;q=0` is an explicit refusal (RFC 9110) —
+    the body must come back uncompressed."""
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal")))
+    server = serve_http(HTTPApi(app), host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        for i in range(10):
+            app.push("neg", list(make_trace(random_trace_id(),
+                                            seed=i).batches))
+        app.flush_tick(force=True)
+        app.poll_tick()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/search?limit=50",
+            headers={"X-Scope-OrgID": "neg",
+                     "Accept-Encoding": "gzip;q=0, identity"})
+        with urllib.request.urlopen(req) as r:
+            assert r.headers.get("Content-Encoding") is None
+            assert "Accept-Encoding" in (r.headers.get("Vary") or "")
+            json.loads(r.read())  # plain JSON
+    finally:
+        server.shutdown()
+        app.shutdown()
