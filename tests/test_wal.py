@@ -157,7 +157,9 @@ def test_wal_compression_shrinks_redundant_segments(tmp_wal_dir):
     raw = WAL(tmp_wal_dir + "-raw", encoding="none")
     comp = WAL(tmp_wal_dir + "-comp")
     braw, bcomp = raw.new_block("t"), comp.new_block("t")
-    tid = random_trace_id()
+    # fixed id: a random one occasionally lands a payload whose single
+    # small record compresses right at the 0.9 assertion line (flake)
+    tid = bytes(range(16))
     seg = _seg(tid, 1, 100, 200) * 1  # one real segment
     for b in (braw, bcomp):
         for _ in range(50):
