@@ -37,6 +37,7 @@ class Stats:
         self.lock = threading.Lock()
         self.lat: list[float] = []
         self.errors = 0
+        self.throttled = 0  # 429 backpressure: expected under overload
 
     def ok(self, dt: float):
         with self.lock:
@@ -46,15 +47,20 @@ class Stats:
         with self.lock:
             self.errors += 1
 
+    def throttle(self):
+        with self.lock:
+            self.throttled += 1
+
     def summary(self) -> dict:
         with self.lock:
             lat = sorted(self.lat)
             n = len(lat)
-            total = n + self.errors
+            total = n + self.errors + self.throttled
             pct = lambda p: lat[min(n - 1, int(p * n))] if n else None  # noqa: E731
             return {
                 "requests": total,
                 "errors": self.errors,
+                "throttled": self.throttled,
                 "error_rate": self.errors / total if total else 0.0,
                 "p50_ms": round(pct(0.50) * 1000, 1) if n else None,
                 "p99_ms": round(pct(0.99) * 1000, 1) if n else None,
@@ -114,6 +120,14 @@ def _vu_loop(target: Target, stats: dict, stop: threading.Event, vu_id: int,
             stats["write"].ok(time.perf_counter() - t0)
             if not write_only:  # stress mode never reads these back
                 written.append(tid)
+        except urllib.error.HTTPError as e:
+            # 429 is limit backpressure (live traces / ingest rate): the
+            # CORRECT overload answer, tallied apart from failures —
+            # the reference's k6 checks treat it the same way
+            if e.code == 429:
+                stats["write"].throttle()
+            else:
+                stats["write"].err()
         except (urllib.error.URLError, OSError):
             stats["write"].err()
         if write_only:

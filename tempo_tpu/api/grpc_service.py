@@ -19,6 +19,7 @@ import grpc
 
 from tempo_tpu import tempopb
 from tempo_tpu.api.params import InvalidArgument
+from tempo_tpu.modules.distributor import RateLimited
 
 SERVICE_PUSHER = "tempopb.Pusher"
 SERVICE_QUERIER = "tempopb.Querier"
@@ -232,6 +233,11 @@ def _unary(fn, req_cls, resp_cls):
                 # WAL entry, object framing): INTERNAL, never a verdict
                 # on the request itself (ADVICE r4)
                 context.abort(grpc.StatusCode.INTERNAL, str(e))
+            except RateLimited as e:
+                # tenant ingest pushback → RESOURCE_EXHAUSTED (retryable
+                # to standard OTLP exporters, reference
+                # distributor.go:305)
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
 
     return grpc.unary_unary_rpc_method_handler(
         traced,

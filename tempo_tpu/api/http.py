@@ -17,6 +17,7 @@ from urllib.parse import urlparse, parse_qs
 
 from google.protobuf import json_format
 
+from tempo_tpu.modules.distributor import RateLimited
 from tempo_tpu.modules.queue import TooManyRequests
 from tempo_tpu.utils.ids import hex_to_trace_id
 from .params import (
@@ -105,6 +106,12 @@ class HTTPApi:
                 # tenant's fair-queue is full (reference frontend v1
                 # max-outstanding → HTTP 429)
                 code, resp = 429, {"error": f"too many outstanding requests: {e}"}
+            except RateLimited as e:
+                # ingest pushback (rate / live-traces / trace-bytes
+                # limits) is retryable tenant backpressure — the
+                # reference answers ResourceExhausted/FailedPrecondition,
+                # i.e. 429 on the HTTP write path, never 500
+                code, resp = 429, {"error": str(e)}
             except Exception as e:  # noqa: BLE001 — surface as 500
                 span.record_exception(e)
                 code, resp = 500, {"error": f"{type(e).__name__}: {e}"}

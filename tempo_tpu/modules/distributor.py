@@ -182,15 +182,34 @@ class Distributor:
             # per-trace quorum over its OWN replica set (reference
             # ring.DoBatch tracks success per item, not per batch): a trace
             # is durable iff a majority of its replicas took the write
+            from tempo_tpu.modules.ingester import LimitError
+
             for tid, replicas in trace_replicas.items():
                 ok = sum(1 for iid in replicas if iid not in errs)
                 need = 1 if self.write_quorum == "one" else len(replicas) // 2 + 1
                 if ok < need:
                     self.metrics.push_failures += 1
+                    # classify over THIS trace's own replica errors only:
+                    # an unrelated ingester's network fault elsewhere in
+                    # the batch must not turn limit pushback into a 500
+                    own = [errs[iid] for iid in replicas if iid in errs]
+                    if own and all(isinstance(e, LimitError) for e in own):
+                        # tenant limit (max live traces / trace bytes) is
+                        # a RETRYABLE pushback, not a server fault — the
+                        # reference answers FailedPrecondition and the
+                        # write path surfaces 429, never 500
+                        # (modules/ingester/instance.go:185,
+                        # distributor.go:525-527)
+                        reason = ("trace_too_large"
+                                  if "bytes per trace" in str(own[0])
+                                  else "live_traces_exceeded")
+                        obs.push_failures.inc(tenant=tenant, reason=reason)
+                        raise RateLimited(
+                            f"tenant {tenant} over ingest limits: {own[0]}")
                     obs.push_failures.inc(tenant=tenant, reason="quorum")
                     raise IngestError(
                         f"push quorum failed for trace {tid.hex()}: "
-                        f"{list(errs.items())[:2]}"
+                        f"{[(iid, e) for iid, e in errs.items() if iid in replicas][:2]}"
                     )
 
     @staticmethod

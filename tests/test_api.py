@@ -581,3 +581,27 @@ def test_grpc_server_side_valueerror_is_internal(tmp_path):
         ch.close()
     finally:
         server.stop(0)
+
+
+def test_live_traces_limit_is_429_not_500(tmp_path):
+    """Soak finding r5: the ingester's max-live-traces pushback surfaced
+    as HTTP 500 through the quorum error path. It is retryable tenant
+    backpressure — the reference answers FailedPrecondition /
+    ResourceExhausted (instance.go:185, distributor.go:305) → 429."""
+    from tempo_tpu.modules import App, AppConfig
+    from tempo_tpu.modules.overrides import Limits
+
+    app2 = App(AppConfig(
+        backend={"backend": "local", "local": {"path": str(tmp_path / "b")}},
+        wal_dir=str(tmp_path / "w")))
+    app2.overrides.defaults = Limits(max_live_traces=3)
+    api = HTTPApi(app2)
+    hdr = {"X-Scope-OrgID": "t1"}
+    codes = []
+    for i in range(6):
+        tr = make_trace(random_trace_id(), seed=i)
+        code, body = api.handle("POST", "/v1/traces", {}, hdr,
+                                tr.SerializeToString())
+        codes.append(code)
+    assert 429 in codes and 500 not in codes, codes
+    assert codes[0] == 200
