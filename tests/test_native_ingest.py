@@ -193,3 +193,82 @@ def test_end_to_end_push_search_roundtrip(tmp_path):
     found = {m.trace_id for m in app.search("t1", req).traces}
     assert found == {t.hex() for t in tids}
     app.shutdown()
+
+
+def test_differential_rich_corpus():
+    """Exotic shapes the plain corpus lacks: unicode keys/names, empty
+    and 300-char names, int64 extremes, doubles, bytes/array/kvlist
+    attributes (unindexed both paths), events, links, trace_state,
+    dropped counts — native and Python walks must agree byte-for-byte
+    on search data and parse-equivalently on segments."""
+    import os as _os
+
+    codec = segment_codec_for(CURRENT_ENCODING)
+    rng = random.Random(42)
+
+    def rich_batch(tids):
+        b = tempopb.ResourceSpans()
+        b.schema_url = "https://opentelemetry.io/schemas/1.4.0"
+        kv = b.resource.attributes.add()
+        kv.key = "service.name"
+        kv.value.string_value = rng.choice(["svc-α", "svc-b", ""])
+        kv2 = b.resource.attributes.add()
+        kv2.key = "host.id"
+        kv2.value.int_value = rng.randint(-2**60, 2**60)
+        kv3 = b.resource.attributes.add()
+        kv3.key = "blob"
+        kv3.value.bytes_value = _os.urandom(5)
+        ss = b.scope_spans.add()
+        ss.scope.name = "lib"
+        ss.scope.version = "1.2.3"
+        for _ in range(rng.randint(1, 5)):
+            sp = ss.spans.add()
+            sp.trace_id = rng.choice(tids)
+            sp.span_id = _os.urandom(8)
+            sp.trace_state = "vendor=1"
+            if rng.random() < 0.5:
+                sp.parent_span_id = _os.urandom(8)
+            sp.name = rng.choice(["op-ü", "", "x" * 300])
+            sp.kind = rng.randint(0, 5)
+            sp.start_time_unix_nano = rng.randint(0, 2**62)
+            sp.end_time_unix_nano = (sp.start_time_unix_nano
+                                     + rng.randint(0, 10**12))
+            sp.status.code = rng.randint(0, 2)
+            sp.status.message = "boom"
+            a = sp.attributes.add()
+            a.key = "℘-key"
+            a.value.double_value = rng.choice([2e5, -0.0, 1e-7, 3.14])
+            a2 = sp.attributes.add()
+            a2.key = "arr"
+            a2.value.array_value.values.add().string_value = "in-array"
+            a3 = sp.attributes.add()
+            a3.key = "kl"
+            e = a3.value.kvlist_value.values.add()
+            e.key = "k"
+            e.value.bool_value = True
+            ev = sp.events.add()
+            ev.name = "evt"
+            ev.time_unix_nano = 7
+            ln = sp.links.add()
+            ln.trace_id = _os.urandom(16)
+            ln.span_id = _os.urandom(8)
+            sp.dropped_attributes_count = 3
+        return b
+
+    for it in range(30):
+        tids = [_os.urandom(16) for _ in range(rng.randint(1, 3))]
+        batches = [rich_batch(tids) for _ in range(rng.randint(1, 4))]
+        budget = rng.choice([32, 200, 1 << 30])
+        blobs = [x.SerializeToString() for x in batches]
+        n_n, items, _ = native.ingest_regroup(blobs, budget)
+        by_trace, n_p, sds = Distributor._regroup_extract(batches, budget)
+        assert n_n == n_p and len(items) == len(by_trace), it
+        for tid, start_s, end_s, seg, sd_b in items:
+            sd = sds[tid]
+            assert sd_b == encode_search_data(sd), (it, budget)
+            want = codec.prepare_for_write(by_trace[tid], sd.start_s,
+                                           sd.end_s)
+            t1, t2 = tempopb.Trace(), tempopb.Trace()
+            t1.ParseFromString(seg[8:])
+            t2.ParseFromString(want[8:])
+            assert t1.SerializeToString() == t2.SerializeToString(), it
