@@ -33,13 +33,43 @@ def enable_compile_cache(path: str,
         # exactly the sum of many sub-second compiles
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           float(min_compile_time_s))
-        if (os.environ.get("JAX_COMPILATION_CACHE_DIR")
-                or jax.config.jax_compilation_cache_dir):
-            # an operator/harness-level cache location is already set —
-            # explicit configuration wins over per-TempoDB defaults
+
+        def apply(d: str) -> None:
+            if jax.config.jax_compilation_cache_dir == d:
+                return
+            jax.config.update("jax_compilation_cache_dir", d)
+            # jax pins its cache object at first compile; a config
+            # update alone never takes effect afterwards (code-review
+            # r5, verified against jax 0.9 _initialize_cache)
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:  # noqa: BLE001 — older/newer layouts
+                pass
+
+        envdir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        if envdir:
+            # operator/harness-level location: explicit wins. jax reads
+            # the env var only at IMPORT time, so a late-set variable
+            # must be applied through config here or the cache silently
+            # never initializes (code-review r5).
+            os.makedirs(envdir, exist_ok=True)
+            apply(envdir)
             return True
+        cur = jax.config.jax_compilation_cache_dir
+        if cur:
+            # an earlier explicit/TempoDB choice wins — (re)create the
+            # dir rather than stomping it (it may be configured before
+            # its mount exists, or a test tempdir may have died under
+            # it); repoint only if it is truly unusable
+            try:
+                os.makedirs(cur, exist_ok=True)
+                return True
+            except OSError:
+                pass
         os.makedirs(path, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", path)
+        apply(path)
         return True
     except Exception as e:  # noqa: BLE001 — cache is an optimization
         print(f"warning: persistent compile cache disabled ({e})",
