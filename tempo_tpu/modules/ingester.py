@@ -306,7 +306,31 @@ class TenantInstance:
             for ssb in [self.head_search] + [c.search for c in self.completing]:
                 for sd in ssb.entries():
                     tags.update(sd.kvs)
+        for meta in self._recent_tag_blocks():
+            # blocklist-poll gap, as in find()/search(): a just-completed
+            # block is out of head/completing but not yet in any reader's
+            # blocklist — without this sweep its tags vanish from
+            # dropdowns for a full poll interval
+            try:
+                sp = self.db._search_block_for(meta)  # noqa: SLF001
+                tags.update(sp.pages().key_dict)
+            except Exception:  # noqa: BLE001 — backend flake → partial
+                continue
         return tags
+
+    # newest-first cap on the recently-completed sweep, mirroring the
+    # querier's TAG_BLOCKS_LIMIT: an uncapped sweep of a busy tenant's
+    # 5-minute `recent` window would decompress dozens of containers per
+    # tags call and thrash the shared block cache (code-review r5)
+    RECENT_TAG_BLOCKS_LIMIT = 20
+
+    def _recent_tag_blocks(self):
+        import heapq
+
+        with self.lock:
+            recent = [m for m, _ in self.recent]
+        return heapq.nlargest(self.RECENT_TAG_BLOCKS_LIMIT, recent,
+                              key=lambda m: m.end_time or 0)
 
     def search_tag_values(self, tag: str, max_bytes: int) -> set:
         vals: set[str] = set()
@@ -323,6 +347,17 @@ class TenantInstance:
                     if size > max_bytes:
                         return vals
                     vals.add(v)
+        for meta in self._recent_tag_blocks():  # blocklist-poll gap
+            try:
+                pages = self.db._search_block_for(meta).pages()  # noqa: SLF001
+            except Exception:  # noqa: BLE001
+                continue
+            for s in pages.values_for_key(tag):
+                if s not in vals:
+                    size += len(s)
+                    if size > max_bytes:
+                        return vals
+                    vals.add(s)
         return vals
 
 

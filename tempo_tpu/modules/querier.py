@@ -279,16 +279,8 @@ class Querier:
                 sp = self.db._search_block_for(m).staged()  # noqa: SLF001
             except Exception:  # noqa: BLE001
                 continue
-            pages = sp.pages
-            if tag not in pages.key_dict:
-                continue
-            import numpy as np
-
-            kid = pages.key_dict.index(tag)
-            hit_vals = np.unique(pages.kv_val[pages.kv_key == kid])
-            for v in hit_vals.tolist():
-                if v >= 0:
-                    s = pages.val_dict[v]
+            for s in sp.pages.values_for_key(tag):
+                if s not in vals:
                     size += len(s)
                     if size > lim.max_bytes_per_tag_values:
                         break

@@ -117,6 +117,18 @@ class ColumnarPages:
             cached = self._packed_vals = pack_val_dict(self.val_dict)
         return cached
 
+    def values_for_key(self, tag: str):
+        """Distinct value strings present under `tag` in this container —
+        the tag-values endpoints' columnar extraction (one idiom, used by
+        both the querier's blocklist sweep and the ingester's
+        recently-completed sweep)."""
+        if tag not in self.key_dict:
+            return
+        kid = self.key_dict.index(tag)
+        for v in np.unique(self.kv_val[self.kv_key == kid]).tolist():
+            if v >= 0:
+                yield self.val_dict[v]
+
     # ------------------------------------------------------------------
     # build
 

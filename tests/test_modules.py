@@ -1263,3 +1263,28 @@ def test_tag_endpoints_cap_block_sweep(tmp_path):
     metas = sorted(db.blocklist.metas("t1"),
                    key=lambda m: m.end_time or 0, reverse=True)
     assert set(staged) <= {m.block_id for m in metas[:3]}
+
+
+def test_tag_endpoints_cover_blocklist_poll_gap(tmp_path):
+    """find() and search() already swept recently-completed blocks; the
+    tag endpoints did not — so a service's tags vanished from UI
+    dropdowns for a full poll interval right after flush (observed via
+    the jaeger bridge in r5). Flush WITHOUT polling the reader: tag
+    names and values must still be visible through the querier."""
+    app = App(AppConfig(
+        backend={"backend": "local", "local": {"path": str(tmp_path / "b")}},
+        wal_dir=str(tmp_path / "w")))
+    from tempo_tpu.utils.ids import random_trace_id
+    from tempo_tpu.utils.test_data import make_trace
+
+    for i in range(5):
+        app.push("t1", list(make_trace(random_trace_id(), seed=i).batches))
+    completed = app.flush_tick(force=True)
+    assert completed  # blocks left the ingester...
+    # ...and the reader has NOT polled: the gap under test
+    assert not app.reader_db.blocklist.metas("t1")
+
+    tags = app.queriers[0].search_tags("t1")
+    assert "service.name" in tags.tag_names
+    vals = app.queriers[0].search_tag_values("t1", "service.name")
+    assert vals.tag_values, "tag values invisible during the poll gap"
