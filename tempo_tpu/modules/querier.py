@@ -274,7 +274,13 @@ class Querier:
                     tenant, tag, lim.max_bytes_per_tag_values))
             except Exception:  # noqa: BLE001 — replica failure → partial values
                 continue
+        budget_hit = False
         for m in self._tag_blocks(tenant):
+            if budget_hit:
+                # a tripped byte budget must stop the whole sweep, not
+                # just the current block — each further block costs a
+                # backend read + decompress + staging for nothing
+                break
             try:
                 sp = self.db._search_block_for(m).staged()  # noqa: SLF001
             except Exception:  # noqa: BLE001
@@ -283,6 +289,7 @@ class Querier:
                 if s not in vals:
                     size += len(s)
                     if size > lim.max_bytes_per_tag_values:
+                        budget_hit = True
                         break
                     vals.add(s)
         resp = tempopb.SearchTagValuesResponse()
