@@ -270,6 +270,157 @@ def bench_serving(n_blocks, entries_per_block, iters):
         return rate, p50, p95, dispatches
 
 
+def bench_coalesced_serving(n_blocks, entries_per_block, iters,
+                            concurrency=8):
+    """Cross-request query coalescing through the serving path: N
+    concurrent synthetic tenants issue DISTINCT predicates over the same
+    device-resident block cache; dispatches landing on the same staged
+    batch within the coalescing window fuse into one multi-query kernel
+    launch (search/batcher.QueryCoalescer). Reports dispatches-per-
+    request (target ≤ 1/2 at concurrency 8 — the whole point), the
+    coalesce ratio (queries per fused launch), p50/p95 per-request
+    latency, and the HBM batch-cache hit counters. Degrades gracefully
+    on CPU like every phase (jax device = cpu; same code path).
+
+    NOTE scan_dispatches semantics: with coalescing active the counter's
+    mode="batched" series counts SOLO kernel launches and
+    mode="coalesced" counts fused multi-query launches — a fused launch
+    increments once however many requests it served. Phases that predate
+    coalescing read mode="batched" only and keep their old meaning
+    (serial runs flush solo)."""
+    import json as _json
+    import tempfile
+    import threading
+
+    from tempo_tpu import tempopb
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.backend.types import (
+        BlockMeta, NAME_SEARCH, NAME_SEARCH_HEADER,
+    )
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.encoding.v2.compression import compress
+    from tempo_tpu.observability import metrics as obs
+
+    total = n_blocks * entries_per_block
+    with tempfile.TemporaryDirectory() as td:
+        be = LocalBackend(td + "/blocks")
+        db = TempoDB(be, td + "/wal", TempoDBConfig(
+            # a slightly wider window than the serving default: the bench
+            # models synchronized dashboard fan-out; CPU-fallback kernels
+            # are slow enough that stragglers need the headroom
+            search_coalesce_window_s=0.01,
+            search_coalesce_max_queries=concurrency))
+        metas = []
+        for s in range(n_blocks):
+            pages = build_corpus(entries_per_block, seed=s)
+            m = BlockMeta(tenant_id="bench", encoding="none")
+            blob = compress(pages.to_bytes(), "none")
+            hdr = dict(pages.header)
+            hdr["encoding"] = "none"
+            hdr["compressed_size"] = len(blob)
+            be.write("bench", m.block_id, NAME_SEARCH, blob)
+            be.write("bench", m.block_id, NAME_SEARCH_HEADER,
+                     _json.dumps(hdr).encode())
+            metas.append(m)
+        db.blocklist.update("bench", add=metas)
+
+        def mk_req(i):
+            req = tempopb.SearchRequest()
+            req.tags["service.name"] = f"svc-{i:03d}"
+            req.tags["http.status_code"] = "500"
+            req.limit = 20
+            return req
+
+        # warm: stage to HBM + compile the solo AND fused kernel shapes
+        # (the fused shape pads Q to pow2, so one warm fusion covers the
+        # steady state); correctness-check against the serial path
+        r = db.search("bench", mk_req(0))
+        assert r.metrics.inspected_traces == total, (
+            r.metrics.inspected_traces, total)
+        serial = {}
+        for i in range(concurrency):
+            serial[i] = db.search(
+                "bench", mk_req(i)).response().SerializeToString()
+
+        barrier = threading.Barrier(concurrency)
+        rounds = max(3, iters)
+        lat: list[float] = []
+        lat_lock = threading.Lock()
+        mismatches = []
+
+        def worker(wi, n_rounds):
+            for _rnd in range(n_rounds):
+                barrier.wait()  # synchronized arrival: the dashboard
+                # fan-out shape (N panels firing together)
+                t0 = time.perf_counter()
+                got = db.search(
+                    "bench", mk_req(wi)).response().SerializeToString()
+                dt = time.perf_counter() - t0
+                with lat_lock:
+                    lat.append(dt)
+                    if got != serial[wi]:
+                        mismatches.append(wi)
+
+        def launches():
+            return (obs.scan_dispatches.value(mode="batched")
+                    + obs.scan_dispatches.value(mode="coalesced"))
+
+        # one synchronized warm round so the fused (Q=concurrency) kernel
+        # shape compiles outside the measured window
+        warm = [threading.Thread(target=worker, args=(i, 1))
+                for i in range(concurrency)]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join()
+        lat.clear()
+
+        d0 = launches()
+        q0 = obs.coalesced_queries.value()
+        f0 = obs.scan_dispatches.value(mode="coalesced")
+        # cache counters are process-lifetime: snapshot so the reported
+        # hits/evicts cover the measured rounds only, not the serial
+        # correctness pass and warm round
+        h0 = obs.batch_cache_events.value(result="hit")
+        e0 = obs.batch_cache_events.value(result="evict")
+        threads = [threading.Thread(target=worker, args=(i, rounds))
+                   for i in range(concurrency)]
+        t_run0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        run_s = time.perf_counter() - t_run0
+        assert not mismatches, f"coalesced results diverged: {mismatches}"
+
+        n_requests = concurrency * rounds
+        dispatches = launches() - d0
+        fused = obs.scan_dispatches.value(mode="coalesced") - f0
+        fused_queries = obs.coalesced_queries.value() - q0
+        lat.sort()
+        coalescer = db.batcher.coalescer
+        window_ms = (coalescer.stats()["window_ms"]
+                     if coalescer is not None else 0.0)
+        return {
+            "blocks": n_blocks,
+            "entries_per_block": entries_per_block,
+            "concurrency": concurrency,
+            "rounds": rounds,
+            "requests": n_requests,
+            "scan_dispatches": dispatches,
+            "dispatches_per_request": round(dispatches / n_requests, 3),
+            "coalesce_ratio": round(fused_queries / fused, 2) if fused else 0,
+            "coalesce_window_ms": window_ms,
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 1),
+            "p95_ms": round(lat[min(len(lat) - 1,
+                                    int(len(lat) * 0.95))] * 1e3, 1),
+            "requests_per_sec": round(n_requests / run_s, 1),
+            "hbm_cache_hits": obs.batch_cache_events.value(result="hit") - h0,
+            "hbm_cache_evicts": (obs.batch_cache_events.value(result="evict")
+                                 - e0),
+        }
+
+
 def bench_scale(n_blocks, entries_per_block, iters):
     """North-star-scale serving (BASELINE config 5 / VERDICT r2 #1): a
     10K-block blocklist driven through the production read path, with the
@@ -774,6 +925,16 @@ def phase_high_cardinality_full():
             "dict_prefilter_ms": round(compile_ms, 1), "matches": matches}
 
 
+def phase_coalesced_serving():
+    n_entries = int(os.environ.get("BENCH_ENTRIES", 1_000_000))
+    iters = int(os.environ.get("BENCH_ITERS", 20))
+    n_blocks = int(os.environ.get("BENCH_BLOCKS", 100))
+    conc = int(os.environ.get("BENCH_COALESCE_CONCURRENCY", 8))
+    return bench_coalesced_serving(
+        n_blocks, max(1024, n_entries // n_blocks),
+        max(3, iters // 4), concurrency=conc)
+
+
 def phase_scale_10k():
     n_blocks = int(os.environ.get("BENCH_SCALE_BLOCKS", 10_000))
     if not n_blocks:
@@ -798,6 +959,7 @@ PHASES = {
     "single": phase_single,
     "multiblock": phase_multiblock,
     "serving": phase_serving,
+    "coalesced_serving": phase_coalesced_serving,
     "high_cardinality": phase_high_cardinality,
     "high_cardinality_full": phase_high_cardinality_full,
     "scale_10k": phase_scale_10k,
@@ -812,6 +974,7 @@ PHASE_TIMEOUTS = {
     "single": 420.0,
     "multiblock": 300.0,
     "serving": 420.0,
+    "coalesced_serving": 420.0,
     "high_cardinality": 300.0,
     "high_cardinality_full": 420.0,
     "scale_10k": 900.0,
@@ -979,6 +1142,7 @@ def _assemble(results: dict) -> dict:
                     if ok else None,
                 "multiblock": results.get("multiblock"),
                 "serving_path": serving,
+                "coalesced_serving": results.get("coalesced_serving"),
                 "high_cardinality": results.get("high_cardinality"),
                 "high_cardinality_full": results.get("high_cardinality_full"),
                 "scale_10k": results.get("scale_10k"),

@@ -113,9 +113,13 @@ def bench_block_write_read(n=500):
     from tempo_tpu.backend import BlockMeta, open_backend
     from tempo_tpu.encoding.v2 import BackendBlock, StreamingBlock
 
+    from tempo_tpu.encoding.v2.compression import encoding_usable
+
     objs = _objects(n)
     total = sum(len(b) for _, b in objs)
     for enc in CODECS:
+        if not encoding_usable(enc):
+            continue  # no native lib / wheel on this host
         backend = open_backend({"backend": "memory"})
         sb = StreamingBlock(BlockMeta(tenant_id="bench", encoding=enc))
         t0 = time.perf_counter()

@@ -759,7 +759,11 @@ long long tt_ingest_regroup(const char* src_c, size_t src_len,
     uint64_t end_ns = T.max_end;
     uint32_t start_s = (uint32_t)((start_ns / 1000000000ull) & 0xFFFFFFFF);
     uint32_t end_s = (uint32_t)((end_ns / 1000000000ull) & 0xFFFFFFFF);
-    uint64_t dur_ms = end_ns ? (end_ns - start_ns) / 1000000ull : 0;
+    // max(0, end - start): clock-skewed end < start must clamp to 0 (the
+    // unsigned underflow previously saturated to 0xFFFFFFFF, diverging
+    // from the Python walks, which now clamp to 0 too)
+    uint64_t dur_ms =
+        (end_ns > start_ns) ? (end_ns - start_ns) / 1000000ull : 0;
     if (dur_ms > 0xFFFFFFFFull) dur_ms = 0xFFFFFFFFull;
 
     out.append((const char*)T.tid.data(), 16);

@@ -78,6 +78,11 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "search_batch_cache_bytes", 4 << 30),
         search_host_cache_bytes=storage.get("search_host_cache_bytes"),
         search_prewarm_on_poll=storage.get("search_prewarm_on_poll", False),
+        # cross-request query coalescing (docs/search-coalescing.md)
+        search_coalesce_window_s=storage.get(
+            "search_coalesce_window_s", 0.003),
+        search_coalesce_max_queries=storage.get(
+            "search_coalesce_max_queries", 8),
         # restartable host state (header snapshot + persistent XLA
         # compile cache); absent = auto (<wal_dir>/host-state), "" = off
         host_state_dir=storage.get("host_state_dir"),

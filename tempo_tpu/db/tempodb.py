@@ -27,6 +27,7 @@ from tempo_tpu.search.columnar import PageGeometry
 from tempo_tpu.search.engine import ScanEngine
 from tempo_tpu.observability import metrics as obs
 from tempo_tpu.observability import tracing
+from tempo_tpu.observability.log import get_logger
 from tempo_tpu.utils.ids import pad_trace_id
 from tempo_tpu.utils.lru import BoundedCache
 from tempo_tpu.wal import WAL, AppendBlock
@@ -36,6 +37,9 @@ from .compaction import TimeWindowBlockSelector, compact_blocks
 from .poller import Poller
 from .pool import run_jobs
 from .retention import apply_retention
+
+
+log = get_logger("tempo_tpu.tempodb")
 
 
 @dataclass
@@ -67,6 +71,13 @@ class TempoDBConfig:
     # memory, so a fixed default would OOM small hosts
     search_host_cache_bytes: int | None = None
     search_pipeline_depth: int = 2        # dispatches in flight
+    # cross-request query coalescing: concurrent searches whose dispatch
+    # hits the same staged batch within this window fuse into ONE
+    # multi-query kernel launch. A solo search skips the window (no peer
+    # to wait for), so serial latency is unchanged. max_queries <= 1
+    # disables coalescing entirely
+    search_coalesce_window_s: float = 0.003
+    search_coalesce_max_queries: int = 8
     # stage + compile-warm hot batches in the background after each poll
     # so the first query pays neither (off by default: polls in tests and
     # write-only processes must not spin up device work)
@@ -91,6 +102,34 @@ class TempoDB:
         devices is built automatically if more than one is present."""
         self.backend = backend
         self.cfg = cfg or TempoDBConfig()
+        # degrade unusable codecs up front: a host without the native
+        # build AND without the zstandard wheel cannot zstd — writing
+        # must fall back to an always-available codec (data is labeled
+        # with the codec that actually wrote it; READS of existing zstd
+        # blocks still fail loudly, which is correct)
+        from tempo_tpu.encoding.v2.compression import best_available
+
+        import dataclasses
+
+        for _field in dataclasses.fields(self.cfg):
+            if _field.name not in ("block_encoding", "search_encoding"):
+                continue
+            _enc = getattr(self.cfg, _field.name)
+            if _enc != _field.default:
+                # an explicit non-default codec choice fails fast on
+                # first use — silently rewriting it would mask a broken
+                # deployment (missing native lib the operator asked for)
+                continue
+            _use = best_available(_enc)
+            if _use != _enc:
+                log.warning("%s %r unusable on this host (no native lib/"
+                            "wheel); degrading to %r", _field.name, _enc,
+                            _use)
+                # degrade a COPY: the caller's config object is theirs —
+                # writing into it would leak this host's fallback into
+                # other TempoDBs built from the same config
+                self.cfg = dataclasses.replace(
+                    self.cfg, **{_field.name: _use})
         self.wal = WAL(wal_dir, encoding=self.cfg.wal_encoding)
         self.blocklist = Blocklist()
         self.poller = Poller(backend, build_index=self.cfg.tenant_index_builder)
@@ -110,6 +149,8 @@ class TempoDB:
             cache_bytes=self.cfg.search_batch_cache_bytes,
             host_cache_bytes=self.cfg.search_host_cache_bytes,
             pipeline_depth=self.cfg.search_pipeline_depth,
+            coalesce_window_s=self.cfg.search_coalesce_window_s,
+            coalesce_max_queries=self.cfg.search_coalesce_max_queries,
         )
         self._prewarm_stop = None  # Event cancelling the running prewarm
         self._prewarm_thread = None

@@ -46,6 +46,20 @@ def requires_native(encoding: str) -> bool:
     return False
 
 
+def encoding_usable(encoding: str) -> bool:
+    """Can this codec actually compress in THIS process (native lib or
+    pure-python fallback present)?"""
+    return not requires_native(encoding) or _native() is not None
+
+
+def best_available(preferred: str, fallback: str = "zlib") -> str:
+    """`preferred` if its codec is usable here, else `fallback` (zlib:
+    always available, closest ratio to zstd). The degrade point for
+    DEFAULT configs on hosts without the native build or wheels — data
+    is always labeled with the codec that actually wrote it."""
+    return preferred if encoding_usable(preferred) else fallback
+
+
 def compress(data: bytes, encoding: str, level: int = 3) -> bytes:
     if encoding == "none":
         return data

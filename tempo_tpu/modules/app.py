@@ -165,8 +165,10 @@ class App:
                 if immediate:  # restart must not serve an empty
                     try:       # blocklist for a full poll interval
                         fn()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception:  # noqa: BLE001 — keep loops alive,
+                        # but a backend broken at boot must not be silent
+                        # (microservices.py logs the same failure)
+                        log.exception("startup maintenance tick")
                 while not self._stop.wait(tick_s):
                     try:
                         fn()

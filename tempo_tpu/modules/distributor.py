@@ -340,7 +340,11 @@ class Distributor:
                 start_ns = 0  # trace_range_ns contract: no ended span
             sd.start_s = start_ns // 1_000_000_000
             sd.end_s = end_ns // 1_000_000_000
-            sd.dur_ms = (min((end_ns - start_ns) // 1_000_000, 0xFFFFFFFF)
+            # max(0, end - start): clock skew can put end before start,
+            # and a negative duration must clamp (not raise in _U32.pack)
+            # identically to extract_search_data and the native walker
+            sd.dur_ms = (min(max(0, end_ns - start_ns) // 1_000_000,
+                             0xFFFFFFFF)
                          if end_ns else 0)
             r = root.get(tid) or first.get(tid)
             if r is not None:

@@ -148,13 +148,24 @@ class QueryFrontend:
     # ---- search (reference searchsharding.go:163-306) ----
 
     def search(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
+        """Shard + dispatch one search. Concurrent search() calls are the
+        query-coalescer's feedstock: every batched sub-request runs on a
+        shared worker-pool thread (never serialized per tenant beyond
+        queue fairness), so two dashboards firing together reach the
+        querier's BlockBatcher concurrently and their same-batch
+        dispatches fuse into one multi-query kernel launch. The frontend
+        deliberately keeps sub-request ORDER deterministic (plan-cached
+        batches, stable group sort) — peers that iterate groups in the
+        same order meet in every coalescing window instead of just the
+        first."""
         with tracing.start_span("frontend.Search", kind=tracing.KIND_SERVER,
                                 tenant=tenant) as span:
-            resp = self._search(tenant, req)
+            resp, n_batches = self._search(tenant, req)
             span.set_attributes(
                 inspected_blocks=resp.metrics.inspected_blocks,
                 inspected_traces=resp.metrics.inspected_traces,
-                results=len(resp.traces))
+                results=len(resp.traces),
+                block_batches=n_batches)
             return resp
 
     def _block_jobs(self, metas) -> list[tuple]:
@@ -249,7 +260,8 @@ class QueryFrontend:
         self._batches_cache.put(key, out)
         return out
 
-    def _search(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
+    def _search(self, tenant: str,
+                req: tempopb.SearchRequest) -> tuple[tempopb.SearchResponse, int]:
         import threading
 
         batches = self._search_batches(tenant)
@@ -317,4 +329,4 @@ class QueryFrontend:
         # "pruned" (reference frontend.go:144-146; HTTP layer maps
         # failed_blocks > 0 to 206)
         merged.metrics.failed_blocks += len(failed_block_ids)
-        return merged.response()
+        return merged.response(), len(batches)

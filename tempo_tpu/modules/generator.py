@@ -28,6 +28,7 @@ from tempo_tpu import tempopb
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 from tempo_tpu.observability.metrics import Registry, Counter, Histogram
+from tempo_tpu.search.data import _any_value_str
 
 LATENCY_BUCKETS_S = (0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
                      0.256, 0.512, 1.024, 2.048, 4.096, 8.192, 16.384)
@@ -53,7 +54,10 @@ class SpanMetricsProcessor:
         svc = ""
         for kv in batch.resource.attributes:
             if kv.key == "service.name":
-                svc = kv.value.string_value
+                # stringified AnyValue, not .string_value: a non-string
+                # service.name ('true', '123') must yield the same series
+                # as search-data extraction and the native summary feed
+                svc = _any_value_str(kv.value)
         kind_names, status_names = self._KIND_NAMES, self._STATUS_NAMES
         series = self._series  # (svc, name, kind, status) → bound handles
         for ss in batch.scope_spans:
@@ -125,7 +129,7 @@ class ServiceGraphProcessor:
         svc = ""
         for kv in batch.resource.attributes:
             if kv.key == "service.name":
-                svc = kv.value.string_value
+                svc = _any_value_str(kv.value)  # match the native feed
         now = time.monotonic()
         for ss in batch.scope_spans:
             for span in ss.spans:

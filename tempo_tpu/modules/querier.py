@@ -13,6 +13,7 @@ from tempo_tpu import tempopb
 from tempo_tpu.db import TempoDB
 from tempo_tpu.model.codec import codec_for, CURRENT_ENCODING
 from tempo_tpu.model.matches import trace_search_metadata
+from tempo_tpu.observability import tracing
 from tempo_tpu.search import SearchResults
 from tempo_tpu.utils.hashing import token_for
 from tempo_tpu.utils.ids import pad_trace_id
@@ -185,10 +186,31 @@ class Querier:
         return self.db.search_block(req).response()
 
     def search_blocks(self, req: tempopb.SearchBlocksRequest) -> tempopb.SearchResponse:
-        """Batched job execution: one kernel dispatch per geometry group.
+        """Batched job execution: one kernel dispatch per geometry group
+        — and under concurrency, FEWER: concurrent search_blocks calls
+        (several frontend requests, several tenants' dashboards) route
+        into the shared BlockBatcher, whose QueryCoalescer fuses
+        dispatches that land on the same staged batch within the
+        coalescing window into one multi-query kernel launch. The
+        querier adds no serialization of its own — each call runs on its
+        caller's worker thread so peers can actually meet in the window.
         With serverless endpoints configured the batch degrades to
         singular jobs so overflow can proxy out (the external workers
-        speak SearchBlockRequest)."""
+        speak SearchBlockRequest); that path bypasses batching AND
+        coalescing."""
+        with tracing.start_span(
+                "querier.SearchBlocks", tenant=req.tenant_id,
+                jobs=len(req.jobs)) as span:
+            resp = self._search_blocks(req)
+            # dispatch counts live in scan_dispatches{mode=batched|
+            # coalesced}, not here: the batcher's last-search scratch is
+            # shared across concurrent searches and would attribute
+            # another request's dispatches to this span
+            span.set_attributes(
+                inspected_blocks=resp.metrics.inspected_blocks)
+            return resp
+
+    def _search_blocks(self, req: tempopb.SearchBlocksRequest) -> tempopb.SearchResponse:
         if self.external_endpoints:
             from tempo_tpu.search import SearchResults
 

@@ -234,7 +234,16 @@ retention_deleted = Counter("tempodb_retention_deleted_total",
 scan_dispatches = Counter("tempo_search_scan_dispatches_total",
                           "device scan kernel dispatches")
 batch_cache_events = Counter("tempo_search_batch_cache_events_total",
-                             "staged-batch HBM cache hits/misses")
+                             "staged-batch HBM cache hits/misses/evictions")
+coalesced_queries = Counter(
+    "tempo_search_coalesced_queries_total",
+    "queries served through fused multi-query scan dispatches; the "
+    "coalesce ratio is this over scan_dispatches{mode=coalesced}")
+coalesce_wait_seconds = Histogram(
+    "tempo_search_coalesce_wait_seconds",
+    "time a query spent waiting in the coalescing window before its "
+    "fused dispatch launched",
+    buckets=(0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1))
 fallback_scans = Counter("tempo_search_fallback_scans_total",
                          "trace-block proto scans for blocks lacking "
                          "search data")

@@ -99,15 +99,17 @@ class DistributedScanEngine:
             top_scores, pos = jax.lax.top_k(all_scores, k)
             return count, inspected, top_scores, all_idx[pos]
 
-        return jax.shard_map(
+        from tempo_tpu.parallel.mesh import shard_map_compat
+
+        return shard_map_compat(
             shard_fn, mesh=self.mesh,
             in_specs=(P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS),
                       P(SCAN_AXIS), P(SCAN_AXIS),
                       P(), P(), P(), P(), P(), P()),
             out_specs=(P(), P(), P(), P()),
             # all_gather+top_k yields identical values on every shard, but
-            # the VMA checker can't infer replication through the gather
-            check_vma=False,
+            # the replication checker can't infer it through the gather
+            check=False,
         )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
           term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end)
 

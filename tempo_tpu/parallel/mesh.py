@@ -26,3 +26,17 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     import numpy as np
 
     return Mesh(np.array(devs), (SCAN_AXIS,))
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check: bool = False):
+    """jax.shard_map across jax versions: newer jax exposes it top-level
+    with `check_vma`; older releases only have the experimental module
+    with the same knob spelled `check_rep`. Every distributed kernel
+    routes through here so a version bump is a one-line change."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check)

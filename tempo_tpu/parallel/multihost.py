@@ -60,8 +60,25 @@ def init_distributed(coordinator: str | None = None,
         # config.update, NOT env: the axon sitecustomize imports jax at
         # interpreter start, so JAX_PLATFORMS set in-process is ignored
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", int(cpu_devices_per_host))
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        try:
+            jax.config.update("jax_num_cpu_devices", int(cpu_devices_per_host))
+        except AttributeError:
+            # older jax: XLA_FLAGS still works as long as no backend has
+            # initialized yet (this runs before any device op). REPLACE
+            # any inherited device-count flag (e.g. the test harness's
+            # 8-device setting) — this process must get exactly its own
+            import re
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "", flags)
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{int(cpu_devices_per_host)}").strip()
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except AttributeError:
+            pass  # older jax: flag spelled differently / unavailable
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)

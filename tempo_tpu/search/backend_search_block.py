@@ -37,7 +37,14 @@ def default_engine() -> ScanEngine:
 def write_search_block(backend: RawBackend, meta: BlockMeta,
                        entries: list[SearchData],
                        geometry: PageGeometry = PageGeometry(),
-                       encoding: str = "zstd") -> dict:
+                       encoding: str | None = None) -> dict:
+    # None = zstd when the codec exists on this host, else zlib — the
+    # header records whichever codec actually wrote the pages, so reads
+    # are unaffected. Production callers pass cfg.search_encoding.
+    if encoding is None:
+        from tempo_tpu.encoding.v2.compression import best_available
+
+        encoding = best_available("zstd")
     pages = ColumnarPages.build(entries, geometry)
     blob = compress(pages.to_bytes(), encoding)
     header = dict(pages.header)

@@ -42,8 +42,9 @@ import numpy as np
 from tempo_tpu.observability import metrics as obs
 from tempo_tpu.observability import tracing
 
-from .engine import DEFAULT_TOP_K, start_fetch
-from .multiblock import MultiBlockEngine, compile_multi
+from .engine import DEFAULT_TOP_K, fetch_coalesced_out, resolve_top_k, \
+    start_fetch
+from .multiblock import MultiBlockEngine, compile_multi, stack_queries
 from .pipeline import matches_block_header
 from .results import SearchResults
 
@@ -79,6 +80,13 @@ class _CachedBatch:
     # O(blocks) python per query (VERDICT r2 #1). Keyed by the full
     # predicate signature; bounded LRU.
     query_cache: OrderedDict = field(default_factory=OrderedDict)
+    # HBM pin count: searches holding this batch (between acquisition and
+    # their final drain). Eviction skips pinned entries so budget
+    # pressure from one tenant never drops a batch another request is
+    # actively scanning — its device arrays would survive via the
+    # in-flight references anyway, but the budget would double-pay when
+    # the next query immediately re-stages it
+    pins: int = 0
 
 
 _QUERY_CACHE_MAX = 32
@@ -94,6 +102,235 @@ def _predicate_sig(req) -> tuple:
             req.max_duration_ms or 0, req.start or 0, req.end or 0)
 
 
+class _PendingCoalesce:
+    """Queries waiting on one staged batch for the window to close."""
+
+    __slots__ = ("batch", "gen", "items")
+
+    def __init__(self, batch, gen):
+        self.batch = batch
+        self.gen = gen
+        self.items = []     # [(mq, top_k, Future, t_submit)]
+
+
+class _FusedOut:
+    """One fused dispatch's device output, demuxed lazily: the blocking
+    D2H sync runs once, on the FIRST waiter's drain thread — never on
+    the submitter whose submit() happened to trigger a size flush (that
+    thread has its own dispatch loop to run; syncing there would
+    serialize its next group behind this group's fetch)."""
+
+    __slots__ = ("_out", "_host", "_lock")
+
+    def __init__(self, out):
+        self._out = out
+        self._host = None
+        self._lock = threading.Lock()
+
+    def host(self):
+        with self._lock:
+            if self._host is None:
+                self._host = fetch_coalesced_out(self._out)
+                self._out = None
+            return self._host
+
+
+class _FusedSlice:
+    """One member query's view of a _FusedOut; unpacks like the direct
+    path's (count, inspected, scores, idx) tuple so drain code cannot
+    tell a fused dispatch from a solo one."""
+
+    __slots__ = ("_shared", "_qi")
+
+    def __init__(self, shared, qi):
+        self._shared = shared
+        self._qi = qi
+
+    def __iter__(self):
+        counts, inspected, scores, idx = self._shared.host()
+        qi = self._qi
+        return iter((int(counts[qi]), inspected, scores[qi], idx[qi]))
+
+
+class QueryCoalescer:
+    """Cross-request query coalescing: concurrent searches whose next
+    dispatch targets the SAME staged BlockBatch stack their compiled
+    queries along a query axis and execute as ONE fused
+    coalesced_scan_kernel launch — continuous batching for scans. N
+    tenants' dashboards over the same device-resident columns then cost
+    ~1 dispatch per coalescing window instead of N.
+
+    Mechanics:
+    - submit() parks the query in a per-batch pending group and arms a
+      window timer (`window_s`, a few ms). The flush NEVER waits for
+      more peers — it fires on the timer or when `max_queries` stack up,
+      so a lone query is delayed by at most the window.
+    - A dispatch with no potential peer skips the window entirely (the
+      `peers` hint on submit, per-BATCH, not merely per-process): serial
+      latency is unchanged, and a single request's own sharded
+      sub-requests — which target disjoint batches and can never fuse —
+      don't tax each other either. The window is only paid when another
+      in-flight search could actually share this batch's dispatch.
+    - Single-query flushes go through the ordinary multi_scan_kernel so
+      they reuse its already-compiled executables.
+    - Query tables pad (Q, T, R, top_k) to power-of-two buckets
+      (multiblock.stack_queries), so the jit cache keys on predicate
+      SHAPE, never predicate values — different tag-sets share one
+      compiled executable.
+    """
+
+    def __init__(self, engine: MultiBlockEngine, window_s: float = 0.003,
+                 max_queries: int = 8, active_fn=None):
+        self.engine = engine
+        self.window_s = window_s
+        self.max_queries = max(2, max_queries)
+        # how many searches are in flight right now; <=1 → flush
+        # immediately (no peer exists to wait for)
+        self._active_fn = active_fn or (lambda: 2)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: dict[int, _PendingCoalesce] = {}
+        # window deadlines served by ONE long-lived scheduler thread
+        # (lazily started): a threading.Timer per armed window would
+        # create an OS thread per batch per window on the serving hot
+        # path — pure churn at thousands of windows/sec
+        self._deadlines: list[tuple[float, int, int]] = []  # (t, key, gen)
+        self._sched: threading.Thread | None = None
+        self._flush_pool = None  # lazily built with the scheduler
+        self._gen = 0
+        self.dispatches = 0   # fused + solo kernel launches issued here
+        self.fused = 0        # launches that served >1 query
+        self.queries = 0      # queries served
+
+    def submit(self, batch, mq, top_k: int, peers: int | None = None):
+        """Queue one compiled query against `batch`; returns a Future
+        resolving to the engine's (count, inspected, scores, idx) — the
+        same host types drain code gets from a direct dispatch. `peers`
+        is the caller's count of in-flight searches that could target
+        THIS batch (self included); <=1 flushes immediately."""
+        import concurrent.futures
+        import heapq
+        import time as _time
+
+        fut = concurrent.futures.Future()
+        flush_now = None
+        with self._lock:
+            key = id(batch)
+            grp = self._pending.get(key)
+            if grp is None:
+                self._gen += 1
+                grp = self._pending[key] = _PendingCoalesce(batch, self._gen)
+            grp.items.append((mq, top_k, fut, _time.perf_counter()))
+            if len(grp.items) >= self.max_queries:
+                del self._pending[key]
+                flush_now = grp
+            elif len(grp.items) == 1:
+                hint = peers if peers is not None else self._active_fn()
+                if hint <= 1:
+                    # no peer can share this batch's dispatch: a window
+                    # would be pure added latency
+                    del self._pending[key]
+                    flush_now = grp
+                else:
+                    heapq.heappush(
+                        self._deadlines,
+                        (_time.perf_counter() + self.window_s, key,
+                         grp.gen))
+                    if self._sched is None:
+                        self._flush_pool = \
+                            concurrent.futures.ThreadPoolExecutor(
+                                max_workers=4,
+                                thread_name_prefix="coalesce-flush")
+                        self._sched = threading.Thread(
+                            target=self._window_loop, daemon=True,
+                            name="coalesce-window")
+                        self._sched.start()
+                    self._cv.notify()
+        if flush_now is not None:
+            self._run(flush_now)
+        return fut
+
+    def _window_loop(self) -> None:
+        """Single scheduler thread draining window deadlines. Stale
+        entries (groups a size-triggered flush already took) are skipped
+        by the gen check — nothing is ever cancelled out of the heap.
+        Due flushes are HANDED OFF to a small pool: _run stages, uploads
+        and may jit-compile a first-seen kernel shape, and running that
+        inline would head-of-line-block every other batch's window
+        behind one slow group."""
+        import heapq
+        import time as _time
+
+        while True:
+            grp = None
+            with self._cv:
+                while not self._deadlines:
+                    self._cv.wait()
+                deadline, key, gen = self._deadlines[0]
+                wait = deadline - _time.perf_counter()
+                if wait > 0:
+                    self._cv.wait(wait)
+                    continue
+                heapq.heappop(self._deadlines)
+                pend = self._pending.get(key)
+                if pend is None or pend.gen != gen:
+                    continue  # size-triggered flush beat the window
+                del self._pending[key]
+                grp = pend
+            self._flush_pool.submit(self._run, grp)
+
+    def _run(self, grp: _PendingCoalesce) -> None:
+        import time as _time
+
+        items = grp.items
+        try:
+            now = _time.perf_counter()
+            for _mq, _k, _fut, t0 in items:
+                obs.coalesce_wait_seconds.observe(now - t0)
+            with self._lock:  # _run races: window thread vs size flush
+                self.dispatches += 1
+                self.queries += len(items)
+                if len(items) > 1:
+                    self.fused += 1
+            if len(items) == 1:
+                mq, _k, fut, _t0 = items[0]
+                out = self.engine.scan_async(grp.batch, mq)
+                start_fetch(out)
+                obs.scan_dispatches.inc(mode="batched")
+                fut.set_result(out)
+                return
+            mqs = [mq for mq, _k, _f, _t in items]
+            cq = stack_queries(mqs)
+            k = max(k for _mq, k, _f, _t in items)
+            out = self.engine.coalesced_scan_async(grp.batch, cq, k)
+            obs.scan_dispatches.inc(mode="coalesced")
+            obs.coalesced_queries.inc(len(items))
+            # D2H starts async NOW; the one blocking sync point happens
+            # on the first waiter's drain (lazy demux), not here — a
+            # size-triggered flush runs on the last submitter's thread,
+            # which still has its own dispatch loop to overlap
+            start_fetch(out)
+            shared = _FusedOut(out)
+            for qi, (_mq, _k, fut, _t0) in enumerate(items):
+                fut.set_result(_FusedSlice(shared, qi))
+        except BaseException as e:  # noqa: BLE001 — delivered via futures
+            for _mq, _k, fut, _t0 in items:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def stats(self) -> dict:
+        with self._lock:
+            pending = sum(len(g.items) for g in self._pending.values())
+        return {
+            "dispatches": self.dispatches,
+            "fused_dispatches": self.fused,
+            "queries": self.queries,
+            "ratio": round(self.queries / max(1, self.dispatches), 3),
+            "pending": pending,
+            "window_ms": self.window_s * 1e3,
+        }
+
+
 class BlockBatcher:
     """Groups ScanJobs into staged device batches and runs searches over
     them. Thread-safe; one instance per TempoDB."""
@@ -103,7 +340,9 @@ class BlockBatcher:
                  cache_bytes: int = 4 << 30,
                  host_cache_bytes: int | None = None,
                  pipeline_depth: int = 2,
-                 io_workers: int = 8):
+                 io_workers: int = 8,
+                 coalesce_window_s: float = 0.003,
+                 coalesce_max_queries: int = 8):
         self.engine = MultiBlockEngine(top_k=top_k, mesh=mesh)
         self.max_batch_pages = max_batch_pages
         self.cache_bytes = cache_bytes
@@ -141,7 +380,29 @@ class BlockBatcher:
         import concurrent.futures
         self._prefetcher = concurrent.futures.ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="stage-prefetch")
-        self.last_dispatches = 0  # diagnostics: kernel calls in last search
+        # cross-request query coalescing: concurrent searches' dispatches
+        # over the same staged batch fuse into one multi-query kernel
+        # launch. coalesce_max_queries <= 1 disables (every submit
+        # dispatches directly, the pre-coalescer behavior).
+        # _interest counts, per batch gkey, how many in-flight searches
+        # plan to scan it; _unplanned counts searches that entered but
+        # haven't resolved their plan yet (unknown targets — they could
+        # hit any batch, so they count as potential peers everywhere).
+        # The coalescing window is armed only when interest+unplanned
+        # says a same-batch peer can actually arrive: a single request's
+        # sharded sub-requests cover DISJOINT batches and must not tax
+        # each other a window apiece
+        self._interest: dict[tuple, int] = {}
+        self._unplanned = 0
+        self.coalescer = None
+        if coalesce_max_queries > 1:
+            self.coalescer = QueryCoalescer(
+                self.engine, window_s=coalesce_window_s,
+                max_queries=coalesce_max_queries)
+        self.last_dispatches = 0  # diagnostics: dispatch SUBMITS in last
+        # search — under coalescing several searches can share one kernel
+        # launch, so the global launch count lives in the
+        # scan_dispatches{mode=batched|coalesced} counters instead
         self.last_scan = None     # /debug/scan: last search's breakdown
 
     # ------------------------------------------------------------------
@@ -183,6 +444,21 @@ class BlockBatcher:
 
     # ------------------------------------------------------------------
     # staging cache
+
+    def _evict_hbm_locked(self) -> None:
+        """LRU-evict staged batches until the HBM budget holds — caller
+        holds self._lock. Pinned entries (actively scanned by some
+        search) are skipped: evicting them reclaims nothing (the
+        in-flight dispatch pins the device arrays) and guarantees an
+        immediate re-stage."""
+        while self._cache_total > self.cache_bytes and len(self._cache) > 1:
+            victim = next((k for k, v in self._cache.items()
+                           if v.pins <= 0), None)
+            if victim is None:
+                break  # everything pinned: over budget until a drain
+            old = self._cache.pop(victim)
+            self._cache_total -= old.nbytes
+            obs.batch_cache_events.inc(result="evict")
 
     def _staged(self, group: list[ScanJob]) -> _CachedBatch:
         key = tuple(j.key for j in group)
@@ -227,6 +503,7 @@ class BlockBatcher:
                            and len(self._host_cache) > 1):
                         _, oldh = self._host_cache.popitem(last=False)
                         self._host_total -= oldh.nbytes
+                        obs.batch_cache_events.inc(result="host_evict")
                 obs.batch_cache_events.inc(result="host_miss")
             else:
                 obs.batch_cache_events.inc(result="host_hit")
@@ -240,9 +517,7 @@ class BlockBatcher:
                     self._cache_total -= prev.nbytes
                 self._cache[key] = entry
                 self._cache_total += nbytes
-                while self._cache_total > self.cache_bytes and len(self._cache) > 1:
-                    _, old = self._cache.popitem(last=False)
-                    self._cache_total -= old.nbytes
+                self._evict_hbm_locked()
             return entry
         finally:
             with self._lock:
@@ -344,7 +619,41 @@ class BlockBatcher:
         (tenant, blocklist-epoch)) memoizes the grouping — the plan is a
         pure function of the job list, and re-sorting 10K jobs per query
         is measurable host overhead. Callers that already hold the plan
-        (tempodb's protocol-path job cache) pass `groups` directly."""
+        (tempodb's protocol-path job cache) pass `groups` directly.
+
+        Concurrent calls coalesce: dispatches landing on the same staged
+        batch within the coalescing window fuse into one multi-query
+        kernel launch (see QueryCoalescer). Batches a search is actively
+        scanning are pinned in the HBM cache for its duration."""
+        with self._lock:
+            self._unplanned += 1
+        pinned: list[_CachedBatch] = []
+        interest: list[tuple] = []   # gkeys registered once planned
+        planned = [False]
+        try:
+            return self._search_impl(jobs, req, results, plan_key, groups,
+                                     pinned, interest, planned)
+        finally:
+            with self._lock:
+                if planned[0]:
+                    for k in interest:
+                        n = self._interest.get(k, 0) - 1
+                        if n <= 0:
+                            self._interest.pop(k, None)
+                        else:
+                            self._interest[k] = n
+                else:  # died before the plan resolved
+                    self._unplanned -= 1
+                for c in pinned:
+                    c.pins -= 1
+                # evictions deferred by pins run now that they dropped
+                self._evict_hbm_locked()
+
+    def _search_impl(self, jobs: list[ScanJob], req,
+                     results: SearchResults | None,
+                     plan_key, groups: list | None,
+                     pinned: list, interest: list,
+                     planned: list) -> SearchResults:
         from .pipeline import is_exhaustive
 
         results = results or SearchResults.for_request(req)
@@ -365,6 +674,16 @@ class BlockBatcher:
                     self._plan_cache[tenant_key] = (gen, groups)
                     while len(self._plan_cache) > 64:
                         self._plan_cache.popitem(last=False)
+        # plan is final: declare which batches this search will scan so
+        # the coalescer can tell a real same-batch peer from an unrelated
+        # concurrent search (which must not make us wait out a window)
+        with self._lock:
+            self._unplanned -= 1
+            planned[0] = True
+            for g in groups:
+                k = tuple(j.key for j in g)
+                self._interest[k] = self._interest.get(k, 0) + 1
+                interest.append(k)
         inflight: deque = deque()
         dispatches = 0
         # per-stage wall time for the LAST search, exposed at /debug/scan
@@ -379,8 +698,36 @@ class BlockBatcher:
 
         def drain_one():
             t0 = _time.perf_counter()
-            cached, mq, pre, fut = inflight.popleft()
+            gkey, cached, mq, pre, fut = inflight.popleft()
+            if hasattr(fut, "result"):  # coalescer Future vs direct tuple
+                fut = fut.result()
             count, inspected, scores, idx = fut
+            # harvest the uploaded per-query tables AFTER the dispatch
+            # ran: under coalescing the flush (and its H2D upload) can
+            # happen on the window-timer thread, after submit returned —
+            # harvesting at submit time saw nothing and repeat predicates
+            # re-uploaded their [B,T]/[B,T,R,2] tables every dispatch.
+            # A fused dispatch uploads the STACKED tables instead, so
+            # per-query params exist only when the single-query kernel
+            # ran (solo flush or coalescing disabled)
+            new_dp = getattr(mq, "_device_params", None)
+            if new_dp is not None:
+                # the uploaded query tables live in HBM: account them
+                # against the batch so the cache_bytes budget sees
+                # per-predicate device memory, not just page arrays
+                dpb = int(sum(getattr(a, "nbytes", 0) for a in new_dp))
+                with self._lock:
+                    if pre.get("device_params") is None:
+                        pre["device_params"] = new_dp
+                        pre["device_params_bytes"] = dpb
+                        cached.nbytes += dpb
+                        # residency guard (same as the memo eviction): dp
+                        # bytes charged to an already-evicted batch would
+                        # inflate the budget with memory the next
+                        # eviction can never reclaim
+                        if self._cache.get(gkey) is cached:
+                            self._cache_total += dpb
+                            self._evict_hbm_locked()
             inspected = int(inspected) - pre["entries_skipped"]
             results.metrics.inspected_blocks += pre["inspected_blocks"]
             results.metrics.inspected_bytes += pre["inspected_bytes"]
@@ -505,6 +852,9 @@ class BlockBatcher:
                 cached = (fut_staged.result() if fut_staged is not None
                           else self._staged(group))
                 stages["staging"] += _time.perf_counter() - t0
+                with self._lock:
+                    cached.pins += 1
+                pinned.append(cached)
                 submit_prefetch(gi + 1)
                 with self._lock:
                     pre = cached.query_cache.get(sig)
@@ -545,31 +895,41 @@ class BlockBatcher:
                     mq._device_params = dp
                 results.metrics.skipped_blocks += pre["skipped"]
                 t0 = _time.perf_counter()
-                fut = self.engine.scan_async(cached.batch, mq)
-                stages["dispatch"] += _time.perf_counter() - t0
-                if dp is None:
-                    new_dp = mq._device_params
-                    # the uploaded query tables live in HBM: account them
-                    # against the batch so the cache_bytes budget sees
-                    # per-predicate device memory, not just page arrays
-                    dpb = int(sum(getattr(a, "nbytes", 0) for a in new_dp))
+                if self.coalescer is not None:
+                    # concurrent peers hitting this batch within the
+                    # window share ONE fused kernel launch; a dispatch
+                    # with no possible same-batch peer (solo search, or
+                    # a sibling sub-request over a disjoint batch) flushes
+                    # immediately (no added latency)
                     with self._lock:
-                        pre["device_params"] = new_dp
-                        pre["device_params_bytes"] = dpb
-                        cached.nbytes += dpb
-                        # same residency guard as the memo eviction above:
-                        # dp bytes charged to an already-evicted batch
-                        # would inflate the budget with memory the next
-                        # eviction can never reclaim
-                        if self._cache.get(gkey) is cached:
-                            self._cache_total += dpb
-                            while (self._cache_total > self.cache_bytes
-                                   and len(self._cache) > 1):
-                                _, old = self._cache.popitem(last=False)
-                                self._cache_total -= old.nbytes
-                start_fetch(fut)  # D2H begins now, overlapping next groups
+                        peers = (self._interest.get(gkey, 1)
+                                 + self._unplanned)
+                    fut = self.coalescer.submit(
+                        cached.batch, mq,
+                        resolve_top_k(self.engine.top_k, mq.limit),
+                        peers=peers)
+                else:
+                    fut = self.engine.scan_async(cached.batch, mq)
+                    start_fetch(fut)  # D2H begins now, overlapping groups
+                stages["dispatch"] += _time.perf_counter() - t0
                 dispatches += 1
-                inflight.append((cached, mq, pre, fut))
+                inflight.append((gkey, cached, mq, pre, fut))
+                # this search never returns to this batch: release its
+                # interest NOW so later peers don't arm windows for a
+                # fusion that can no longer happen (a parked query still
+                # fuses — joiners find the pending group itself, not the
+                # hint). The outer finally releases whatever never
+                # dispatched (skipped groups, early quit)
+                with self._lock:
+                    n = self._interest.get(gkey, 0) - 1
+                    if n <= 0:
+                        self._interest.pop(gkey, None)
+                    else:
+                        self._interest[gkey] = n
+                try:
+                    interest.remove(gkey)
+                except ValueError:
+                    pass
                 while len(inflight) >= self.pipeline_depth:
                     drain_one()
             while inflight:
@@ -586,7 +946,11 @@ class BlockBatcher:
             span.set_attributes(groups=len(groups), scan_dispatches=dispatches,
                                 inspected_blocks=results.metrics.inspected_blocks,
                                 skipped_blocks=results.metrics.skipped_blocks)
-        obs.scan_dispatches.inc(dispatches, mode="batched")
+        if self.coalescer is None:
+            # with the coalescer active the LAUNCH counters are kept at
+            # flush time (mode="batched" solo, mode="coalesced" fused) —
+            # counting submits here would double-book shared launches
+            obs.scan_dispatches.inc(dispatches, mode="batched")
         self.last_dispatches = dispatches
         self.last_scan = {
             "total_ms": round((_time.perf_counter() - t_search0) * 1000, 3),
@@ -620,4 +984,6 @@ class BlockBatcher:
                     "plan_entries": len(self._plan_cache),
                     "warmed_shapes": len(self._warmed_shapes),
                 },
+                "coalesce": (self.coalescer.stats()
+                             if self.coalescer is not None else None),
             }

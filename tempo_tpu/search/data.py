@@ -73,7 +73,12 @@ def extract_search_data(trace_id: bytes, trace: tempopb.Trace,
     start_ns, end_ns = range_ns
     sd.start_s = start_ns // 1_000_000_000
     sd.end_s = end_ns // 1_000_000_000
-    sd.dur_ms = min((end_ns - start_ns) // 1_000_000, 0xFFFFFFFF) if end_ns else 0
+    # clamp: clock-skewed clients ship end < start (valid input); the
+    # duration convention is max(0, end - start) on EVERY path — Python
+    # walk, distributor fused walk, native tt_ingest_regroup — or the
+    # paths diverge and the Python one crashes encode_search_data
+    sd.dur_ms = (min(max(0, end_ns - start_ns) // 1_000_000, 0xFFFFFFFF)
+                 if end_ns else 0)
 
     budget = max_bytes
     root = None

@@ -135,6 +135,28 @@ def fetch_scan_out(out):
     return int(count), int(inspected), np.asarray(scores), np.asarray(idx)
 
 
+def resolve_top_k(base: int, limit: int) -> int:
+    """top_k must cover the request limit or results get silently
+    truncated below it; bucket to pow2 to bound recompiles. Shared by
+    the single-block, multi-block and coalesced dispatch paths so the
+    SAME (limit → k) mapping keys every jit cache."""
+    k = max(1, base)
+    while k < limit:
+        k *= 2
+    return k
+
+
+def fetch_coalesced_out(out):
+    """Query-axis variant of fetch_scan_out: (counts [Q], inspected,
+    scores [Q,k], idx [Q,k]) device arrays → host values with a single
+    synchronization point. The per-query demux slices the host arrays —
+    one D2H wait for the whole coalesced group, not Q."""
+    start_fetch(out)
+    counts, inspected, scores, idx = out
+    return (np.asarray(counts), int(inspected),
+            np.asarray(scores), np.asarray(idx))
+
+
 _TOPK_CHUNK = 8192
 
 
@@ -198,12 +220,7 @@ class ScanEngine:
         self.top_k = top_k
 
     def _resolve_top_k(self, cq: CompiledQuery) -> int:
-        """top_k must cover the request limit or results get silently
-        truncated below it; bucket to pow2 to bound recompiles."""
-        k = self.top_k
-        while k < cq.limit:
-            k *= 2
-        return k
+        return resolve_top_k(self.top_k, cq.limit)
 
     @staticmethod
     def query_device_params(cq: CompiledQuery):

@@ -281,15 +281,87 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
     )
 
 
+@dataclass
+class CoalescedQuery:
+    """Several requests' MultiQueries stacked along a new QUERY axis for
+    one fused dispatch over a shared staged batch — the continuous-
+    batching shape: predicate tables become [Q, B, ...] and the kernel
+    computes per-query masks + per-query top-k in a single launch."""
+    term_keys: np.ndarray    # int32 [Q, B, T]
+    val_ranges: np.ndarray   # int32 [Q, B, T, R, 2]
+    term_active: np.ndarray  # bool [Q, T] — False = padding term (no-op)
+    dur_lo: np.ndarray       # uint32 [Q]
+    dur_hi: np.ndarray       # uint32 [Q]
+    win_start: np.ndarray    # uint32 [Q]
+    win_end: np.ndarray      # uint32 [Q]
+    n_terms: int             # padded (static) term count
+    n_queries: int           # REAL queries; padding rows match nothing
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
+    """Stack compiled queries over the SAME block batch along the query
+    axis. Every shape axis (Q, T, R) pads to a power of two so the jit
+    cache keys on predicate SHAPE buckets, never predicate values —
+    different tag-sets share one compiled executable.
+
+    Pad semantics: extra terms of a real query are inactive (neutral-TRUE
+    in the AND); whole pad QUERIES get an empty duration window
+    (dur_lo=1 > dur_hi=0) so their mask is all-false and their top-k is
+    all sentinel — dead lanes, not wrong results."""
+    Qn = len(mqs)
+    B = mqs[0].term_keys.shape[0]
+    Q = _pow2(Qn)
+    T = _pow2(max(1, max(mq.n_terms for mq in mqs)))
+    R = _pow2(max(mq.val_ranges.shape[2] for mq in mqs))
+    term_keys = np.full((Q, B, T), -1, dtype=np.int32)
+    val_ranges = np.tile(np.array([1, 0], dtype=np.int32), (Q, B, T, R, 1))
+    term_active = np.zeros((Q, T), dtype=bool)
+    dur_lo = np.ones(Q, dtype=np.uint32)      # pad: empty dur range
+    dur_hi = np.zeros(Q, dtype=np.uint32)
+    win_start = np.zeros(Q, dtype=np.uint32)
+    win_end = np.zeros(Q, dtype=np.uint32)
+    for qi, mq in enumerate(mqs):
+        if mq.term_keys.shape[0] != B:
+            raise ValueError("coalesced queries must share one batch")
+        t_n = mq.term_keys.shape[1]
+        r_n = mq.val_ranges.shape[2]
+        term_keys[qi, :, :t_n] = mq.term_keys
+        val_ranges[qi, :, :t_n, :r_n] = mq.val_ranges
+        term_active[qi, :mq.n_terms] = True
+        dur_lo[qi] = mq.dur_lo
+        dur_hi[qi] = min(mq.dur_hi, 0xFFFFFFFF)
+        win_start[qi] = mq.win_start
+        win_end[qi] = min(mq.win_end, 0xFFFFFFFF)
+    return CoalescedQuery(
+        term_keys=term_keys, val_ranges=val_ranges, term_active=term_active,
+        dur_lo=dur_lo, dur_hi=dur_hi, win_start=win_start, win_end=win_end,
+        n_terms=T, n_queries=Qn)
+
+
 def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
                      entry_valid, page_block, term_keys, val_ranges,
-                     dur_lo, dur_hi, win_start, win_end, *, n_terms: int):
+                     dur_lo, dur_hi, win_start, win_end, *, n_terms: int,
+                     term_active=None):
     """The multi-block predicate: [P,E] bool mask of matching entries.
     Like engine.entry_match_mask but term columns are selected per page
     through the page_block index: key id and ranges become [P]-indexed
     gathers over the SMALL [B,...] tables (cheap — B entries, not 8M).
     Shared by the single-device kernel and the shard_map distributed
-    kernel (each shard evaluates it over its local page slice)."""
+    kernel (each shard evaluates it over its local page slice).
+
+    `term_active` ([T] bool, optional): the query-coalescing pad axis —
+    queries stacked along a query axis share one static n_terms, so a
+    query with fewer real terms marks the excess inactive and they drop
+    out of the AND (neutral-TRUE). This is distinct from the -1 key
+    sentinel, which means 'term exists but this block can never match
+    it' (neutral-FALSE for the block)."""
     safe_block = jnp.maximum(page_block, 0)
     mask = entry_valid & (page_block >= 0)[:, None]
     if n_terms:
@@ -301,7 +373,10 @@ def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
             v = kv_val[..., None]                          # [P,E,C,1]
             valm = ((v >= lo[:, None, None, :]) &
                     (v <= hi[:, None, None, :])).any(-1)   # [P,E,C]
-            return acc & jnp.any(keym & valm, axis=-1)
+            hit = jnp.any(keym & valm, axis=-1)            # [P,E]
+            if term_active is not None:
+                hit = hit | ~term_active[t]
+            return acc & hit
 
         mask = jax.lax.fori_loop(0, n_terms, term_body, mask)
 
@@ -368,15 +443,111 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
         top_scores, pos = jax.lax.top_k(all_scores, k)
         return count, inspected, top_scores, all_idx[pos]
 
-    return jax.shard_map(
+    from tempo_tpu.parallel.mesh import shard_map_compat
+
+    return shard_map_compat(
         shard_fn, mesh=mesh,
         in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 6,
         out_specs=(P(), P(), P(), P()),
         # all_gather+top_k yields identical values on every shard, but the
-        # VMA checker can't infer replication through the gather
-        check_vma=False,
+        # replication checker can't infer it through the gather
+        check=False,
     )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
       page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end)
+
+
+@functools.partial(jax.jit, static_argnames=("n_terms", "top_k"))
+def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
+                          entry_valid, page_block, term_keys, val_ranges,
+                          term_active, dur_lo, dur_hi, win_start, win_end,
+                          *, n_terms: int, top_k: int):
+    """The query-axis variant of multi_scan_kernel: predicate tables are
+    [Q, ...]-stacked and vmap lifts the per-query mask + top-k over the
+    query axis — ONE dispatch serves Q concurrent requests over the same
+    staged pages. The page arrays are read once per term loop regardless
+    of Q (the scan is bandwidth-bound; queries amortize the read).
+    Returns (counts i32 [Q], inspected i32, scores i32 [Q,k],
+    flat idx i32 [Q,k]). `inspected` is query-independent (every query
+    sees the same staged pages), so it stays scalar."""
+    inspected = jnp.sum(entry_valid & (page_block >= 0)[:, None],
+                        dtype=jnp.int32)
+
+    def one_query(tk, vr, ta, dlo, dhi, ws, we):
+        mask = multi_entry_mask(
+            kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
+            page_block, tk, vr, dlo, dhi, ws, we,
+            n_terms=n_terms, term_active=ta)
+        count = jnp.sum(mask, dtype=jnp.int32)
+        scores, idx = masked_topk(mask, entry_start, top_k)
+        return count, scores, idx
+
+    counts, scores, idx = jax.vmap(one_query)(
+        term_keys, val_ranges, term_active, dur_lo, dur_hi,
+        win_start, win_end)
+    return counts, inspected, scores, idx
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "n_terms", "top_k"))
+def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
+                               entry_dur, entry_valid, page_block, term_keys,
+                               val_ranges, term_active, dur_lo, dur_hi,
+                               win_start, win_end, *, n_terms: int,
+                               top_k: int):
+    """Coalesced scan sharded over the mesh's scan axis: the page axis
+    splits across devices, the [Q,...] query tables replicate, and the
+    per-shard per-query top-k candidates all_gather into a per-query
+    global top-k (lax.top_k batches over the leading query axis)."""
+    from jax.sharding import PartitionSpec as P
+    from tempo_tpu.parallel.mesh import SCAN_AXIS
+
+    n_shards = mesh.devices.size
+    E = entry_valid.shape[1]
+    local_flat = kv_key.shape[0] // n_shards * E
+
+    def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
+                 entry_valid, page_block, term_keys, val_ranges,
+                 term_active, dur_lo, dur_hi, win_start, win_end):
+        local_inspected = jnp.sum(
+            entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
+
+        def one_query(tk, vr, ta, dlo, dhi, ws, we):
+            mask = multi_entry_mask(
+                kv_key, kv_val, entry_start, entry_end, entry_dur,
+                entry_valid, page_block, tk, vr, dlo, dhi, ws, we,
+                n_terms=n_terms, term_active=ta)
+            count = jnp.sum(mask, dtype=jnp.int32)
+            scores, idx = masked_topk(mask, entry_start, top_k)
+            return count, scores, idx
+
+        counts, scores, idx = jax.vmap(one_query)(
+            term_keys, val_ranges, term_active, dur_lo, dur_hi,
+            win_start, win_end)
+        shard = jax.lax.axis_index(SCAN_AXIS).astype(jnp.int32)
+        gidx = idx + shard * local_flat
+        counts = jax.lax.psum(counts, SCAN_AXIS)
+        inspected = jax.lax.psum(local_inspected, SCAN_AXIS)
+        all_scores = jax.lax.all_gather(scores, SCAN_AXIS)   # [S, Q, k]
+        all_idx = jax.lax.all_gather(gidx, SCAN_AXIS)
+        Qn = all_scores.shape[1]
+        flat_scores = jnp.swapaxes(all_scores, 0, 1).reshape(Qn, -1)
+        flat_idx = jnp.swapaxes(all_idx, 0, 1).reshape(Qn, -1)
+        k = min(top_k, flat_scores.shape[-1])
+        top_scores, pos = jax.lax.top_k(flat_scores, k)      # batched [Q,k]
+        top_idx = jnp.take_along_axis(flat_idx, pos, axis=-1)
+        return counts, inspected, top_scores, top_idx
+
+    from tempo_tpu.parallel.mesh import shard_map_compat
+
+    return shard_map_compat(
+        shard_fn, mesh=mesh,
+        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 7,
+        out_specs=(P(), P(), P(), P()),
+        # same stance as dist_multi_scan_kernel: the gather+top_k output
+        # is replicated but the replication checker can't infer it
+        check=False,
+    )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
+      page_block, term_keys, val_ranges, term_active, dur_lo, dur_hi,
+      win_start, win_end)
 
 
 class MultiBlockEngine:
@@ -385,9 +556,18 @@ class MultiBlockEngine:
     reference's job fan-out and the Results merge)."""
 
     def __init__(self, top_k: int = DEFAULT_TOP_K, mesh=None):
+        import threading
+
         self.top_k = top_k
         self.mesh = mesh
         self.n_shards = int(mesh.devices.size) if mesh is not None else 1
+        # collective-program dispatch order must be IDENTICAL on every
+        # device: two threads enqueueing shard_map programs concurrently
+        # can interleave per-device queues (dev0 runs A then B, dev1 runs
+        # B then A) and the collectives rendezvous-deadlock — observed as
+        # a zero-CPU wall-clock hang under the concurrent serving path.
+        # Single-device dispatches need no ordering and skip the lock.
+        self._dispatch_lock = threading.Lock()
 
     def stage_host(self, blocks: list[ColumnarPages]) -> HostBatch:
         """Stack a batch on host, padded for this engine's device layout.
@@ -418,9 +598,9 @@ class MultiBlockEngine:
 
     def scan_async(self, batch: BlockBatch, mq: MultiQuery):
         """Dispatch without device→host sync; returns device arrays."""
-        k = self.top_k
-        while k < mq.limit:
-            k *= 2
+        from .engine import resolve_top_k
+
+        k = resolve_top_k(self.top_k, mq.limit)
         d = batch.device
         # params uploaded once per MultiQuery (duck-typed: MultiQuery has
         # the same param attributes CompiledQuery has)
@@ -431,14 +611,34 @@ class MultiBlockEngine:
                 d["entry_dur"], d["entry_valid"], d["page_block"],
                 tk, vr, dlo, dhi, ws, we)
         if self.mesh is not None:
-            return dist_multi_scan_kernel(self.mesh, *args,
-                                          n_terms=mq.n_terms, top_k=k)
+            with self._dispatch_lock:  # see __init__: collective ordering
+                return dist_multi_scan_kernel(self.mesh, *args,
+                                              n_terms=mq.n_terms, top_k=k)
         return multi_scan_kernel(*args, n_terms=mq.n_terms, top_k=k)
 
     def scan(self, batch: BlockBatch, mq: MultiQuery):
         from .engine import fetch_scan_out
 
         return fetch_scan_out(self.scan_async(batch, mq))
+
+    def coalesced_scan_async(self, batch: BlockBatch, cq: CoalescedQuery,
+                             top_k: int):
+        """Fused multi-query dispatch without device→host sync; returns
+        device arrays (counts [Q], inspected, scores [Q,k], idx [Q,k]).
+        `top_k` is the GROUP k — max over the coalesced requests'
+        resolved k, so every member's limit is covered."""
+        d = batch.device
+        args = (d["kv_key"], d["kv_val"], d["entry_start"], d["entry_end"],
+                d["entry_dur"], d["entry_valid"], d["page_block"],
+                jnp.asarray(cq.term_keys), jnp.asarray(cq.val_ranges),
+                jnp.asarray(cq.term_active),
+                jnp.asarray(cq.dur_lo), jnp.asarray(cq.dur_hi),
+                jnp.asarray(cq.win_start), jnp.asarray(cq.win_end))
+        if self.mesh is not None:
+            with self._dispatch_lock:  # see __init__: collective ordering
+                return dist_coalesced_scan_kernel(
+                    self.mesh, *args, n_terms=cq.n_terms, top_k=top_k)
+        return coalesced_scan_kernel(*args, n_terms=cq.n_terms, top_k=top_k)
 
     def results(self, batch: BlockBatch, mq: MultiQuery,
                 scores: np.ndarray, idx: np.ndarray) -> list:

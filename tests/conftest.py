@@ -21,7 +21,13 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax without the option: XLA_FLAGS above still applies, since
+    # the CPU client reads it at backend init (first device use), which
+    # has not happened yet — sitecustomize only IMPORTS jax
+    pass
 
 import pytest  # noqa: E402
 

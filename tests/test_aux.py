@@ -121,6 +121,42 @@ def test_spanmetrics_processor():
     assert "traces_spanmetrics_latency_bucket" in out
 
 
+def test_spanmetrics_non_string_service_label():
+    """ADVICE r5: a non-string service.name (int/bool/double) must label
+    the series with the stringified AnyValue — matching search-data
+    extraction and the native summary feed — not the empty string
+    .string_value yields."""
+    reg = Registry()
+    p = SpanMetricsProcessor(reg)
+    for field, val, want in (("int_value", 123, "123"),
+                             ("bool_value", True, "true"),
+                             ("double_value", 2.5, "2.5")):
+        b = tempopb.ResourceSpans()
+        kv = b.resource.attributes.add()
+        kv.key = "service.name"
+        setattr(kv.value, field, val)
+        sp = b.scope_spans.add().spans.add()
+        sp.trace_id = random_trace_id()
+        sp.name = "op"
+        sp.start_time_unix_nano = 1
+        sp.end_time_unix_nano = 2
+        p.consume(b)
+        assert f'service="{want}"' in reg.expose()
+
+
+def test_service_graph_non_string_service_label():
+    reg = Registry()
+    p = ServiceGraphProcessor(reg)
+    client, server = _client_server_pair(random_trace_id())
+    for half in (client, server):
+        for kv in half.resource.attributes:
+            if kv.key == "service.name":
+                kv.value.int_value = 7  # clears string_value (oneof)
+    p.consume(client)
+    p.consume(server)
+    assert p.requests.value(client="7", server="7") == 1
+
+
 def test_service_graph_pairs_edges():
     reg = Registry()
     p = ServiceGraphProcessor(reg)

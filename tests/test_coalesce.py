@@ -1,0 +1,479 @@
+"""Cross-request query coalescing (search/batcher.QueryCoalescer).
+
+Concurrent SearchRequests whose dispatches land on the same staged
+BlockBatch within the coalescing window stack along a query axis and run
+as ONE fused coalesced_scan_kernel launch. These tests pin down the
+contract:
+
+  - coalesced results are byte-identical to serial execution
+  - the window NEVER waits for peers (timer- or size-triggered flush)
+  - solo searches skip the window entirely (no added latency)
+  - the HBM batch cache evicts LRU under budget pressure, skips pinned
+    (actively scanned) batches, and survives blocklist invalidation
+    mid-flight
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.observability import metrics as obs
+from tempo_tpu.search import ColumnarPages, PageGeometry, SearchResults
+from tempo_tpu.search.batcher import BlockBatcher, QueryCoalescer, ScanJob
+from tempo_tpu.search.data import SearchData
+from tempo_tpu.search.engine import fetch_coalesced_out, resolve_top_k
+from tempo_tpu.search.multiblock import (
+    MultiBlockEngine,
+    compile_multi,
+    stack_queries,
+)
+
+
+def _corpus(n=200, seed=0):
+    """Entries with UNIQUE start seconds: the two top-k implementations
+    only differ in tie-breaks among equal starts (documented as
+    semantically invisible), and byte-identity tests must not depend on
+    that."""
+    rng = random.Random(seed)
+    entries = []
+    for i in range(n):
+        tid = (seed.to_bytes(2, "big") + i.to_bytes(4, "big")).rjust(16, b"\x00")
+        sd = SearchData(trace_id=tid)
+        sd.start_s = 1_600_000_000 + seed * 1_000_000 + i
+        sd.end_s = sd.start_s + 5
+        sd.dur_ms = rng.randint(1, 30_000)
+        sd.root_service = f"svc-{rng.randrange(6)}"
+        sd.root_name = "GET /"
+        sd.kvs = {
+            "service.name": {sd.root_service},
+            "http.status_code": {str(rng.choice([200, 404, 500]))},
+        }
+        entries.append(sd)
+    return entries
+
+
+def _blocks(n=4, entries=200):
+    return [ColumnarPages.build(_corpus(entries, seed=s), PageGeometry(32, 8))
+            for s in range(n)]
+
+
+def _jobs(blocks):
+    jobs = []
+    for i, p in enumerate(blocks):
+        jobs.append(ScanJob(
+            key=(f"blk-{i:03d}", 0, p.n_pages), pages_fn=(lambda p=p: p),
+            header=dict(p.header), n_pages=p.n_pages, n_entries=p.n_entries,
+            geometry=(p.header["entries_per_page"],
+                      p.header["kv_per_entry"])))
+    return jobs
+
+
+def _mk_req(tags=None, **kw):
+    req = tempopb.SearchRequest()
+    for k, v in (tags or {}).items():
+        req.tags[k] = v
+    for k, v in kw.items():
+        setattr(req, k, v)
+    return req
+
+
+def _rand_req(rng):
+    tags = {}
+    if rng.random() < 0.8:
+        tags["service.name"] = f"svc-{rng.randrange(6)}"
+    if rng.random() < 0.6:
+        tags["http.status_code"] = str(rng.choice([200, 404, 500]))
+    kw = {"limit": rng.choice([5, 20, 50])}
+    if rng.random() < 0.4:
+        kw["min_duration_ms"] = rng.choice([100, 5_000])
+    if rng.random() < 0.3:
+        kw["max_duration_ms"] = 25_000
+    return _mk_req(tags, **kw)
+
+
+# ---------------------------------------------------------------------------
+# kernel-level identity
+
+
+def test_coalesced_kernel_matches_serial_dispatches():
+    """The fused kernel's per-query outputs equal N independent
+    multi_scan_kernel dispatches exactly — counts, scores AND indices."""
+    blocks = _blocks(3)
+    eng = MultiBlockEngine(top_k=128)
+    batch = eng.stage(blocks)
+    rng = random.Random(11)
+    reqs = [_rand_req(rng) for _ in range(5)]
+    mqs = [compile_multi(blocks, r) for r in reqs]
+    mqs = [m for m in mqs if m is not None]
+    assert len(mqs) >= 2
+    serial = [eng.scan(batch, mq) for mq in mqs]
+    cq = stack_queries(mqs)
+    k = max(resolve_top_k(eng.top_k, mq.limit) for mq in mqs)
+    counts, inspected, scores, idx = fetch_coalesced_out(
+        eng.coalesced_scan_async(batch, cq, k))
+    for qi, (c, ins, s, i) in enumerate(serial):
+        assert int(counts[qi]) == c
+        assert inspected == ins
+        kq = s.shape[0]
+        np.testing.assert_array_equal(scores[qi][:kq], s)
+        np.testing.assert_array_equal(idx[qi][:kq], i)
+
+
+def test_stack_queries_buckets_shapes():
+    """The jit cache must key on predicate SHAPE, not values: different
+    tag-sets with the same bucketed (Q, T, R) stack to identical array
+    shapes, and odd counts pad to the next power of two."""
+    blocks = _blocks(2, entries=64)
+    a = compile_multi(blocks, _mk_req({"service.name": "svc-1"}, limit=20))
+    b = compile_multi(blocks, _mk_req({"service.name": "svc-2",
+                                       "http.status_code": "500"}, limit=20))
+    c = compile_multi(blocks, _mk_req({"http.status_code": "404"}, limit=20))
+    s1 = stack_queries([a, b])
+    s2 = stack_queries([b, c])
+    assert s1.term_keys.shape == s2.term_keys.shape
+    assert s1.val_ranges.shape == s2.val_ranges.shape
+    s3 = stack_queries([a, b, c])  # Q=3 → pads to 4
+    assert s3.term_keys.shape[0] == 4
+    assert s3.n_queries == 3
+
+
+# ---------------------------------------------------------------------------
+# coalescer mechanics
+
+
+def _engine_and_batch(blocks):
+    eng = MultiBlockEngine(top_k=128)
+    return eng, eng.stage(blocks)
+
+
+def test_window_timeout_flushes_without_peers():
+    """A lone query under (pretend) concurrency is released by the
+    window TIMER — never stuck waiting for a peer that will not come."""
+    blocks = _blocks(2, entries=64)
+    eng, batch = _engine_and_batch(blocks)
+    co = QueryCoalescer(eng, window_s=0.15, max_queries=4,
+                        active_fn=lambda: 2)
+    req = _mk_req({"service.name": "svc-1"}, limit=20)
+    mq = compile_multi(blocks, req)
+    want = eng.scan(batch, mq)
+    t0 = time.perf_counter()
+    fut = co.submit(batch, mq, resolve_top_k(eng.top_k, mq.limit))
+    assert not fut.done(), "window should park the query, not dispatch"
+    out = fut.result(timeout=10)
+    waited = time.perf_counter() - t0
+    assert waited >= 0.10, f"flushed after {waited * 1e3:.1f}ms, window 150ms"
+    count, inspected, scores, idx = out
+    assert (int(count), int(inspected)) == (want[0], want[1])
+    np.testing.assert_array_equal(np.asarray(scores), want[2])
+    np.testing.assert_array_equal(np.asarray(idx), want[3])
+
+
+def test_max_queries_triggers_immediate_fused_flush():
+    """Hitting max_queries flushes NOW — a full group never waits out
+    the window (window set absurdly high to prove it)."""
+    blocks = _blocks(2, entries=64)
+    eng, batch = _engine_and_batch(blocks)
+    co = QueryCoalescer(eng, window_s=60.0, max_queries=2,
+                        active_fn=lambda: 2)
+    r1 = _mk_req({"service.name": "svc-1"}, limit=20)
+    r2 = _mk_req({"service.name": "svc-2"}, limit=20)
+    mq1, mq2 = compile_multi(blocks, r1), compile_multi(blocks, r2)
+    want1, want2 = eng.scan(batch, mq1), eng.scan(batch, mq2)
+    f1 = co.submit(batch, mq1, resolve_top_k(eng.top_k, mq1.limit))
+    f2 = co.submit(batch, mq2, resolve_top_k(eng.top_k, mq2.limit))
+    out1 = f1.result(timeout=30)
+    out2 = f2.result(timeout=30)
+    assert co.fused == 1 and co.queries == 2
+    for out, want in ((out1, want1), (out2, want2)):
+        count, inspected, scores, idx = out
+        assert (int(count), int(inspected)) == (want[0], want[1])
+        kq = want[2].shape[0]
+        np.testing.assert_array_equal(np.asarray(scores)[:kq], want[2])
+        np.testing.assert_array_equal(np.asarray(idx)[:kq], want[3])
+
+
+def test_solo_search_skips_window_entirely():
+    """active_searches <= 1 → no peer can arrive → the window would be
+    pure added latency; submit must dispatch inline."""
+    blocks = _blocks(2, entries=64)
+    eng, batch = _engine_and_batch(blocks)
+    co = QueryCoalescer(eng, window_s=60.0, max_queries=8,
+                        active_fn=lambda: 1)
+    mq = compile_multi(blocks, _mk_req({"service.name": "svc-1"}, limit=20))
+    fut = co.submit(batch, mq, resolve_top_k(eng.top_k, mq.limit))
+    assert fut.done(), "solo submit must flush inline, not wait 60s"
+    assert co.fused == 0 and co.dispatches == 1
+
+
+def test_peers_hint_overrides_process_global_activity():
+    """The per-batch `peers` hint decides the window, not the process-
+    global activity count: a dispatch whose batch no other search can
+    target flushes inline even while unrelated searches are in flight."""
+    blocks = _blocks(2, entries=64)
+    eng, batch = _engine_and_batch(blocks)
+    co = QueryCoalescer(eng, window_s=60.0, max_queries=8,
+                        active_fn=lambda: 99)  # process looks busy
+    mq = compile_multi(blocks, _mk_req({"service.name": "svc-1"}, limit=20))
+    fut = co.submit(batch, mq, resolve_top_k(eng.top_k, mq.limit), peers=1)
+    assert fut.done(), "peers=1 must flush inline despite global activity"
+    assert co.fused == 0 and co.dispatches == 1
+
+
+def test_disjoint_concurrent_searches_skip_window():
+    """Two concurrent searches over DISJOINT batches (the shape of one
+    frontend request's sharded sub-requests) can never fuse, so neither
+    may park in the coalescing window — with a process-global activity
+    hint each group would stall ~window_s for a peer that cannot exist."""
+    blocks = _blocks(4, entries=200)
+    jobs = _jobs(blocks)
+    half_a, half_b = jobs[:2], jobs[2:]
+    req = _mk_req({"service.name": "svc-1"})
+    b = BlockBatcher(max_batch_pages=8, coalesce_window_s=0.6,
+                     coalesce_max_queries=8)
+    # warm: stage + compile both halves outside the clock
+    b.search(list(half_a), req)
+    b.search(list(half_b), req)
+
+    best = float("inf")
+    for _ in range(3):  # min-of-3: tolerate one lost plan-timing race
+        barrier = threading.Barrier(2)
+        done = []
+
+        def one(js):
+            barrier.wait()
+            t0 = time.perf_counter()
+            b.search(list(js), req)
+            done.append(time.perf_counter() - t0)
+
+        ts = [threading.Thread(target=one, args=(h,))
+              for h in (half_a, half_b)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        best = min(best, max(done))
+    assert best < 0.5, (f"disjoint concurrent searches waited out the "
+                        f"coalescing window ({best:.3f}s, window 0.6s)")
+
+
+def test_device_params_cached_after_deferred_window_flush():
+    """The per-predicate device tables must land in the query cache even
+    when the flush runs on the window-timer thread, after submit()
+    returned — a submit-time harvest saw nothing there, so every repeat
+    of the predicate re-uploaded its tables per dispatch."""
+    from tempo_tpu.search.batcher import _predicate_sig
+
+    blocks = _blocks(2, entries=64)
+    jobs = _jobs(blocks)
+    b = BlockBatcher(coalesce_window_s=0.05, coalesce_max_queries=8)
+    b.search(list(jobs), _mk_req({"service.name": "svc-1"}))  # warm/stage
+    req = _mk_req({"service.name": "svc-2"})  # fresh predicate, no dp yet
+    # phantom peer on every staged batch: arms the window, so the solo
+    # flush is timer-deferred instead of inline
+    with b._lock:
+        gkeys = list(b._cache)
+        for k in gkeys:
+            b._interest[k] = b._interest.get(k, 0) + 1
+    try:
+        b.search(list(jobs), req)
+    finally:
+        with b._lock:
+            for k in gkeys:
+                n = b._interest.get(k, 0) - 1
+                if n <= 0:
+                    b._interest.pop(k, None)
+                else:
+                    b._interest[k] = n
+    sig = _predicate_sig(req)
+    cached_dps = [c.query_cache[sig].get("device_params")
+                  for c in b._cache.values() if sig in c.query_cache]
+    assert cached_dps and all(dp is not None for dp in cached_dps), (
+        "deferred-flush dispatch did not cache its uploaded query tables")
+
+
+# ---------------------------------------------------------------------------
+# serving-path property test
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_concurrent_coalesced_results_identical_to_serial(seed):
+    """N concurrent searches with distinct random predicates over shared
+    blocks return byte-identical SearchResponses to the same N run
+    serially — with real fusion happening (asserted via the coalesced
+    dispatch counter)."""
+    rng = random.Random(seed)
+    blocks = _blocks(3, entries=150)
+    jobs = _jobs(blocks)
+    N = 6
+    reqs = [_rand_req(rng) for _ in range(N)]
+
+    serial_b = BlockBatcher(coalesce_max_queries=1)  # coalescing OFF
+    serial = [serial_b.search(list(jobs), r).response().SerializeToString()
+              for r in reqs]
+
+    co_b = BlockBatcher(coalesce_window_s=0.05, coalesce_max_queries=N)
+    # warm staging + compile so every worker reaches the window together
+    co_b.search(list(jobs), reqs[0])
+    q0 = obs.coalesced_queries.value()
+
+    out = [None] * N
+    barrier = threading.Barrier(N)
+
+    def one(i):
+        barrier.wait()
+        out[i] = co_b.search(
+            list(jobs), reqs[i]).response().SerializeToString()
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(N):
+        assert out[i] == serial[i], f"query {i} diverged under coalescing"
+    assert obs.coalesced_queries.value() > q0, "no fusion happened"
+
+
+def test_coalesced_against_scan_engine_oracle():
+    """Acceptance cross-check: coalesced serving results equal the same
+    queries run serially through the single-block ScanEngine.scan."""
+    from tempo_tpu.search.engine import ScanEngine
+    from tempo_tpu.search.pipeline import compile_query
+
+    rng = random.Random(7)
+    blocks = _blocks(3, entries=150)
+    jobs = _jobs(blocks)
+    reqs = [_rand_req(rng) for _ in range(4)]
+
+    def oracle(req):
+        results = SearchResults.for_request(req)
+        eng = ScanEngine()
+        for pages in blocks:
+            cq = compile_query(pages.key_dict, pages.val_dict, req)
+            if cq is None:
+                continue
+            from tempo_tpu.search.engine import stage
+
+            sp = stage(pages)
+            _c, _i, scores, idx = eng.scan_staged(sp, cq)
+            for m in eng.results(sp, cq, scores, idx):
+                results.add(m)
+        return results
+
+    co_b = BlockBatcher(coalesce_window_s=0.05, coalesce_max_queries=4)
+    co_b.search(list(jobs), reqs[0])  # warm
+    barrier = threading.Barrier(len(reqs))
+    got = [None] * len(reqs)
+
+    def one(i):
+        barrier.wait()
+        got[i] = co_b.search(list(jobs), reqs[i])
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, req in enumerate(reqs):
+        want = sorted(
+            m.SerializeToString() for m in oracle(req).response().traces)
+        have = sorted(m.SerializeToString() for m in got[i].response().traces)
+        assert have == want, f"query {i} diverged from ScanEngine oracle"
+
+
+# ---------------------------------------------------------------------------
+# HBM cache: eviction, pinning, invalidation
+
+
+def test_hbm_eviction_under_budget_pressure():
+    """A cache budget below the working set LRU-evicts staged batches
+    (metric: batch_cache_events{result=evict}) and queries stay correct
+    across the eviction churn."""
+    blocks = _blocks(6, entries=200)
+    jobs = _jobs(blocks)
+    b = BlockBatcher(max_batch_pages=8, coalesce_max_queries=1)
+    req = _mk_req({"service.name": "svc-1"}, limit=100)
+    want = b.search(list(jobs), req).response().SerializeToString()
+    groups = b.plan(jobs)
+    assert len(groups) > 1, "budget test needs multiple groups"
+
+    # shrink the budget below one staged group: every group staged past
+    # the first must evict a predecessor
+    ev0 = obs.batch_cache_events.value(result="evict")
+    b.cache_bytes = 1
+    got = b.search(list(jobs), req).response().SerializeToString()
+    assert got == want
+    assert obs.batch_cache_events.value(result="evict") > ev0
+    assert len(b._cache) <= 1  # budget enforced after pins released
+
+
+def test_eviction_skips_pinned_batches():
+    blocks = _blocks(2, entries=64)
+    jobs = _jobs(blocks)
+    b = BlockBatcher(coalesce_max_queries=1)
+    b.search(list(jobs), _mk_req({"service.name": "svc-1"}, limit=20))
+    assert len(b._cache) == 1
+    entry = next(iter(b._cache.values()))
+    entry.pins = 1
+    b.cache_bytes = 1
+    with b._lock:
+        b._evict_hbm_locked()
+    assert len(b._cache) == 1, "pinned batch must survive eviction"
+    entry.pins = 0
+    # pins released → next search enforces the budget again
+    b.search(list(jobs), _mk_req({"service.name": "svc-2"}, limit=20))
+    assert b._cache_total <= max(b.cache_bytes, entry.nbytes)
+
+
+def test_invalidation_mid_flight_is_safe():
+    """A blocklist change (batcher.invalidate) racing an in-flight
+    search must neither crash nor corrupt results; afterwards the dead
+    batches are gone from both cache tiers."""
+    blocks = _blocks(4, entries=150)
+    jobs = _jobs(blocks)
+    b = BlockBatcher(max_batch_pages=8, coalesce_window_s=0.01,
+                     coalesce_max_queries=4)
+    req = _mk_req({"service.name": "svc-1"}, limit=100)
+    want = b.search(list(jobs), req).response().SerializeToString()
+
+    stop = threading.Event()
+    errors = []
+
+    def invalidator():
+        while not stop.is_set():
+            b.invalidate(set())          # nothing is live: drop everything
+            time.sleep(0.001)
+
+    inv = threading.Thread(target=invalidator)
+    inv.start()
+    try:
+        for _ in range(5):
+            got = b.search(list(jobs), req).response().SerializeToString()
+            if got != want:
+                errors.append("diverged")
+    finally:
+        stop.set()
+        inv.join()
+    assert not errors
+    b.invalidate(set())
+    assert not b._cache and not b._host_cache
+
+
+def test_debug_stats_exposes_coalesce_ratio():
+    blocks = _blocks(2, entries=64)
+    eng, batch = _engine_and_batch(blocks)
+    co = QueryCoalescer(eng, window_s=60.0, max_queries=2,
+                        active_fn=lambda: 2)
+    mqs = [compile_multi(blocks, _mk_req({"service.name": f"svc-{i}"},
+                                         limit=20)) for i in (1, 2)]
+    futs = [co.submit(batch, mq, 128) for mq in mqs]
+    for f in futs:
+        f.result(timeout=30)
+    s = co.stats()
+    assert s["queries"] == 2 and s["fused_dispatches"] == 1
+    assert s["ratio"] == 2.0

@@ -65,10 +65,26 @@ def test_differential_regroup_extract():
 
 def test_differential_generator_series():
     """Summary-row feed produces byte-identical exposition output to the
-    proto-walk feed (spanmetrics + service graphs)."""
+    proto-walk feed (spanmetrics + service graphs) — including for
+    non-string service.name values, which both feeds must label with the
+    stringified AnyValue ('true', '123'), never an empty string."""
     batches = []
     for i in range(30):
         batches.extend(make_trace(random_trace_id(), seed=i).batches)
+    for field, val in (("int_value", 123), ("bool_value", True),
+                       ("double_value", 2.5)):
+        b = tempopb.ResourceSpans()
+        kv = b.resource.attributes.add()
+        kv.key = "service.name"
+        setattr(kv.value, field, val)
+        sp = b.scope_spans.add().spans.add()
+        sp.trace_id = random_trace_id()
+        sp.span_id = b"\x05" * 8
+        sp.name = "op-nonstr"
+        sp.kind = tempopb.Span.SPAN_KIND_SERVER
+        sp.start_time_unix_nano = 10
+        sp.end_time_unix_nano = 20
+        batches.append(b)
     g1, g2 = MetricsGenerator(), MetricsGenerator()
     g1.push_spans("t", batches)
     blobs = [b.SerializeToString() for b in batches]
@@ -231,8 +247,13 @@ def test_differential_rich_corpus():
             sp.name = rng.choice(["op-ü", "", "x" * 300])
             sp.kind = rng.randint(0, 5)
             sp.start_time_unix_nano = rng.randint(0, 2**62)
-            sp.end_time_unix_nano = (sp.start_time_unix_nano
-                                     + rng.randint(0, 10**12))
+            # end < start included deliberately (clock skew is valid
+            # client input): duration must clamp to max(0, end-start)
+            # identically on the native and Python paths — the Python
+            # walk used to raise struct.error, the native walker used to
+            # saturate the unsigned underflow to 0xFFFFFFFF
+            sp.end_time_unix_nano = max(
+                0, sp.start_time_unix_nano + rng.randint(-10**12, 10**12))
             sp.status.code = rng.randint(0, 2)
             sp.status.message = "boom"
             a = sp.attributes.add()

@@ -80,6 +80,41 @@ def test_extract_search_data_matches_proto_oracle():
             assert search_data_matches(sd, req) == matches(tr, req), (seed, req)
 
 
+def test_end_before_start_duration_clamps_to_zero():
+    """ADVICE r5 medium: a span with end < start (clock skew — valid
+    client input) must yield dur_ms 0 on every extraction path, not a
+    negative duration that struct.error-crashes encode_search_data
+    (which surfaced as HTTP 500 on push, permanently failing on retry).
+    The shared convention is max(0, end - start), matching the native
+    walker's clamp."""
+    from tempo_tpu.modules.distributor import Distributor
+    from tempo_tpu.search.data import extract_search_data
+    from tempo_tpu.utils.ids import random_trace_id
+
+    tid = random_trace_id()
+    b = tempopb.ResourceSpans()
+    kv = b.resource.attributes.add()
+    kv.key = "service.name"
+    kv.value.string_value = "skewed"
+    sp = b.scope_spans.add().spans.add()
+    sp.trace_id = tid
+    sp.name = "op"
+    sp.start_time_unix_nano = 5_000_000_000
+    sp.end_time_unix_nano = 2_000_000_000  # ends "before" it starts
+
+    trace = tempopb.Trace()
+    trace.batches.append(b)
+    sd = extract_search_data(tid, trace)
+    assert sd.dur_ms == 0
+    encode_search_data(sd)  # used to raise struct.error
+
+    by_trace, n, sds = Distributor._regroup_extract([b], 1 << 20)
+    assert n == 1
+    (sd2,) = sds.values()
+    assert sd2.dur_ms == 0
+    encode_search_data(sd2)  # used to raise struct.error
+
+
 def test_substring_value_ids():
     vd = ["alpha", "beta", "alphabet", "gamma"]
     assert substring_value_ids(vd, "alpha").tolist() == [0, 2]
