@@ -20,9 +20,13 @@ ORCHESTRATOR that never touches jax itself. Each bench config runs as
 `python bench.py --phase NAME` in its own subprocess with its own
 deadline, checkpointing its result to BENCH_CKPT_DIR as it completes;
 the final line assembles whatever finished, with explicit per-phase
-errors for anything that wedged. A ≤60 s preflight device probe (3
-attempts) runs first; if the accelerator tunnel is unhealthy the bench
-degrades to a clearly-marked CPU run instead of recording silence.
+errors for anything that wedged. A preflight device probe runs first
+(BENCH_PREFLIGHT_ATTEMPTS, default 1 — one wedge already means the
+tunnel is gone; BENCH_TIMEOUT_PROBE seconds per attempt); if the
+accelerator tunnel is unhealthy the bench degrades to a clearly-marked
+CPU run instead of recording silence, with the scale phases re-run at
+reduced size (BENCH_DEGRADED_SCALE=0 skips them instead) so even a
+degraded round records a full trajectory point.
 A hung phase loses only itself — never the completed phases.
 """
 
@@ -802,12 +806,25 @@ def bench_scale_large(n_blocks, entries_per_block, iters):
         }
 
 
-def bench_high_cardinality(n_entries, cardinality, iters):
-    """Config 4: substring search against a huge value dictionary — the
-    dictionary prefilter (native memmem scan) + device scan."""
+def bench_high_cardinality(n_entries, cardinality, iters,
+                           probe_min_vals=None):
+    """Config 4: substring search against a huge value dictionary. Both
+    prefilter executions are measured over the same corpus and query:
+
+      - HOST path (`dict_prefilter_ms`): native memmem / numpy scan →
+        id ranges → range-compare scan kernel (the pre-PR4 pipeline);
+      - DEVICE path (`device_probe_ms`): packed dictionary staged to
+        HBM, rolling-window probe kernel → hit mask → mask-lookup scan
+        kernel (search/dict_probe.py) — the near-data-processing move.
+
+    Matches must be identical between the paths (asserted), and the
+    scan-rate comparison re-validates the mask-lookup-vs-range-compare
+    tradeoff (the ids_to_ranges gather measurement) every round instead
+    of assuming it."""
     import numpy as np
 
     from tempo_tpu import tempopb
+    from tempo_tpu.search import dict_probe
     from tempo_tpu.search.engine import ScanEngine, stage
     from tempo_tpu.search.pipeline import compile_query, pack_val_dict
 
@@ -832,11 +849,49 @@ def bench_high_cardinality(n_entries, cardinality, iters):
         "BENCH_CARDINALITY must exceed ~1240 so the session prefix exists"
     )
     eng = ScanEngine(top_k=128)
-    sp = stage(pages)
-    count, _, _, _ = eng.scan_staged(sp, cq)
+    sp = stage(pages, probe_min_vals=0)  # host-path staging: no dict
+    count, _, h_scores, h_idx = eng.scan_staged(sp, cq)
     rate = _timed_rate(lambda: eng.scan_staged_async(sp, cq),
                        lambda out: int(out[0]), n_entries, iters)
-    return rate, int(count), compile_ms
+
+    # --- device-resident probe over the same staged pages ---
+    probe = {"device_probe_ms": None, "device_probe_rate": None,
+             "device_probe_stage_ms": None}
+    mv = (dict_probe.DEVICE_PROBE_MIN_VALS if probe_min_vals is None
+          else probe_min_vals)
+    if 0 < mv <= len(pages.val_dict):
+        t0 = time.perf_counter()
+        sp.staged_dict = dict_probe.stage_val_dict(pages.val_dict,
+                                                   cache_on=pages)
+        for a in sp.staged_dict.device.values():
+            a.block_until_ready()
+        probe["device_probe_stage_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+
+        def dev_compile():
+            # fresh compile each call (no cache_on): probe dispatch +
+            # the [T]-bool any_hits prune sync — the replacement for the
+            # host prefilter's dict_prefilter_ms
+            return compile_query(pages.key_dict, pages.val_dict, req,
+                                 staged_dict=sp.staged_dict)
+
+        cq_dev = dev_compile()  # warm: compiles the probe kernel
+        t0 = time.perf_counter()
+        n_probe = max(3, min(iters, 10))
+        for _ in range(n_probe):
+            dev_compile()
+        probe["device_probe_ms"] = round(
+            (time.perf_counter() - t0) / n_probe * 1e3, 1)
+
+        d_count, _, d_scores, d_idx = eng.scan_staged(sp, cq_dev)
+        assert int(d_count) == int(count), (
+            f"device probe diverged: {int(d_count)} != {int(count)}")
+        assert np.array_equal(np.asarray(d_scores), np.asarray(h_scores)), \
+            "device-probe top-k scores diverged from host path"
+        probe["device_probe_rate"] = round(_timed_rate(
+            lambda: eng.scan_staged_async(sp, cq_dev),
+            lambda out: int(out[0]), n_entries, iters))
+    return rate, int(count), compile_ms, probe
 
 
 # ---------------------------------------------------------------------------
@@ -901,28 +956,39 @@ def phase_serving():
             "scan_dispatches": dispatches}
 
 
+def _probe_min_vals_env():
+    """BENCH_PROBE_MIN_VALS: override the device-probe threshold for the
+    high-cardinality phases (0 disables; unset = library default)."""
+    raw = os.environ.get("BENCH_PROBE_MIN_VALS")
+    return int(raw) if raw not in (None, "") else None
+
+
 def phase_high_cardinality():
     n_entries = int(os.environ.get("BENCH_ENTRIES", 1_000_000))
     iters = int(os.environ.get("BENCH_ITERS", 20))
     cardinality = int(os.environ.get("BENCH_CARDINALITY", 1_000_000))
-    rate, matches, compile_ms = bench_high_cardinality(
-        n_entries, cardinality, iters)
+    rate, matches, compile_ms, probe = bench_high_cardinality(
+        n_entries, cardinality, iters, probe_min_vals=_probe_min_vals_env())
     return {"distinct_values": cardinality, "traces_per_sec": round(rate),
-            "dict_prefilter_ms": round(compile_ms, 1), "matches": matches}
+            "dict_prefilter_ms": round(compile_ms, 1), "matches": matches,
+            **probe}
 
 
 def phase_high_cardinality_full():
     # BASELINE config 4 names 10M distinct values — run the prefilter at
-    # full cardinality too (device side is unchanged: ranges, not values)
+    # full cardinality too (the device probe scales with dictionary
+    # BYTES, so full cardinality is exactly where it must be measured)
     n_entries = int(os.environ.get("BENCH_ENTRIES", 1_000_000))
     iters = int(os.environ.get("BENCH_ITERS", 20))
     cardinality = int(os.environ.get("BENCH_CARDINALITY_FULL", 10_000_000))
     if not cardinality:
         return None
-    rate, matches, compile_ms = bench_high_cardinality(
-        n_entries, cardinality, max(3, iters // 4))
+    rate, matches, compile_ms, probe = bench_high_cardinality(
+        n_entries, cardinality, max(3, iters // 4),
+        probe_min_vals=_probe_min_vals_env())
     return {"distinct_values": cardinality, "traces_per_sec": round(rate),
-            "dict_prefilter_ms": round(compile_ms, 1), "matches": matches}
+            "dict_prefilter_ms": round(compile_ms, 1), "matches": matches,
+            **probe}
 
 
 def phase_coalesced_serving():
@@ -1150,6 +1216,21 @@ def _assemble(results: dict) -> dict:
             },
         },
     }
+    # the dictionary-probe trajectory (host prefilter vs device probe)
+    # surfaces at the TOP level of detail so round-over-round consumers
+    # track the optimization without digging through per-phase configs
+    probe_ms = {}
+    for ph in ("high_cardinality", "high_cardinality_full"):
+        r = results.get(ph)
+        if isinstance(r, dict) and not _failed(r):
+            probe_ms[ph] = {
+                "distinct_values": r.get("distinct_values"),
+                "dict_prefilter_ms": r.get("dict_prefilter_ms"),
+                "device_probe_ms": r.get("device_probe_ms"),
+                "device_probe_stage_ms": r.get("device_probe_stage_ms"),
+            }
+    if probe_ms:
+        doc["detail"]["dict_probe"] = probe_ms
     if not ok:
         err = (single or {}).get(
             "error", "headline phase 'single' did not run")
@@ -1243,11 +1324,17 @@ def orchestrate() -> int:
             return emit_and_exit(2)
         phase_order = [p for p in phase_order if p in sel]
 
-    # --- preflight: short probe, 3 attempts, then explicit CPU fallback ---
+    # --- preflight: short probe, then explicit CPU fallback ---
+    # BENCH_PREFLIGHT_ATTEMPTS (default 1): r05 burned 3x60s on a wedged
+    # device tunnel before falling back — one wedge is already a strong
+    # signal, so fail over to CPU after the FIRST by default; operators
+    # chasing a flaky (not dead) tunnel can raise it. The per-attempt
+    # deadline is BENCH_TIMEOUT_PROBE (seconds).
     probe_deadline = float(os.environ.get(
         "BENCH_TIMEOUT_PROBE", PHASE_TIMEOUTS["probe"]))
+    n_attempts = max(1, int(os.environ.get("BENCH_PREFLIGHT_ATTEMPTS", 1)))
     attempts = []
-    for i in range(3):
+    for i in range(n_attempts):
         if time_left() < 10:
             break
         r = _run_child("probe", min(probe_deadline, time_left()),
@@ -1256,8 +1343,8 @@ def orchestrate() -> int:
             results["probe"] = r
             break
         attempts.append(r["error"])
-        print(f"bench: preflight attempt {i + 1} failed: {r['error']}",
-              file=sys.stderr, flush=True)
+        print(f"bench: preflight attempt {i + 1}/{n_attempts} failed: "
+              f"{r['error']}", file=sys.stderr, flush=True)
     if "probe" not in results:
         if os.environ.get("BENCH_CPU_FALLBACK", "1") not in ("0", ""):
             extra_env["JAX_PLATFORMS"] = "cpu"
@@ -1277,13 +1364,33 @@ def orchestrate() -> int:
                                           "(preflight probe failed)"}
             return emit_and_exit(3)
 
+    # CPU fallback: the scale phases at full size stage multi-GB corpora
+    # sized for a 16 GB-HBM chip — run them at REDUCED size instead of
+    # skipping, so a degraded round still records a trajectory point for
+    # every phase (r05 lost both scale series to one wedged tunnel).
+    # BENCH_DEGRADED_SCALE=0 restores the old skip behavior.
+    degraded_scale_env: dict = {}
     if results.get("degraded"):
-        # CPU fallback: the scale phases stage multi-GB corpora through
-        # host RAM sized for a 16 GB-HBM chip — skip rather than thrash
-        for p in ("scale_10k", "scale_large_blocks"):
-            if p in phase_order:
-                phase_order.remove(p)
-                results[p] = {"error": "skipped: degraded cpu-fallback run"}
+        if os.environ.get("BENCH_DEGRADED_SCALE", "1") in ("0", ""):
+            for p in ("scale_10k", "scale_large_blocks"):
+                if p in phase_order:
+                    phase_order.remove(p)
+                    results[p] = {"error":
+                                  "skipped: degraded cpu-fallback run"}
+        else:
+            degraded_scale_env = {
+                "scale_10k": {
+                    "BENCH_SCALE_BLOCKS": os.environ.get(
+                        "BENCH_DEGRADED_SCALE_BLOCKS", "1000"),
+                    "BENCH_SCALE_ENTRIES": "128",
+                },
+                "scale_large_blocks": {
+                    "BENCH_LARGE_BLOCKS": os.environ.get(
+                        "BENCH_DEGRADED_LARGE_BLOCKS", "24"),
+                    "BENCH_LARGE_ENTRIES": "16384",
+                    "BENCH_LARGE_BATCH_PAGES": "2048",
+                },
+            }
 
     for name in phase_order:
         ck = os.path.join(ckpt_dir, f"{name}.json")
@@ -1315,10 +1422,19 @@ def orchestrate() -> int:
         reason = ("global bench budget truncation — phase may be healthy"
                   if remaining < deadline
                   else "phase deadline — device tunnel likely wedged")
+        phase_env = extra_env
+        if name in degraded_scale_env:
+            phase_env = dict(extra_env)
+            phase_env.update(degraded_scale_env[name])
         t0 = time.perf_counter()
         results[name] = _run_child(name, min(deadline, remaining),
-                                   ckpt_dir, extra_env,
+                                   ckpt_dir, phase_env,
                                    timeout_reason=reason)
+        if name in degraded_scale_env and not _failed(results[name]) \
+                and isinstance(results[name], dict):
+            # mark the trajectory point: these numbers came from the
+            # reduced degraded-mode corpus, not the full-size config
+            results[name]["degraded_reduced_size"] = True
         status = "FAILED" if _failed(results[name]) else "ok"
         print(f"bench: phase {name} {status} "
               f"({time.perf_counter() - t0:.1f}s)",

@@ -83,6 +83,11 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "search_coalesce_window_s", 0.003),
         search_coalesce_max_queries=storage.get(
             "search_coalesce_max_queries", 8),
+        # device-resident dictionary probe threshold
+        # (docs/search-dict-probe.md); absent/null = library default
+        # (50k distinct values), <= 0 = host-only probing
+        search_device_probe_min_vals=storage.get(
+            "search_device_probe_min_vals"),
         # restartable host state (header snapshot + persistent XLA
         # compile cache); absent = auto (<wal_dir>/host-state), "" = off
         host_state_dir=storage.get("host_state_dir"),

@@ -78,6 +78,15 @@ class TempoDBConfig:
     # disables coalescing entirely
     search_coalesce_window_s: float = 0.003
     search_coalesce_max_queries: int = 8
+    # device-resident dictionary probe: value dictionaries at/above this
+    # many distinct values stage their packed bytes to HBM and run the
+    # substring prefilter ON DEVICE (search/dict_probe.py) instead of
+    # the host memmem walk — at 10M distinct values the host walk is
+    # ~312 ms per fresh tag-set vs single-digit-ms on chip (BENCH_r05).
+    # Mirrors pipeline.NATIVE_SCAN_THRESHOLD (the same scale at which
+    # the HOST scan moves to the native memmem path); <= 0 keeps every
+    # probe on the exact host path. None = the dict_probe default (50k).
+    search_device_probe_min_vals: int | None = None
     # stage + compile-warm hot batches in the background after each poll
     # so the first query pays neither (off by default: polls in tests and
     # write-only processes must not spin up device work)
@@ -151,6 +160,7 @@ class TempoDB:
             pipeline_depth=self.cfg.search_pipeline_depth,
             coalesce_window_s=self.cfg.search_coalesce_window_s,
             coalesce_max_queries=self.cfg.search_coalesce_max_queries,
+            device_probe_min_vals=self.cfg.search_device_probe_min_vals,
         )
         self._prewarm_stop = None  # Event cancelling the running prewarm
         self._prewarm_thread = None
@@ -404,7 +414,8 @@ class TempoDB:
             if bsb is None:
                 bsb = BackendSearchBlock(
                     self.backend, meta,
-                    header=self._headers.get(meta.block_id))
+                    header=self._headers.get(meta.block_id),
+                    probe_min_vals=self.cfg.search_device_probe_min_vals)
                 self._search_blocks[meta.block_id] = bsb
                 # bounded HBM cache: evict oldest staged blocks
                 while len(self._search_blocks) > self.cfg.search_cache_blocks:

@@ -71,18 +71,18 @@ class DistributedScanEngine:
     @functools.partial(jax.jit, static_argnames=("self", "n_terms", "top_k"))
     def _dist_kernel(self, kv_key, kv_val, entry_start, entry_end,
                      entry_dur, entry_valid, term_keys, val_ranges,
-                     dur_lo, dur_hi, win_start, win_end,
+                     dur_lo, dur_hi, win_start, win_end, val_hits=None,
                      *, n_terms: int, top_k: int):
         E = entry_valid.shape[1]
         local_flat = kv_key.shape[0] // self.n_shards * E
 
         def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                      entry_valid, term_keys, val_ranges,
-                     dur_lo, dur_hi, win_start, win_end):
+                     dur_lo, dur_hi, win_start, win_end, val_hits):
             mask = entry_match_mask(
                 kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, term_keys, val_ranges, dur_lo, dur_hi,
-                win_start, win_end, n_terms=n_terms,
+                win_start, win_end, n_terms=n_terms, val_hits=val_hits,
             )
             local_count = jnp.sum(mask, dtype=jnp.int32)
             local_inspected = jnp.sum(entry_valid, dtype=jnp.int32)
@@ -103,15 +103,18 @@ class DistributedScanEngine:
 
         return shard_map_compat(
             shard_fn, mesh=self.mesh,
+            # val_hits (the device-probe hit mask) replicates like the
+            # other predicate tables; a None leaf makes its spec a no-op
             in_specs=(P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS),
                       P(SCAN_AXIS), P(SCAN_AXIS),
-                      P(), P(), P(), P(), P(), P()),
+                      P(), P(), P(), P(), P(), P(), P()),
             out_specs=(P(), P(), P(), P()),
             # all_gather+top_k yields identical values on every shard, but
             # the replication checker can't infer it through the gather
             check=False,
         )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
-          term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end)
+          term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end,
+          val_hits)
 
     # ---- public API ----
 
@@ -123,12 +126,19 @@ class DistributedScanEngine:
         from tempo_tpu.search.engine import ScanEngine
 
         tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(cq)
-        out = self._dist_kernel(
-            d["kv_key"], d["kv_val"],
-            d["entry_start"], d["entry_end"], d["entry_dur"], d["entry_valid"],
-            tk, vr, dlo, dhi, ws, we,
-            n_terms=cq.n_terms, top_k=k,
-        )
+        from tempo_tpu.parallel.mesh import dispatch_lock
+
+        # process-wide collective-ordering lock (parallel.mesh): shared
+        # with the multiblock engine and the dictionary probe, so no two
+        # threads can interleave per-device shard_map queues
+        with dispatch_lock:
+            out = self._dist_kernel(
+                d["kv_key"], d["kv_val"],
+                d["entry_start"], d["entry_end"], d["entry_dur"],
+                d["entry_valid"],
+                tk, vr, dlo, dhi, ws, we, getattr(cq, "val_hits", None),
+                n_terms=cq.n_terms, top_k=k,
+            )
         from tempo_tpu.search.engine import fetch_scan_out
 
         return fetch_scan_out(out)

@@ -9,10 +9,22 @@ and DCN across slices, replacing the goroutine fan-out + Results channel.
 
 from __future__ import annotations
 
+import threading
+
 import jax
 from jax.sharding import Mesh
 
 SCAN_AXIS = "shards"
+
+# Collective-program dispatch order must be IDENTICAL on every device:
+# two threads enqueueing shard_map programs concurrently can interleave
+# the per-device queues (dev0 runs A then B, dev1 runs B then A) and the
+# collectives rendezvous-deadlock — observed as a multi-minute zero-CPU
+# hang. ONE process-wide lock covers every dispatch site (scan kernels,
+# the dictionary probe, any future collective): per-engine locks are not
+# enough, because the probe dispatches during query compilation while a
+# different engine thread may be mid-scan on the same devices.
+dispatch_lock = threading.Lock()
 
 
 def scan_mesh_axes() -> tuple[str, ...]:

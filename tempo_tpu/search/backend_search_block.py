@@ -73,11 +73,18 @@ def write_search_block(backend: RawBackend, meta: BlockMeta,
 
 class BackendSearchBlock:
     def __init__(self, backend: RawBackend, meta: BlockMeta,
-                 header: dict | None = None):
+                 header: dict | None = None,
+                 probe_min_vals: int | None = None):
         """header: an already-fetched rollup (TempoDB's header cache /
-        restart snapshot) — saves one backend GET per container open."""
+        restart snapshot) — saves one backend GET per container open.
+
+        probe_min_vals: the device-probe staging threshold
+        (cfg.search_device_probe_min_vals) — the single-block path must
+        honor the same knob as the batcher, including <= 0 = host-only
+        probing; None = the dict_probe library default."""
         self.backend = backend
         self.meta = meta
+        self.probe_min_vals = probe_min_vals
         self._header: dict | None = header
         self._pages: ColumnarPages | None = None
         self._staged: StagedPages | None = None
@@ -113,7 +120,7 @@ class BackendSearchBlock:
         with self._lock:
             if self._staged is not None:
                 return self._staged
-        sp = stage(self.pages())
+        sp = stage(self.pages(), probe_min_vals=self.probe_min_vals)
         with self._lock:
             if self._staged is None:
                 self._staged = sp
@@ -137,8 +144,12 @@ class BackendSearchBlock:
         packed = (sp.pages.packed_val_dict()
                   if req.tags and native.available()
                   and len(sp.pages.val_dict) >= NATIVE_SCAN_THRESHOLD else None)
+        # staged_dict present → the substring probe runs on device
+        # (staging already applied the size threshold); the host memmem
+        # path above stays the exact fallback for oversized needles
         cq = compile_query(sp.pages.key_dict, sp.pages.val_dict, req,
-                           packed_vals=packed, cache_on=sp.pages)
+                           packed_vals=packed, cache_on=sp.pages,
+                           staged_dict=sp.staged_dict)
         if cq is None:  # dictionary prefilter pruned the block
             results.metrics.skipped_blocks += 1
             return results

@@ -342,8 +342,11 @@ class BlockBatcher:
                  pipeline_depth: int = 2,
                  io_workers: int = 8,
                  coalesce_window_s: float = 0.003,
-                 coalesce_max_queries: int = 8):
-        self.engine = MultiBlockEngine(top_k=top_k, mesh=mesh)
+                 coalesce_max_queries: int = 8,
+                 device_probe_min_vals: int | None = None):
+        self.engine = MultiBlockEngine(
+            top_k=top_k, mesh=mesh,
+            device_probe_min_vals=device_probe_min_vals)
         self.max_batch_pages = max_batch_pages
         self.cache_bytes = cache_bytes
         if host_cache_bytes is None:
@@ -508,7 +511,9 @@ class BlockBatcher:
             else:
                 obs.batch_cache_events.inc(result="host_hit")
             batch = self.engine.place(host)  # H2D only on the hot path
-            nbytes = int(sum(int(a.nbytes) for a in batch.device.values()))
+            # batch.nbytes covers the stacked page arrays AND any staged
+            # probe dictionaries — both live in HBM under this budget
+            nbytes = int(batch.nbytes)
             entry = _CachedBatch(batch=batch, nbytes=nbytes, jobs=list(group))
             with self._lock:
                 obs.batch_cache_events.inc(result="miss")
@@ -757,6 +762,8 @@ class BlockBatcher:
                 "all_skip": False,
                 "term_keys": mq.term_keys,
                 "val_ranges": mq.val_ranges,
+                "val_hits": mq.val_hits,
+                "block_group": mq.block_group,
                 "n_terms": mq.n_terms,
                 "dur_lo": mq.dur_lo, "dur_hi": mq.dur_hi,
                 "win_start": mq.win_start, "win_end": mq.win_end,
@@ -886,7 +893,9 @@ class BlockBatcher:
                     term_keys=pre["term_keys"], val_ranges=pre["val_ranges"],
                     dur_lo=pre["dur_lo"], dur_hi=pre["dur_hi"],
                     win_start=pre["win_start"], win_end=pre["win_end"],
-                    limit=req.limit or 20, n_terms=pre["n_terms"])
+                    limit=req.limit or 20, n_terms=pre["n_terms"],
+                    val_hits=pre.get("val_hits"),
+                    block_group=pre.get("block_group"))
                 dp = pre.get("device_params")
                 if dp is not None:
                     # repeated predicates reuse the H2D-uploaded query

@@ -31,6 +31,8 @@ the VPU; dense + reduce is the idiomatic mapping.
 
 from __future__ import annotations
 
+import bisect
+import hashlib
 import json
 import struct
 from dataclasses import dataclass, field
@@ -102,9 +104,16 @@ class ColumnarPages:
             val_dict=self.val_dict, n_entries=hdr["n_entries"],
             header=hdr, **kw,
         )
-        cached = getattr(self, "_packed_vals", None)
-        if cached is not None:  # dictionaries are shared; so is the packing
-            out._packed_vals = cached
+        # dictionaries are shared with the parent — so are every
+        # dictionary-derived product: the native-scan packing, the
+        # compile-cache fingerprint, and the device-probe packing
+        # (re-deriving any of them per page-range job re-pays an
+        # O(dict) walk the parent already did)
+        for attr in ("_packed_vals", "_dict_fingerprint",
+                     "_dict_section_sha", "_device_dict_packed"):
+            cached = getattr(self, attr, None)
+            if cached is not None:
+                setattr(out, attr, cached)
         return out
 
     def packed_val_dict(self) -> tuple:
@@ -122,9 +131,12 @@ class ColumnarPages:
         the tag-values endpoints' columnar extraction (one idiom, used by
         both the querier's blocklist sweep and the ingester's
         recently-completed sweep)."""
-        if tag not in self.key_dict:
+        # one binary search on the sorted key dictionary (matching
+        # pipeline._probe_tags) — `in` + `.index()` were each a linear
+        # walk, paid per tag-values call per block
+        kid = bisect.bisect_left(self.key_dict, tag)
+        if kid >= len(self.key_dict) or self.key_dict[kid] != tag:
             return
-        kid = self.key_dict.index(tag)
         for v in np.unique(self.kv_val[self.kv_key == kid]).tolist():
             if v >= 0:
                 yield self.val_dict[v]
@@ -296,6 +308,18 @@ class ColumnarPages:
             body += blob
         hdr = dict(self.header)
         hdr["sections"] = offsets
+        # content digest of the ENCODED dictionary sections, recorded at
+        # build so open-time readers get the query-compile cache
+        # fingerprint for free (pipeline._dict_fingerprint — the sha256
+        # walk over 1M decoded strings costs ~100ms per first cache
+        # touch; this is one C-speed pass over bytes already in hand)
+        digest = _dict_sections_sha(sections["key_dict"],
+                                    sections["val_dict"])
+        hdr["dict_sha"] = digest.hex()
+        # the writer's own instance adopts the section digest too, so a
+        # built-then-serialized container shares its compile-cache
+        # fingerprint with every reader that decodes it
+        self._dict_section_sha = digest
         hdr_b = json.dumps(hdr).encode()
         return _HDR.pack(_MAGIC, _VERSION, len(hdr_b)) + hdr_b + bytes(body)
 
@@ -326,13 +350,35 @@ class ColumnarPages:
                                 offset=base + off)
             kw[name] = arr.reshape(shapes[name])
         off, length = sections["key_dict"]
-        key_dict = _unpack_strs(buf[base + off: base + off + length])
+        key_sec = buf[base + off: base + off + length]
+        key_dict = _unpack_strs(key_sec)
         off, length = sections["val_dict"]
-        val_dict = _unpack_strs(buf[base + off: base + off + length])
-        return cls(
+        val_sec = buf[base + off: base + off + length]
+        val_dict = _unpack_strs(val_sec)
+        out = cls(
             geometry=PageGeometry(E, C), key_dict=key_dict, val_dict=val_dict,
             n_entries=hdr["n_entries"], header=hdr, **kw,
         )
+        # dictionary fingerprint for the query-compile cache: recorded
+        # in the header at build (v2 containers); older containers
+        # re-hash the encoded section bytes here — still one C-speed
+        # pass over contiguous bytes, never the python string walk
+        ds = hdr.get("dict_sha")
+        out._dict_section_sha = (bytes.fromhex(ds) if ds
+                                 else _dict_sections_sha(key_sec, val_sec))
+        return out
+
+
+def _dict_sections_sha(key_sec: bytes, val_sec: bytes) -> bytes:
+    """Content digest of the encoded dictionary sections. The encoding
+    (_pack_strs) is injective and the separator keeps (key, val) section
+    boundaries unambiguous, so equal digests mean equal dictionaries —
+    the same contract pipeline._dict_fingerprint's string walk gives."""
+    h = hashlib.sha256()
+    h.update(key_sec)
+    h.update(b"\x01")
+    h.update(val_sec)
+    return h.digest()
 
 
 def _pack_strs(strs: list) -> bytes:

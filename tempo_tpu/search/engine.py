@@ -46,6 +46,11 @@ class StagedPages:
     device: dict          # name -> jnp array, page axis padded to bucket
     n_pages: int          # real (unpadded) page count
     pages: ColumnarPages  # host container (dicts, trace ids, header)
+    # dict_probe.DeviceDict when the value dictionary cleared the
+    # device-probe threshold at staging time — query compilation then
+    # runs the substring probe ON DEVICE (pipeline._device_probe_tags)
+    # instead of the host memmem walk
+    staged_dict: object = None
 
 
 DEVICE_ARRAYS = ("kv_key", "kv_val", "entry_start", "entry_end",
@@ -75,32 +80,69 @@ def pad_page_axis(pages: ColumnarPages, target: int) -> dict:
     return out
 
 
-def stage(pages: ColumnarPages, page_bucket: int | None = None) -> StagedPages:
+def stage(pages: ColumnarPages, page_bucket: int | None = None,
+          probe_min_vals: int | None = None) -> StagedPages:
     """Move a block's columns to device, padding the page axis to a
-    power-of-two bucket so jit compiles once per bucket."""
+    power-of-two bucket so jit compiles once per bucket.
+
+    `probe_min_vals`: value-dictionary size at which the packed
+    dictionary bytes stage alongside the columns for the on-device
+    substring probe (None = dict_probe.DEVICE_PROBE_MIN_VALS; <= 0
+    disables). The threshold is applied HERE, at staging time — query
+    compilation just uses whatever was staged."""
     B = page_bucket or _bucket(pages.n_pages)
     dev = {k: jnp.asarray(v) for k, v in pad_page_axis(pages, B).items()}
-    return StagedPages(device=dev, n_pages=pages.n_pages, pages=pages)
+    sd = stage_block_dict(pages, probe_min_vals)
+    return StagedPages(device=dev, n_pages=pages.n_pages, pages=pages,
+                       staged_dict=sd)
+
+
+def stage_block_dict(pages: ColumnarPages, probe_min_vals: int | None):
+    """DeviceDict for one block's value dictionary when it clears the
+    device-probe threshold, else None. Shared by the single-block stage
+    and the batched stack_host staging."""
+    from . import dict_probe
+    from .pipeline import _dict_fingerprint
+
+    mv = (dict_probe.DEVICE_PROBE_MIN_VALS if probe_min_vals is None
+          else probe_min_vals)
+    if mv <= 0 or len(pages.val_dict) < mv:
+        return None
+    fp = _dict_fingerprint(pages, pages.key_dict, pages.val_dict)
+    return dict_probe.stage_val_dict(pages.val_dict, fingerprint=fp,
+                                     cache_on=pages)
 
 
 def entry_match_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
                      entry_valid, term_keys, val_ranges,
-                     dur_lo, dur_hi, win_start, win_end, *, n_terms: int):
+                     dur_lo, dur_hi, win_start, win_end, *, n_terms: int,
+                     val_hits=None):
     """The core predicate: [P,E] bool mask of matching entries. Shared by
     the single-device kernel and the shard_map distributed kernel (each
     shard evaluates it over its local page slice).
 
     Value membership is an OR over inclusive [lo,hi] id ranges — pure
-    broadcast compares, no gather (pipeline.ids_to_ranges explains why)."""
+    broadcast compares, no gather (pipeline.ids_to_ranges explains why).
+
+    `val_hits` (bool [T, v_pad], device): the on-device dictionary
+    probe's per-term value hit mask (search/dict_probe.py). When present
+    the membership test is a mask LOOKUP — one [P,E,C] gather per term —
+    and the range tables are the never-match padding; the probe result
+    never crossed the host boundary. (bench.py's high-cardinality phases
+    re-validate the lookup-vs-range tradeoff each round.)"""
     mask = entry_valid
     if n_terms:
         def term_body(t, acc):
             k = term_keys[t]
             keym = kv_key == k                       # [P,E,C]
-            lo = val_ranges[t, :, 0]                 # [R]
-            hi = val_ranges[t, :, 1]
-            v = kv_val[..., None]                    # [P,E,C,1]
-            valm = ((v >= lo) & (v <= hi)).any(-1)   # [P,E,C], fused over R
+            if val_hits is not None:
+                safe_v = jnp.maximum(kv_val, 0).astype(jnp.int32)
+                valm = val_hits[t][safe_v] & (kv_val >= 0)  # [P,E,C]
+            else:
+                lo = val_ranges[t, :, 0]                 # [R]
+                hi = val_ranges[t, :, 1]
+                v = kv_val[..., None]                    # [P,E,C,1]
+                valm = ((v >= lo) & (v <= hi)).any(-1)   # [P,E,C], fused over R
             hit = jnp.any(keym & valm, axis=-1)      # [P,E] lane reduction
             return acc & hit
 
@@ -197,13 +239,16 @@ def masked_topk(mask, entry_start, top_k: int):
 @functools.partial(jax.jit, static_argnames=("n_terms", "top_k"))
 def scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, term_keys, val_ranges, dur_lo, dur_hi,
-                win_start, win_end, *, n_terms: int, top_k: int):
+                win_start, win_end, val_hits=None,
+                *, n_terms: int, top_k: int):
     """Returns (match_count i32, inspected i32, topk_scores i32 [k],
-    topk_flat_idx i32 [k]) — flat index = page * E + entry."""
+    topk_flat_idx i32 [k]) — flat index = page * E + entry. `val_hits`
+    (None or bool [T, v_pad]) selects the device-probe membership path;
+    jit treats None as pytree structure, so each variant compiles once."""
     mask = entry_match_mask(
         kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
         term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end,
-        n_terms=n_terms,
+        n_terms=n_terms, val_hits=val_hits,
     )
     count = jnp.sum(mask, dtype=jnp.int32)
     inspected = jnp.sum(entry_valid, dtype=jnp.int32)
@@ -248,7 +293,7 @@ class ScanEngine:
         return scan_kernel(
             d["kv_key"], d["kv_val"],
             d["entry_start"], d["entry_end"], d["entry_dur"], d["entry_valid"],
-            tk, vr, dlo, dhi, ws, we,
+            tk, vr, dlo, dhi, ws, we, getattr(cq, "val_hits", None),
             n_terms=cq.n_terms, top_k=self._resolve_top_k(cq),
         )
 

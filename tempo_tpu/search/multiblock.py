@@ -19,7 +19,7 @@ sequence axis, sharded over the mesh.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +27,7 @@ import numpy as np
 
 from tempo_tpu import tempopb
 from .columnar import ColumnarPages
+from .dict_probe import _pow2
 from .engine import DEFAULT_TOP_K, masked_topk
 from .pipeline import (
     CompiledQuery,
@@ -46,10 +47,25 @@ class BlockBatch:
     page_block: np.ndarray          # int32 [P_total] block index per page
     blocks: list                    # list[ColumnarPages]
     page_offset: list               # start page of each block in the stack
+    # dict fingerprint -> dict_probe.DeviceDict for every DISTINCT value
+    # dictionary that cleared the device-probe threshold at staging time:
+    # query compilation then runs the substring probe on device against
+    # these instead of the host memmem walk. Staged with the batch,
+    # accounted in `nbytes`, re-uploaded with it after an HBM eviction.
+    staged_dicts: dict = field(default_factory=dict)
 
     @property
     def n_pages(self) -> int:
         return int(self.page_block.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        """HBM pinned by this batch: the stacked page arrays PLUS the
+        staged dictionary arrays — the cache budget must see both or a
+        high-cardinality tenant's dictionaries become unaccounted
+        residents."""
+        return (int(sum(int(a.nbytes) for a in self.device.values()))
+                + int(sum(d.nbytes for d in self.staged_dicts.values())))
 
 
 @dataclass
@@ -62,6 +78,11 @@ class HostBatch:
     page_block: np.ndarray
     blocks: list                    # list[ColumnarPages]
     page_offset: list
+    # dict fingerprint -> dict_probe.PackedDeviceDict: the host half of
+    # the device-probe staging, packed once per distinct dictionary and
+    # kept with the batch so an HBM-evicted batch re-uploads with one
+    # H2D copy, not a re-pack of 10M strings
+    packed_dicts: dict = field(default_factory=dict)
 
     @property
     def nbytes(self) -> int:
@@ -70,12 +91,52 @@ class HostBatch:
         # budget against real RAM, not just the cat arrays, or a 32 GB
         # budget pins ~64 GB (code-review r4)
         return int(sum(a.nbytes for a in self.cat.values())
-                   + sum(b.nbytes for b in self.blocks))
+                   + sum(b.nbytes for b in self.blocks)
+                   + sum(d.nbytes for d in self.packed_dicts.values()))
+
+
+def _pack_batch_dicts(blocks: list[ColumnarPages],
+                      probe_min_vals: int | None,
+                      n_shards: int = 1) -> dict:
+    """fp -> PackedDeviceDict for every DISTINCT value dictionary above
+    the device-probe threshold (None = dict_probe default; <= 0
+    disables). Packing memoizes on the immutable block container, so an
+    evicted batch restacked from the same blocks packs nothing."""
+    from . import dict_probe
+    from .pipeline import _dict_fingerprint
+
+    mv = (dict_probe.DEVICE_PROBE_MIN_VALS if probe_min_vals is None
+          else probe_min_vals)
+    out: dict = {}
+    if mv <= 0:
+        return out
+    S = max(1, int(n_shards))
+    for b in blocks:
+        if len(b.val_dict) < mv:
+            continue
+        fp = _dict_fingerprint(b, b.key_dict, b.val_dict)
+        if fp in out:
+            continue
+        hit = getattr(b, "_device_dict_packed", None)
+        if hit is not None and hit.n_shards == S:
+            out[fp] = hit
+        else:
+            out[fp] = b._device_dict_packed = dict_probe.pack_device_dict(
+                b.val_dict, n_shards=S, fingerprint=fp)
+    return out
 
 
 def stack_host(blocks: list[ColumnarPages],
-               pad_to: int | None = None) -> HostBatch:
-    """Concatenate uniform-geometry blocks along the page axis on host."""
+               pad_to: int | None = None,
+               probe_min_vals: int | None = 0,
+               n_shards: int = 1) -> HostBatch:
+    """Concatenate uniform-geometry blocks along the page axis on host.
+
+    `probe_min_vals` routes value dictionaries at/above that size into
+    the packed device-probe staging (`HostBatch.packed_dicts`); the
+    default 0 keeps direct/test callers dictionary-free — the serving
+    path (MultiBlockEngine.stage_host) passes its configured
+    threshold."""
     E = blocks[0].geometry.entries_per_page
     C = max(b.geometry.kv_per_entry for b in blocks)
     # narrow the kv columns to the smallest dtype the dictionaries allow:
@@ -128,11 +189,19 @@ def stack_host(blocks: list[ColumnarPages],
 
     cat["page_block"] = page_block
     return HostBatch(cat=cat, page_block=page_block, blocks=blocks,
-                     page_offset=page_offset)
+                     page_offset=page_offset,
+                     packed_dicts=_pack_batch_dicts(blocks, probe_min_vals,
+                                                    n_shards=n_shards))
 
 
-def place_batch(host: HostBatch, sharding=None) -> BlockBatch:
-    """H2D: put a host-stacked batch on device(s)."""
+def place_batch(host: HostBatch, sharding=None, mesh=None) -> BlockBatch:
+    """H2D: put a host-stacked batch on device(s). `mesh` shards staged
+    probe dictionaries along the value axis when they were packed for
+    that mesh size (engine.stage_host packs with the engine's shard
+    count); any mismatch places them unsharded — still correct, the
+    probe just runs on one device."""
+    from . import dict_probe
+
     cat = host.cat
     if sharding is not None:
         if jax.process_count() > 1:
@@ -149,16 +218,26 @@ def place_batch(host: HostBatch, sharding=None) -> BlockBatch:
             dev = {k: jax.device_put(v, sharding) for k, v in cat.items()}
     else:
         dev = {k: jnp.asarray(v) for k, v in cat.items()}
+    staged = {}
+    for fp, pd in host.packed_dicts.items():
+        dict_mesh = (mesh if mesh is not None and pd.n_shards > 1
+                     and pd.n_shards == int(mesh.devices.size) else None)
+        staged[fp] = dict_probe.place_device_dict(pd, mesh=dict_mesh)
     return BlockBatch(device=dev, page_block=host.page_block,
-                      blocks=host.blocks, page_offset=host.page_offset)
+                      blocks=host.blocks, page_offset=host.page_offset,
+                      staged_dicts=staged)
 
 
 def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None,
-                 sharding=None) -> BlockBatch:
+                 sharding=None, probe_min_vals: int | None = 0,
+                 mesh=None, n_shards: int = 1) -> BlockBatch:
     """Concatenate uniform-geometry blocks along the page axis and place
     on device. With `sharding` (a NamedSharding over the page axis) the
     stacked arrays shard across the mesh instead of the default device."""
-    return place_batch(stack_host(blocks, pad_to=pad_to), sharding=sharding)
+    return place_batch(stack_host(blocks, pad_to=pad_to,
+                                  probe_min_vals=probe_min_vals,
+                                  n_shards=n_shards),
+                       sharding=sharding, mesh=mesh)
 
 
 @dataclass
@@ -172,6 +251,13 @@ class MultiQuery:
     win_end: int
     limit: int
     n_terms: int
+    # device-probe product (search/dict_probe.py): bool [G, T, Vmax]
+    # per-dictionary-GROUP value hit masks on device, and the int32 [B]
+    # block -> group row map (-1 = this block compiled through the host
+    # range path; its val_ranges row applies). The probe output feeds
+    # the kernel directly — no id-set ever crossed the host boundary.
+    val_hits: object = None
+    block_group: np.ndarray | None = None
 
 
 def _dict_groups(blocks: list[ColumnarPages], cache_on=None):
@@ -219,6 +305,10 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
     # tenant usually cycles a handful of dictionary contents (same
     # services/status codes everywhere)
     fp_of, rep_idx, rows_of = _dict_groups(blocks, cache_on=cache_on)
+    # dictionaries the batch staged for the on-device probe (BlockBatch
+    # .staged_dicts, keyed by the same fingerprints): their substring
+    # scan runs on device and yields a hit mask instead of host ranges
+    staged_dicts = getattr(cache_on, "staged_dicts", None) or {}
     compiled: dict[bytes, CompiledQuery | None] = {}
     for fp, i in rep_idx.items():
         b = blocks[i]
@@ -229,6 +319,7 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
                          else None),
             cache_on=b,  # blocks are immutable: repeated tag-sets skip
                          # the O(dict) probe (VERDICT r2 #1 host cost)
+            staged_dict=staged_dicts.get(fp),
         )
     per_block: list[CompiledQuery | None] = [
         None if (skip is not None and skip[i]) else compiled[fp_of[i]]
@@ -265,12 +356,36 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
         val_ranges[rows[:, None, None],
                    np.arange(t_n)[:, None],
                    np.arange(r_n)] = cq.val_ranges[:t_n, :r_n]
+    # device-probed dictionary groups: stack their [T, v_pad] hit masks
+    # along a GROUP axis (pad T and V to the assembled/maximum widths —
+    # device ops, nothing syncs to host) and map each block row to its
+    # group; -1 rows keep the host range path, so a batch can mix
+    # device-probed high-cardinality blocks with host-compiled small ones
+    probe_fps = [fp for fp, cq in compiled.items()
+                 if cq is not None and cq.n_terms
+                 and cq.val_hits is not None]
+    val_hits = block_group = None
+    if probe_fps:
+        Tp = max(1, T)
+        Vm = max(int(compiled[fp].val_hits.shape[1]) for fp in probe_fps)
+        padded = []
+        for fp in probe_fps:
+            h = compiled[fp].val_hits
+            h = jnp.pad(h, ((0, Tp - h.shape[0]), (0, Vm - h.shape[1])))
+            padded.append(h)
+        val_hits = jnp.stack(padded)                       # [G, Tp, Vm]
+        block_group = np.full(B, -1, dtype=np.int32)
+        for g, fp in enumerate(probe_fps):
+            block_group[np.asarray(rows_of[fp], dtype=np.int64)] = g
+
     if skip is not None and any(skip):
         # header-pruned rows back to the unmatchable sentinel (their
         # dict group was assembled wholesale above)
         sk = np.asarray(skip, dtype=bool)
         term_keys[sk] = -1
         val_ranges[sk] = np.array([1, 0], dtype=np.int32)
+        if block_group is not None:
+            block_group[sk] = -1  # term_keys -1 + range path: can't match
 
     any_cq = next(cq for cq in per_block if cq is not None)
     return MultiQuery(
@@ -278,6 +393,7 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
         dur_lo=any_cq.dur_lo, dur_hi=any_cq.dur_hi,
         win_start=any_cq.win_start, win_end=any_cq.win_end,
         limit=any_cq.limit, n_terms=T,
+        val_hits=val_hits, block_group=block_group,
     )
 
 
@@ -296,13 +412,12 @@ class CoalescedQuery:
     win_end: np.ndarray      # uint32 [Q]
     n_terms: int             # padded (static) term count
     n_queries: int           # REAL queries; padding rows match nothing
-
-
-def _pow2(n: int) -> int:
-    b = 1
-    while b < n:
-        b *= 2
-    return b
+    # device-probe product stacked along the query axis: bool
+    # [Q, G, T, Vmax] hit masks + int32 [Q, B] block->group rows (a
+    # member query that compiled through the host path gets an all -1
+    # row — its range tables apply). None when no member probed.
+    val_hits: object = None
+    block_group: np.ndarray | None = None
 
 
 def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
@@ -339,16 +454,39 @@ def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
         dur_hi[qi] = min(mq.dur_hi, 0xFFFFFFFF)
         win_start[qi] = mq.win_start
         win_end[qi] = min(mq.win_end, 0xFFFFFFFF)
+    # device-probe members: stack their [G, T, V] group masks along the
+    # query axis (device pads/stack — the probe product stays on chip
+    # through the fused dispatch); host-path and pad queries carry all-
+    # false masks behind an all -1 block_group row, so they never read it
+    val_hits = block_group = None
+    if any(mq.val_hits is not None for mq in mqs):
+        probed = [mq for mq in mqs if mq.val_hits is not None]
+        Gm = max(int(mq.val_hits.shape[0]) for mq in probed)
+        Vm = max(int(mq.val_hits.shape[2]) for mq in probed)
+        zero = jnp.zeros((Gm, T, Vm), dtype=jnp.bool_)
+        block_group = np.full((Q, B), -1, dtype=np.int32)
+        rows = []
+        for qi in range(Q):
+            mq = mqs[qi] if qi < Qn else None
+            if mq is None or mq.val_hits is None:
+                rows.append(zero)
+                continue
+            h = mq.val_hits
+            rows.append(jnp.pad(h, ((0, Gm - h.shape[0]),
+                                    (0, T - h.shape[1]),
+                                    (0, Vm - h.shape[2]))))
+            block_group[qi] = mq.block_group
+        val_hits = jnp.stack(rows)                  # [Q, Gm, T, Vm]
     return CoalescedQuery(
         term_keys=term_keys, val_ranges=val_ranges, term_active=term_active,
         dur_lo=dur_lo, dur_hi=dur_hi, win_start=win_start, win_end=win_end,
-        n_terms=T, n_queries=Qn)
+        n_terms=T, n_queries=Qn, val_hits=val_hits, block_group=block_group)
 
 
 def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
                      entry_valid, page_block, term_keys, val_ranges,
                      dur_lo, dur_hi, win_start, win_end, *, n_terms: int,
-                     term_active=None):
+                     term_active=None, val_hits=None, block_group=None):
     """The multi-block predicate: [P,E] bool mask of matching entries.
     Like engine.entry_match_mask but term columns are selected per page
     through the page_block index: key id and ranges become [P]-indexed
@@ -361,10 +499,21 @@ def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
     query with fewer real terms marks the excess inactive and they drop
     out of the AND (neutral-TRUE). This is distinct from the -1 key
     sentinel, which means 'term exists but this block can never match
-    it' (neutral-FALSE for the block)."""
+    it' (neutral-FALSE for the block).
+
+    `val_hits` (bool [G, T, Vmax]) + `block_group` (int32 [P-indexable
+    [B]]): the device-probe product — pages of a block mapped to group
+    g >= 0 test value membership with a hit-mask lookup on that group's
+    row (one gather per term); group -1 pages keep the range compares,
+    so device-probed and host-compiled blocks mix in one batch."""
     safe_block = jnp.maximum(page_block, 0)
     mask = entry_valid & (page_block >= 0)[:, None]
     if n_terms:
+        if val_hits is not None:
+            bg_page = block_group[safe_block]              # [P]
+            probe_page = (bg_page >= 0)[:, None, None]     # [P,1,1]
+            safe_g = jnp.maximum(bg_page, 0)
+
         def term_body(t, acc):
             k_per_page = term_keys[safe_block, t]          # [P]
             keym = kv_key == k_per_page[:, None, None]     # [P,E,C]
@@ -373,6 +522,11 @@ def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
             v = kv_val[..., None]                          # [P,E,C,1]
             valm = ((v >= lo[:, None, None, :]) &
                     (v <= hi[:, None, None, :])).any(-1)   # [P,E,C]
+            if val_hits is not None:
+                safe_v = jnp.maximum(kv_val, 0).astype(jnp.int32)
+                mh = (val_hits[safe_g[:, None, None], t, safe_v]
+                      & (kv_val >= 0))                     # [P,E,C]
+                valm = jnp.where(probe_page, mh, valm)
             hit = jnp.any(keym & valm, axis=-1)            # [P,E]
             if term_active is not None:
                 hit = hit | ~term_active[t]
@@ -391,11 +545,13 @@ def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
 def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                       entry_valid, page_block, term_keys, val_ranges,
                       dur_lo, dur_hi, win_start, win_end,
+                      val_hits=None, block_group=None,
                       *, n_terms: int, top_k: int):
     mask = multi_entry_mask(
         kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
         page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
-        win_end, n_terms=n_terms,
+        win_end, n_terms=n_terms, val_hits=val_hits,
+        block_group=block_group,
     )
     count = jnp.sum(mask, dtype=jnp.int32)
     inspected = jnp.sum(entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
@@ -407,6 +563,7 @@ def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
 def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                            entry_dur, entry_valid, page_block, term_keys,
                            val_ranges, dur_lo, dur_hi, win_start, win_end,
+                           val_hits=None, block_group=None,
                            *, n_terms: int, top_k: int):
     """Multi-block scan sharded over the mesh's scan axis: the stacked
     page axis (blocks × pages — the corpus 'sequence' axis, SURVEY.md §5)
@@ -423,11 +580,13 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
 
     def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                  entry_valid, page_block, term_keys, val_ranges,
-                 dur_lo, dur_hi, win_start, win_end):
+                 dur_lo, dur_hi, win_start, win_end, val_hits,
+                 block_group):
         mask = multi_entry_mask(
             kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
             page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
-            win_end, n_terms=n_terms,
+            win_end, n_terms=n_terms, val_hits=val_hits,
+            block_group=block_group,
         )
         local_count = jnp.sum(mask, dtype=jnp.int32)
         local_inspected = jnp.sum(
@@ -447,19 +606,23 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
 
     return shard_map_compat(
         shard_fn, mesh=mesh,
-        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 6,
+        # the probe hit mask + block->group map replicate like the other
+        # predicate tables (a None leaf makes its spec a no-op)
+        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 8,
         out_specs=(P(), P(), P(), P()),
         # all_gather+top_k yields identical values on every shard, but the
         # replication checker can't infer it through the gather
         check=False,
     )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
-      page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end)
+      page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
+      win_end, val_hits, block_group)
 
 
 @functools.partial(jax.jit, static_argnames=("n_terms", "top_k"))
 def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                           entry_valid, page_block, term_keys, val_ranges,
                           term_active, dur_lo, dur_hi, win_start, win_end,
+                          val_hits=None, block_group=None,
                           *, n_terms: int, top_k: int):
     """The query-axis variant of multi_scan_kernel: predicate tables are
     [Q, ...]-stacked and vmap lifts the per-query mask + top-k over the
@@ -472,18 +635,20 @@ def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
     inspected = jnp.sum(entry_valid & (page_block >= 0)[:, None],
                         dtype=jnp.int32)
 
-    def one_query(tk, vr, ta, dlo, dhi, ws, we):
+    def one_query(tk, vr, ta, dlo, dhi, ws, we, vh, bg):
         mask = multi_entry_mask(
             kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
             page_block, tk, vr, dlo, dhi, ws, we,
-            n_terms=n_terms, term_active=ta)
+            n_terms=n_terms, term_active=ta, val_hits=vh, block_group=bg)
         count = jnp.sum(mask, dtype=jnp.int32)
         scores, idx = masked_topk(mask, entry_start, top_k)
         return count, scores, idx
 
+    # val_hits/block_group are [Q,...]-stacked like the other predicate
+    # tables (None vmaps as an empty pytree — no leaves to map)
     counts, scores, idx = jax.vmap(one_query)(
         term_keys, val_ranges, term_active, dur_lo, dur_hi,
-        win_start, win_end)
+        win_start, win_end, val_hits, block_group)
     return counts, inspected, scores, idx
 
 
@@ -491,7 +656,8 @@ def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
 def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                                entry_dur, entry_valid, page_block, term_keys,
                                val_ranges, term_active, dur_lo, dur_hi,
-                               win_start, win_end, *, n_terms: int,
+                               win_start, win_end, val_hits=None,
+                               block_group=None, *, n_terms: int,
                                top_k: int):
     """Coalesced scan sharded over the mesh's scan axis: the page axis
     splits across devices, the [Q,...] query tables replicate, and the
@@ -506,22 +672,24 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
 
     def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                  entry_valid, page_block, term_keys, val_ranges,
-                 term_active, dur_lo, dur_hi, win_start, win_end):
+                 term_active, dur_lo, dur_hi, win_start, win_end,
+                 val_hits, block_group):
         local_inspected = jnp.sum(
             entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
 
-        def one_query(tk, vr, ta, dlo, dhi, ws, we):
+        def one_query(tk, vr, ta, dlo, dhi, ws, we, vh, bg):
             mask = multi_entry_mask(
                 kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, page_block, tk, vr, dlo, dhi, ws, we,
-                n_terms=n_terms, term_active=ta)
+                n_terms=n_terms, term_active=ta, val_hits=vh,
+                block_group=bg)
             count = jnp.sum(mask, dtype=jnp.int32)
             scores, idx = masked_topk(mask, entry_start, top_k)
             return count, scores, idx
 
         counts, scores, idx = jax.vmap(one_query)(
             term_keys, val_ranges, term_active, dur_lo, dur_hi,
-            win_start, win_end)
+            win_start, win_end, val_hits, block_group)
         shard = jax.lax.axis_index(SCAN_AXIS).astype(jnp.int32)
         gidx = idx + shard * local_flat
         counts = jax.lax.psum(counts, SCAN_AXIS)
@@ -540,14 +708,14 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
 
     return shard_map_compat(
         shard_fn, mesh=mesh,
-        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 7,
+        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 9,
         out_specs=(P(), P(), P(), P()),
         # same stance as dist_multi_scan_kernel: the gather+top_k output
         # is replicated but the replication checker can't infer it
         check=False,
     )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
       page_block, term_keys, val_ranges, term_active, dur_lo, dur_hi,
-      win_start, win_end)
+      win_start, win_end, val_hits, block_group)
 
 
 class MultiBlockEngine:
@@ -555,19 +723,24 @@ class MultiBlockEngine:
     the batch shards across devices (the serving-path union of the
     reference's job fan-out and the Results merge)."""
 
-    def __init__(self, top_k: int = DEFAULT_TOP_K, mesh=None):
-        import threading
+    def __init__(self, top_k: int = DEFAULT_TOP_K, mesh=None,
+                 device_probe_min_vals: int | None = None):
+        from tempo_tpu.parallel import mesh as mesh_mod
 
         self.top_k = top_k
         self.mesh = mesh
         self.n_shards = int(mesh.devices.size) if mesh is not None else 1
-        # collective-program dispatch order must be IDENTICAL on every
-        # device: two threads enqueueing shard_map programs concurrently
-        # can interleave per-device queues (dev0 runs A then B, dev1 runs
-        # B then A) and the collectives rendezvous-deadlock — observed as
-        # a zero-CPU wall-clock hang under the concurrent serving path.
+        # value-dictionary size at which staging also packs+uploads the
+        # dictionary bytes for the on-device substring probe (None =
+        # dict_probe.DEVICE_PROBE_MIN_VALS; <= 0 keeps every probe on
+        # the exact host path). Config: search_device_probe_min_vals.
+        self.device_probe_min_vals = device_probe_min_vals
+        # the PROCESS-WIDE collective-ordering lock (parallel.mesh
+        # .dispatch_lock — see its comment): shared with every other
+        # collective dispatch site, including the dictionary probe that
+        # fires during query compilation on another thread.
         # Single-device dispatches need no ordering and skip the lock.
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = mesh_mod.dispatch_lock
 
     def stage_host(self, blocks: list[ColumnarPages]) -> HostBatch:
         """Stack a batch on host, padded for this engine's device layout.
@@ -580,7 +753,9 @@ class MultiBlockEngine:
         pad_to = max(1, self.n_shards)
         while pad_to < total:
             pad_to *= 2
-        return stack_host(blocks, pad_to=pad_to)
+        return stack_host(blocks, pad_to=pad_to,
+                          probe_min_vals=self.device_probe_min_vals,
+                          n_shards=self.n_shards)
 
     def place(self, host: HostBatch) -> BlockBatch:
         """H2D of a host-stacked batch (sharded over the mesh if any)."""
@@ -590,7 +765,7 @@ class MultiBlockEngine:
         from tempo_tpu.parallel.mesh import SCAN_AXIS
 
         spec = NamedSharding(self.mesh, P(SCAN_AXIS))
-        return place_batch(host, sharding=spec)
+        return place_batch(host, sharding=spec, mesh=self.mesh)
 
     def stage(self, blocks: list[ColumnarPages]) -> BlockBatch:
         """Stack + place a batch on device(s)."""
@@ -607,9 +782,11 @@ class MultiBlockEngine:
         from .engine import ScanEngine
 
         tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(mq)
+        vh = getattr(mq, "val_hits", None)
+        bg = None if vh is None else jnp.asarray(mq.block_group)
         args = (d["kv_key"], d["kv_val"], d["entry_start"], d["entry_end"],
                 d["entry_dur"], d["entry_valid"], d["page_block"],
-                tk, vr, dlo, dhi, ws, we)
+                tk, vr, dlo, dhi, ws, we, vh, bg)
         if self.mesh is not None:
             with self._dispatch_lock:  # see __init__: collective ordering
                 return dist_multi_scan_kernel(self.mesh, *args,
@@ -628,12 +805,14 @@ class MultiBlockEngine:
         `top_k` is the GROUP k — max over the coalesced requests'
         resolved k, so every member's limit is covered."""
         d = batch.device
+        vh = getattr(cq, "val_hits", None)
+        bg = None if vh is None else jnp.asarray(cq.block_group)
         args = (d["kv_key"], d["kv_val"], d["entry_start"], d["entry_end"],
                 d["entry_dur"], d["entry_valid"], d["page_block"],
                 jnp.asarray(cq.term_keys), jnp.asarray(cq.val_ranges),
                 jnp.asarray(cq.term_active),
                 jnp.asarray(cq.dur_lo), jnp.asarray(cq.dur_hi),
-                jnp.asarray(cq.win_start), jnp.asarray(cq.win_end))
+                jnp.asarray(cq.win_start), jnp.asarray(cq.win_end), vh, bg)
         if self.mesh is not None:
             with self._dispatch_lock:  # see __init__: collective ordering
                 return dist_coalesced_scan_kernel(

@@ -50,6 +50,12 @@ class CompiledQuery:
     win_start: int
     win_end: int
     limit: int
+    # device-probe product (search/dict_probe.py): bool [T, v_pad] value
+    # hit mask, resident on device. When set, val_ranges is the
+    # never-match padding and the scan kernels test membership with a
+    # mask lookup instead of range compares — the probe result never
+    # crosses the host boundary.
+    val_hits: object = None
 
     @property
     def n_terms(self) -> int:
@@ -126,6 +132,10 @@ def pack_val_dict(val_dict: list) -> tuple:
 _PRUNED = "pruned"  # cache sentinel: block provably cannot match these tags
 _COMPILE_CACHE_MAX = 128     # distinct tag-sets kept per dictionary
 _COMPILE_CACHE_DICTS = 4096  # distinct dictionaries tracked
+# entries whose probe product is a DEVICE hit mask pin HBM (~v_pad bytes
+# per term — 10 MB/term at 10M values), so they get a much tighter
+# per-dictionary bound than the host-only entries
+_PROBE_CACHE_MAX = 8
 _COMPILE_CACHE: OrderedDict = OrderedDict()
 _compile_cache_lock = threading.Lock()
 
@@ -135,18 +145,28 @@ def _dict_fingerprint(cache_on, key_dict: list, val_dict: list) -> bytes:
     OUTSIDE the cache lock (a 1M-value dictionary hashes for ~100ms — it
     must not serialize every other thread's compiles). sha256, not
     hash(): a 64-bit collision would silently serve another dictionary's
-    compiled term ids, an undetectable wrong-results failure."""
+    compiled term ids, an undetectable wrong-results failure.
+
+    Containers decoded from the encoding/v2 search object carry the
+    digest of their ENCODED dictionary sections (`_dict_section_sha`,
+    columnar.from_bytes — one C-speed pass over contiguous bytes at
+    build, zero cost at open), so the first cache touch skips the
+    ~100ms-per-1M-values python walk; synthetic/test containers built
+    in memory fall back to it."""
     fp = getattr(cache_on, "_dict_fingerprint", None)
     if fp is None:
-        h = hashlib.sha256()
-        for part in key_dict:
-            h.update(part.encode("utf-8", "surrogatepass"))
-            h.update(b"\x00")
-        h.update(b"\x01")
-        for part in val_dict:
-            h.update(part.encode("utf-8", "surrogatepass"))
-            h.update(b"\x00")
-        fp = cache_on._dict_fingerprint = h.digest()
+        fp = getattr(cache_on, "_dict_section_sha", None)
+        if fp is None:
+            h = hashlib.sha256()
+            for part in key_dict:
+                h.update(part.encode("utf-8", "surrogatepass"))
+                h.update(b"\x00")
+            h.update(b"\x01")
+            for part in val_dict:
+                h.update(part.encode("utf-8", "surrogatepass"))
+                h.update(b"\x00")
+            fp = h.digest()
+        cache_on._dict_fingerprint = fp
     return fp
 
 
@@ -162,7 +182,7 @@ def _tags_sig(req) -> tuple:
 def compile_query(key_dict: list, val_dict: list,
                   req: tempopb.SearchRequest,
                   packed_vals: tuple | None = None,
-                  cache_on=None) -> CompiledQuery | None:
+                  cache_on=None, staged_dict=None) -> CompiledQuery | None:
     """Returns None when the block provably cannot match (key absent from
     the key dictionary, or no dictionary value satisfies a term). Under the
     exhaustive debug flag blocks are never pruned: an unsatisfiable term
@@ -175,7 +195,17 @@ def compile_query(key_dict: list, val_dict: list,
     blocks are immutable, so repeated tag-sets hit, and blocks that
     SHARE dictionaries (the common production shape: the same services/
     status codes tenant-wide) share one probe. Bounded LRU per
-    dictionary; the fingerprint is computed once per container."""
+    dictionary; the fingerprint is computed once per container.
+
+    `staged_dict`: a dict_probe.DeviceDict for this value dictionary —
+    when present the substring probe runs ON DEVICE (staging-time
+    routing already applied the `search_device_probe_min_vals`
+    threshold) and the compiled query carries the [T, v_pad] hit mask
+    instead of host-folded ranges. The cache key is unchanged, so
+    repeated tag-sets skip all probe work on either path; a cached
+    host-path product is served to a device-capable caller (and vice
+    versa) — both are exact, only the kernel's membership test
+    differs."""
     sig = None
     if cache_on is not None:
         sig = _tags_sig(req)
@@ -195,7 +225,8 @@ def compile_query(key_dict: list, val_dict: list,
             # exhaustive flag is part of the signature)
             return None if isinstance(hit, str) else _from_probe(hit, req)
 
-    out = _probe_tags(key_dict, val_dict, req, packed_vals)
+    out = _probe_tags(key_dict, val_dict, req, packed_vals,
+                      staged_dict=staged_dict)
     if sig is not None:
         with _compile_cache_lock:
             cache = _COMPILE_CACHE.get(fp)
@@ -203,15 +234,21 @@ def compile_query(key_dict: list, val_dict: list,
                 cache[sig] = _PRUNED if out is None else out
                 while len(cache) > _COMPILE_CACHE_MAX:
                     cache.popitem(last=False)
+                probed = [s for s, o in cache.items()
+                          if not isinstance(o, str) and o[3] is not None]
+                # device hit masks pin HBM: keep only the newest few
+                while len(probed) > _PROBE_CACHE_MAX:
+                    cache.pop(probed.pop(0), None)
     return None if out is None else _from_probe(out, req)
 
 
 def _from_probe(probe, req) -> CompiledQuery:
-    term_keys, term_vals, val_ranges = probe
+    term_keys, term_vals, val_ranges, val_hits = probe
     return CompiledQuery(
         term_keys=term_keys,
         term_vals=term_vals,
         val_ranges=val_ranges,
+        val_hits=val_hits,
         dur_lo=req.min_duration_ms or 0,
         dur_hi=req.max_duration_ms or UINT32_MAX,
         win_start=req.start or 0,
@@ -220,16 +257,65 @@ def _from_probe(probe, req) -> CompiledQuery:
     )
 
 
-def _probe_tags(key_dict: list, val_dict: list, req,
-                packed_vals: tuple | None):
-    """The expensive, tags-only part of compilation: binary-search keys,
-    substring-scan the value dictionary, fold ids to range sets. Returns
-    (term_keys, term_vals, val_ranges) or None (pruned)."""
-    exhaustive = is_exhaustive(req)
+def _device_probe_tags(terms, key_dict, staged_dict, exhaustive):
+    """Device-path value probe: ONE vmapped kernel call for all terms;
+    the only host sync is the [T]-bool any_hits fetch that prune
+    decisions need. Returns the probe product or None (pruned).
+    Raises ValueError when a needle exceeds the kernel's unroll bound —
+    the caller falls back to the exact host scan."""
+    from . import dict_probe
+
     term_key_ids = []
-    term_val_sets = []
+    needles = []
+    for k, v in terms:
+        i = bisect.bisect_left(key_dict, k)
+        if i >= len(key_dict) or key_dict[i] != k:
+            if not exhaustive:
+                return None
+            i = -1
+        term_key_ids.append(i)
+        nb = v.encode("utf-8")
+        if len(nb) > dict_probe.MAX_NEEDLE_BYTES:
+            raise ValueError("needle too long for device probe")
+        needles.append(nb)
+    hits, any_hits = dict_probe.probe_value_hits(staged_dict, needles)
+    if not exhaustive:
+        any_host = np.asarray(any_hits)
+        for t, ki in enumerate(term_key_ids):
+            if ki >= 0 and not any_host[t]:
+                return None  # no dictionary value satisfies this term
+    # missing keys (exhaustive only) must contribute an all-false row
+    # regardless of what the probe said for their needle
+    key_ok = np.asarray(term_key_ids, dtype=np.int32) >= 0
+    if not key_ok.all():
+        import jax.numpy as jnp
+
+        hits = hits & jnp.asarray(key_ok)[:, None]
+    T = len(term_key_ids)
+    term_keys = np.asarray(term_key_ids, dtype=np.int32)
+    term_vals = np.full((T, 1), INT32_SENTINEL, dtype=np.int32)
+    val_ranges = np.tile(np.array([1, 0], dtype=np.int32), (T, 1, 1))
+    return term_keys, term_vals, val_ranges, hits
+
+
+def _probe_tags(key_dict: list, val_dict: list, req,
+                packed_vals: tuple | None, staged_dict=None):
+    """The expensive, tags-only part of compilation: binary-search keys,
+    then either the host substring scan folded to range sets, or the
+    device probe (staged_dict present) yielding a device hit mask.
+    Returns (term_keys, term_vals, val_ranges, val_hits) or None
+    (pruned)."""
+    exhaustive = is_exhaustive(req)
     terms = sorted((k, v) for k, v in req.tags.items()
                    if k != EXHAUSTIVE_SEARCH_TAG)
+    if staged_dict is not None and terms:
+        try:
+            return _device_probe_tags(terms, key_dict, staged_dict,
+                                      exhaustive)
+        except ValueError:
+            pass  # oversized needle: exact host path below
+    term_key_ids = []
+    term_val_sets = []
     for k, v in terms:
         i = bisect.bisect_left(key_dict, k)
         if i >= len(key_dict) or key_dict[i] != k:
@@ -267,4 +353,6 @@ def _probe_tags(key_dict: list, val_dict: list, req,
         term_vals = np.zeros((0, 1), dtype=np.int32)
         val_ranges = np.zeros((0, 1, 2), dtype=np.int32)
 
-    return term_keys, term_vals, val_ranges
+    # host path: no device hit mask (val_hits slot keeps the probe
+    # product a uniform 4-tuple across both paths)
+    return term_keys, term_vals, val_ranges, None

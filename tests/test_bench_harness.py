@@ -91,14 +91,15 @@ def test_preflight_probe_failure_is_explicit(tmp_path):
 
 
 @pytest.mark.slow
-def test_cpu_fallback_is_marked_degraded(tmp_path):
-    # probes 1-3 wedge (counted hang hook); the 4th — the CPU fallback —
-    # answers. The run must complete with CPU numbers in detail only,
-    # headline value=0 (the TPU metric contract), and rc=4.
+def test_cpu_fallback_after_first_wedge_by_default(tmp_path):
+    # default BENCH_PREFLIGHT_ATTEMPTS=1: ONE wedged probe (counted hang
+    # hook) and the very next attempt is the CPU fallback — r05 burned
+    # 3x60s before falling back. The run must complete with CPU numbers
+    # in detail only, headline value=0 (the TPU metric contract), rc=4.
     rc, doc = run_bench(tmp_path, {
         "BENCH_PHASES": "single",
         "BENCH_TEST_HANG_PHASE": "probe",
-        "BENCH_TEST_HANG_TIMES": "3",
+        "BENCH_TEST_HANG_TIMES": "1",
         "BENCH_TIMEOUT_PROBE": "4",
     }, timeout=300)
     assert rc == 4
@@ -108,6 +109,83 @@ def test_cpu_fallback_is_marked_degraded(tmp_path):
     # the degraded run still recorded real (CPU) numbers in detail
     cfg = doc["detail"]["configs"]
     assert cfg["duration_only_traces_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_preflight_attempts_env_configurable(tmp_path):
+    # BENCH_PREFLIGHT_ATTEMPTS=3 restores the retry-happy behavior:
+    # probes 1-3 wedge, the 4th (CPU fallback) answers
+    rc, doc = run_bench(tmp_path, {
+        "BENCH_PHASES": "single",
+        "BENCH_PREFLIGHT_ATTEMPTS": "3",
+        "BENCH_TEST_HANG_PHASE": "probe",
+        "BENCH_TEST_HANG_TIMES": "3",
+        "BENCH_TIMEOUT_PROBE": "4",
+    }, timeout=300)
+    assert rc == 4
+    assert doc["degraded"].startswith("cpu-fallback")
+    assert "3x" in doc["degraded"]
+
+
+@pytest.mark.slow
+def test_degraded_run_records_reduced_scale_point(tmp_path):
+    # a degraded (CPU-fallback) round must still record scale-phase
+    # numbers — at reduced size, flagged as such — instead of skipping
+    # them (r05 lost both scale series to one wedged tunnel)
+    rc, doc = run_bench(tmp_path, {
+        "BENCH_PHASES": "single,scale_10k",
+        "BENCH_TEST_HANG_PHASE": "probe",
+        "BENCH_TEST_HANG_TIMES": "1",
+        "BENCH_TIMEOUT_PROBE": "4",
+        "BENCH_DEGRADED_SCALE_BLOCKS": "4",
+    }, timeout=420)
+    assert rc == 4
+    scale = doc["detail"]["configs"]["scale_10k"]
+    assert "error" not in scale, scale
+    assert scale["degraded_reduced_size"] is True
+    assert scale["blocks"] == 4  # the reduced corpus, not the 10K config
+    assert scale["p50_ms"] > 0
+
+
+@pytest.mark.slow
+def test_degraded_scale_opt_out_still_skips(tmp_path):
+    rc, doc = run_bench(tmp_path, {
+        "BENCH_PHASES": "single,scale_10k",
+        "BENCH_TEST_HANG_PHASE": "probe",
+        "BENCH_TEST_HANG_TIMES": "1",
+        "BENCH_TIMEOUT_PROBE": "4",
+        "BENCH_DEGRADED_SCALE": "0",
+    }, timeout=300)
+    assert rc == 4
+    scale = doc["detail"]["configs"]["scale_10k"]
+    assert "skipped: degraded" in scale["error"]
+
+
+def test_assemble_surfaces_dict_probe_trajectory():
+    """The host-prefilter vs device-probe timings of BOTH high-
+    cardinality phases must land at detail.dict_probe in the final doc
+    (the round-over-round trajectory for the PR4 optimization) — and a
+    wedged phase must drop out instead of contributing nulls."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    hc = {"distinct_values": 1_000_000, "traces_per_sec": 100,
+          "dict_prefilter_ms": 38.0, "matches": 5,
+          "device_probe_ms": 2.5, "device_probe_stage_ms": 40.0,
+          "device_probe_rate": 120}
+    full = dict(hc, distinct_values=10_000_000, dict_prefilter_ms=312.0)
+    doc = bench._assemble({"high_cardinality": hc,
+                           "high_cardinality_full": full})
+    traj = doc["detail"]["dict_probe"]
+    assert traj["high_cardinality"]["dict_prefilter_ms"] == 38.0
+    assert traj["high_cardinality"]["device_probe_ms"] == 2.5
+    assert traj["high_cardinality_full"]["distinct_values"] == 10_000_000
+    assert traj["high_cardinality_full"]["device_probe_stage_ms"] == 40.0
+
+    doc = bench._assemble({"high_cardinality": hc,
+                           "high_cardinality_full": {"error": "wedged"}})
+    assert list(doc["detail"]["dict_probe"]) == ["high_cardinality"]
+    assert bench._assemble({}).get("detail", {}).get("dict_probe") is None
 
 
 @pytest.mark.slow
