@@ -1001,6 +1001,105 @@ def phase_coalesced_serving():
         max(3, iters // 4), concurrency=conc)
 
 
+def phase_profile_overhead():
+    """Dispatch-profiler contract: `search_profiling_enabled: false` is
+    a TRUE noop, and the enabled profiler must cost < ~2% on the
+    dispatch hot path. Measures the same fully-synchronous scan loop
+    with the profiler enabled vs disabled (min-of-reps, interleaved so
+    clock drift cancels) and asserts the delta; the enabled run's
+    per-stage aggregates ride along for detail.profile."""
+    from tempo_tpu import tempopb
+    from tempo_tpu.observability import profile
+    from tempo_tpu.search.engine import ScanEngine, stage
+    from tempo_tpu.search.pipeline import compile_query
+
+    n_entries = int(os.environ.get("BENCH_PROFILE_ENTRIES", 65_536))
+    iters = int(os.environ.get("BENCH_PROFILE_ITERS", 150))
+    reps = int(os.environ.get("BENCH_PROFILE_REPS", 5))
+    pages = build_corpus(n_entries)
+    req = tempopb.SearchRequest()
+    req.tags["service.name"] = "svc-007"
+    req.tags["http.status_code"] = "500"
+    req.limit = 20
+    cq = compile_query(pages.key_dict, pages.val_dict, req)
+    eng = ScanEngine(top_k=128)
+    sp = stage(pages)
+    eng.scan_staged(sp, cq)  # compile+warm
+
+    def run_loop(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            eng.scan_staged(sp, cq)  # sync path: dispatch + D2H, profiled
+        return time.perf_counter() - t0
+
+    run_loop(max(1, iters // 5))  # warmup
+    t_on, t_off = [], []
+    try:
+        for _ in range(reps):
+            profile.configure(enabled=False)
+            t_off.append(run_loop(iters))
+            profile.configure(enabled=True)
+            t_on.append(run_loop(iters))
+    finally:
+        profile.configure(enabled=True)
+    best_on, best_off = min(t_on), min(t_off)
+    ab_overhead_pct = (best_on - best_off) / best_off * 100
+
+    # The A/B wall-clock delta above is the honest end-to-end number but
+    # on a shared host its noise floor (several %) swamps a ~50us/call
+    # effect. The ASSERTED bound is deterministic: time the exact record
+    # protocol an enabled dispatch adds (alloc + stage timers +
+    # compile_check + publish) against the noop path, and take it as a
+    # fraction of the measured per-dispatch time.
+    def protocol_loop(n):
+        t0 = time.perf_counter()
+        for i in range(n):
+            with profile.dispatch("single") as rec:
+                with rec.stage("build"):
+                    pass
+                rec.compile_check(("overhead_probe", i % 8))
+                with rec.stage("execute"):
+                    pass
+                with rec.stage("d2h"):
+                    pass
+                rec.add_bytes(d2h=64)
+        return time.perf_counter() - t0
+
+    N_PROTO = 20_000
+    protocol_loop(1000)  # warm
+    record_us = min(protocol_loop(N_PROTO) for _ in range(3)) \
+        / N_PROTO * 1e6
+    profile.configure(enabled=False)
+    try:
+        noop_us = min(protocol_loop(N_PROTO) for _ in range(3)) \
+            / N_PROTO * 1e6
+    finally:
+        profile.configure(enabled=True)
+    dispatch_us = best_on / iters * 1e6
+    overhead_pct = (record_us - noop_us) / dispatch_us * 100
+
+    snap = profile.PROFILER.snapshot(recent=0)
+    result = {
+        "n_entries": n_entries,
+        "iters_per_rep": iters,
+        "reps": reps,
+        "enabled_s": round(best_on, 4),
+        "disabled_s": round(best_off, 4),
+        "ab_overhead_pct": round(ab_overhead_pct, 3),
+        "record_cost_us": round(record_us - noop_us, 2),
+        "noop_cost_us": round(noop_us, 3),
+        "dispatch_us": round(dispatch_us, 1),
+        "overhead_pct": round(overhead_pct, 3),
+        "within_2pct": overhead_pct < 2.0,
+        "jit_cache": snap["jit_cache"],
+    }
+    assert overhead_pct < 2.0, (
+        f"profiling record cost {record_us - noop_us:.1f}us is "
+        f"{overhead_pct:.2f}% of the {dispatch_us:.0f}us dispatch — "
+        "exceeds the 2% budget")
+    return result
+
+
 def phase_scale_10k():
     n_blocks = int(os.environ.get("BENCH_SCALE_BLOCKS", 10_000))
     if not n_blocks:
@@ -1028,6 +1127,7 @@ PHASES = {
     "coalesced_serving": phase_coalesced_serving,
     "high_cardinality": phase_high_cardinality,
     "high_cardinality_full": phase_high_cardinality_full,
+    "profile_overhead": phase_profile_overhead,
     "scale_10k": phase_scale_10k,
     "scale_large_blocks": phase_scale_large_blocks,
 }
@@ -1043,6 +1143,7 @@ PHASE_TIMEOUTS = {
     "coalesced_serving": 420.0,
     "high_cardinality": 300.0,
     "high_cardinality_full": 420.0,
+    "profile_overhead": 300.0,
     "scale_10k": 900.0,
     "scale_large_blocks": 1200.0,
 }
@@ -1089,6 +1190,23 @@ def _phase_main(name: str) -> int:
 
     honor_jax_platforms(required=True)  # bench WILL use jax: fail loudly
     result = PHASES[name]()
+    if isinstance(result, dict) and "_profile" not in result:
+        # per-phase dispatch-stage breakdown (observability/profile.py):
+        # each phase child is its own process, so the process profiler's
+        # aggregates ARE this phase's stage profile — the trajectory
+        # files stop being opaque wall-clock totals
+        try:
+            from tempo_tpu.observability.profile import PROFILER
+
+            snap = PROFILER.snapshot(recent=0)
+            if snap["aggregates"]:
+                result["_profile"] = {
+                    "aggregates": snap["aggregates"],
+                    "jit_cache": snap["jit_cache"],
+                    "bytes": snap["bytes"],
+                }
+        except Exception:  # noqa: BLE001 — telemetry must not fail a phase
+            pass
     doc = json.dumps(result)
     ckpt = os.environ.get("BENCH_CKPT_FILE")
     if ckpt:
@@ -1180,6 +1298,18 @@ def _assemble(results: dict) -> dict:
     """Build the single final JSON doc from whatever phases finished —
     same shape as every prior round so BENCH_r0N files stay comparable;
     wedged phases carry {"error": ...} instead of numbers."""
+    def _strip(r):
+        """Phase result without its `_profile` rider (that lands once,
+        under detail.profile.stages, not duplicated per config)."""
+        if isinstance(r, dict) and "_profile" in r:
+            return {k: v for k, v in r.items() if k != "_profile"}
+        return r
+
+    # per-phase dispatch-stage profiles, collected before the strip
+    prof_stages = {k: v["_profile"] for k, v in results.items()
+                   if isinstance(v, dict) and "_profile" in v}
+    results = {k: _strip(v) if k != "degraded" else v
+               for k, v in results.items()}
     single = results.get("single")
     probe = results.get("probe") or {}
     ok = isinstance(single, dict) and not _failed(single)
@@ -1231,6 +1361,19 @@ def _assemble(results: dict) -> dict:
             }
     if probe_ms:
         doc["detail"]["dict_probe"] = probe_ms
+    # dispatch-profiler telemetry: the overhead contract measurement plus
+    # every phase's per-(mode, stage) aggregates — the trajectory now
+    # carries WHERE device time went, not just wall-clock totals
+    prof: dict = {}
+    ov = results.get("profile_overhead")
+    if isinstance(ov, dict) and not _failed(ov):
+        prof["overhead"] = ov
+    elif isinstance(ov, dict):
+        prof["overhead"] = {"error": ov.get("error")}
+    if prof_stages:
+        prof["stages"] = prof_stages
+    if prof:
+        doc["detail"]["profile"] = prof
     if not ok:
         err = (single or {}).get(
             "error", "headline phase 'single' did not run")

@@ -42,6 +42,21 @@ def _hex_trace_id(s: str) -> bytes:
         raise InvalidArgument(str(e)) from None
 
 
+class TextBody(str):
+    """A text response body carrying its own Content-Type. A str
+    subclass, so handle() callers that compare/parse the body are
+    unaffected — only the wire serializer (_reply) looks at the
+    attribute. /metrics uses it: Prometheus scrapers key the parser off
+    `text/plain; version=0.0.4` vs the OpenMetrics media type."""
+
+    __slots__ = ("content_type",)
+
+    def __new__(cls, s: str, content_type: str):
+        self = super().__new__(cls, s)
+        self.content_type = content_type
+        return self
+
+
 def _route_template(path: str) -> str:
     """Collapse variable path segments so span names stay low-cardinality
     (OTel convention: name by route, real path in http.target)."""
@@ -158,9 +173,19 @@ class HTTPApi:
         if path == "/ready":
             return (200, "ready") if self.app.ready() else (503, "not ready")
         if path == "/metrics":
-            from tempo_tpu.observability.metrics import REGISTRY
+            from tempo_tpu.observability.metrics import (
+                OPENMETRICS_CONTENT_TYPE, PROM_CONTENT_TYPE, REGISTRY)
 
-            return 200, REGISTRY.expose()
+            # OpenMetrics negotiation: scrapers that Accept the
+            # openmetrics media type get exemplars (histogram buckets →
+            # self-trace ids); everyone else gets the classic 0.0.4 text
+            # format, byte-identical to before
+            accept = (headers.get("Accept") or "") \
+                if hasattr(headers, "get") else ""
+            om = "application/openmetrics-text" in accept
+            return 200, TextBody(
+                REGISTRY.expose(openmetrics=om),
+                OPENMETRICS_CONTENT_TYPE if om else PROM_CONTENT_TYPE)
         if path == "/status" or path.startswith("/status/"):
             return 200, self._status(path, query)
         if path == "/flush":
@@ -180,6 +205,17 @@ class HTTPApi:
             if db is None:
                 return 404, {"error": "no storage reader in this target"}
             return 200, db.batcher.debug_stats()
+        if path == "/debug/profile":
+            # dispatch profiler: recent per-dispatch stage breakdowns +
+            # process-lifetime aggregates (observability/profile.py)
+            from tempo_tpu.observability.profile import PROFILER
+
+            recent = 32
+            try:
+                recent = max(0, int(query.get("recent", recent)))
+            except (TypeError, ValueError):
+                pass
+            return 200, PROFILER.snapshot(recent=recent)
         if path == "/shutdown":
             threading.Thread(target=self.app.shutdown, daemon=True).start()
             return 200, "shutting down"
@@ -417,7 +453,8 @@ def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
                 ctype = "application/json"
             else:
                 data = str(body).encode()
-                ctype = "text/plain"
+                # TextBody carries its negotiated type (/metrics)
+                ctype = getattr(body, "content_type", "text/plain")
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             # the body varies on negotiation headers — shared caches

@@ -88,6 +88,14 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         # (50k distinct values), <= 0 = host-only probing
         search_device_probe_min_vals=storage.get(
             "search_device_probe_min_vals"),
+        # dispatch profiler (docs/observability.md): per-dispatch stage
+        # telemetry + /debug/profile; false is a true noop on the
+        # dispatch hot path
+        search_profiling_enabled=storage.get(
+            "search_profiling_enabled", True),
+        search_profiling_fence=storage.get(
+            "search_profiling_fence", False),
+        search_profiling_ring=storage.get("search_profiling_ring", 256),
         # restartable host state (header snapshot + persistent XLA
         # compile cache); absent = auto (<wal_dir>/host-state), "" = off
         host_state_dir=storage.get("host_state_dir"),

@@ -2,4 +2,6 @@ from .metrics import REGISTRY, Counter, Gauge, Histogram
 from .log import get_logger, RateLimitedLogger
 
 __all__ = ["REGISTRY", "Counter", "Gauge", "Histogram", "get_logger",
-           "RateLimitedLogger"]
+           "RateLimitedLogger", "profile"]
+
+from . import profile  # noqa: E402 — imports metrics+tracing above

@@ -5,6 +5,14 @@ registered at var-init in every component with `tempo_`/`tempodb_`
 namespaces (SURVEY.md §5 observability), exposed in text format at
 /metrics. Labels are per-series (cardinality-aware: the label set lives
 in the series key).
+
+Exemplars ("tempo traces tempo", closed loop): a Histogram observation
+made while a SAMPLED self-trace span is active records that span's
+trace_id against the bucket the value fell in. ``/metrics`` negotiates
+OpenMetrics via ``Accept`` (api/http.py) and ``expose(openmetrics=True)``
+emits the exemplars per the OpenMetrics 1.0 text format — latency
+buckets become clickable into the self-traces that produced them. The
+classic Prometheus text format (0.0.4) is byte-identical to before.
 """
 
 from __future__ import annotations
@@ -12,6 +20,22 @@ from __future__ import annotations
 import threading
 import time
 from bisect import bisect_left
+
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def _exemplar_ref() -> str | None:
+    """trace_id (hex) of the active sampled self-trace span, or None.
+    Imported lazily: tracing imports this module at load for its own
+    counters; the call path here only runs post-import."""
+    from . import tracing
+
+    s = tracing.current_span()
+    if s.recording and s.context.sampled:
+        return s.context.trace_id.hex()
+    return None
 
 
 class _Metric:
@@ -27,9 +51,18 @@ class _Metric:
     def _key(self, labels: dict | None) -> tuple:
         return tuple(sorted((labels or {}).items()))
 
-    def expose(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} {self.kind}"]
+    def _om_base(self) -> str:
+        """OpenMetrics metric-family name: counters are named WITHOUT the
+        `_total` suffix in HELP/TYPE lines (the suffix belongs to the
+        sample), everything else is unchanged."""
+        if self.kind == "counter" and self.name.endswith("_total"):
+            return self.name[: -len("_total")]
+        return self.name
+
+    def expose(self, openmetrics: bool = False) -> str:
+        name = self._om_base() if openmetrics else self.name
+        lines = [f"# HELP {name} {self.help}",
+                 f"# TYPE {name} {self.kind}"]
         with self._lock:
             for key, val in sorted(self._series.items()):
                 lbl = ",".join(f'{k}="{v}"' for k, v in key)
@@ -60,7 +93,10 @@ class Counter(_Metric):
         return _BoundCounter(self, self._key(labels))
 
     def value(self, **labels) -> float:
-        return self._series.get(self._key(labels), 0)
+        # locked like every writer: a bare dict read races resize-in-
+        # progress under free-threading and misses published updates
+        with self._lock:
+            return self._series.get(self._key(labels), 0)
 
 
 class _BoundCounter:
@@ -83,7 +119,8 @@ class Gauge(_Metric):
             self._series[self._key(labels)] = v
 
     def value(self, **labels) -> float:
-        return self._series.get(self._key(labels), 0)
+        with self._lock:
+            return self._series.get(self._key(labels), 0)
 
 
 class Histogram(_Metric):
@@ -95,6 +132,10 @@ class Histogram(_Metric):
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
         self._counts: dict[tuple, list] = {}
         self._sums: dict[tuple, float] = {}
+        # series key -> {bin index: (trace_id_hex, value, unix_ts)}:
+        # the newest sampled-span observation per bucket — OpenMetrics
+        # exemplars linking latency buckets to self-traces
+        self._exemplars: dict[tuple, dict] = {}
 
     def observe(self, v: float, **labels) -> None:
         self._observe_key(self._key(labels), v)
@@ -105,12 +146,15 @@ class Histogram(_Metric):
         # cumulative-le form. One bisect + one increment beats the old
         # O(buckets) cumulative walk on the per-span ingest path.
         i = bisect_left(self.buckets, v)
+        ex = _exemplar_ref()  # before the lock: reads a contextvar only
         with self._lock:
             counts = self._counts.get(k)
             if counts is None:
                 counts = self._counts[k] = [0] * (len(self.buckets) + 1)
             counts[i] += 1
             self._sums[k] = self._sums.get(k, 0) + v
+            if ex is not None:
+                self._exemplars.setdefault(k, {})[i] = (ex, v, time.time())
 
     def labels(self, **labels) -> "_BoundHistogram":
         return _BoundHistogram(self, self._key(labels))
@@ -118,22 +162,36 @@ class Histogram(_Metric):
     def time(self, **labels):
         return _Timer(self, labels)
 
-    def expose(self) -> str:
+    @staticmethod
+    def _exemplar_suffix(ex) -> str:
+        """OpenMetrics exemplar: ` # {labels} value timestamp`."""
+        if ex is None:
+            return ""
+        trace_id, value, ts = ex
+        return f' # {{trace_id="{trace_id}"}} {value} {round(ts, 3)}'
+
+    def expose(self, openmetrics: bool = False) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
         with self._lock:
             for key, counts in sorted(self._counts.items()):
                 base = dict(key)
+                exs = self._exemplars.get(key, {}) if openmetrics else {}
                 cum = 0
                 for i, b in enumerate(self.buckets):
                     cum += counts[i]
+                    # OpenMetrics requires float-formatted thresholds
+                    le = float(b) if openmetrics else b
                     lbl = ",".join(f'{k}="{v}"' for k, v in
-                                   sorted({**base, "le": b}.items()))
-                    lines.append(f"{self.name}_bucket{{{lbl}}} {cum}")
+                                   sorted({**base, "le": le}.items()))
+                    lines.append(f"{self.name}_bucket{{{lbl}}} {cum}"
+                                 + self._exemplar_suffix(exs.get(i)))
                 total = cum + counts[-1]
                 lbl = ",".join(f'{k}="{v}"' for k, v in
                                sorted({**base, "le": "+Inf"}.items()))
-                lines.append(f"{self.name}_bucket{{{lbl}}} {total}")
+                lines.append(f"{self.name}_bucket{{{lbl}}} {total}"
+                             + self._exemplar_suffix(
+                                 exs.get(len(self.buckets))))
                 blbl = ",".join(f'{k}="{v}"' for k, v in key)
                 suffix = f"{{{blbl}}}" if blbl else ""
                 lines.append(f"{self.name}_sum{suffix} {self._sums.get(key, 0)}")
@@ -197,10 +255,13 @@ class Registry:
     def get(self, name: str) -> _Metric | None:
         return self._metrics.get(name)
 
-    def expose(self) -> str:
+    def expose(self, openmetrics: bool = False) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
-        return "\n".join(m.expose() for m in metrics) + "\n"
+        body = "\n".join(m.expose(openmetrics) for m in metrics) + "\n"
+        if openmetrics:
+            body += "# EOF\n"
+        return body
 
     def samples(self) -> list:
         with self._lock:
@@ -250,3 +311,39 @@ fallback_scans = Counter("tempo_search_fallback_scans_total",
 truncated_tag_entries = Counter(
     "tempo_search_truncated_entries_total",
     "entries whose tag set exceeded the kv-slot capacity at block build")
+
+# ---- dispatch profiler (observability/profile.py) ----
+dispatch_stage_seconds = Histogram(
+    "tempo_search_dispatch_stage_seconds",
+    "per-dispatch stage wall time: stage=build|h2d|compile|execute|d2h|"
+    "lock_wait, mode=single|batched|coalesced|mesh|dict_probe|host_probe",
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1,
+             5, 30))
+jit_cache_events = Counter(
+    "tempo_search_jit_cache_events_total",
+    "dispatch-shape compile-cache outcomes (result=hit|miss); a miss "
+    "means that dispatch paid XLA trace+compile")
+h2d_bytes = Counter("tempo_search_h2d_bytes_total",
+                    "bytes staged host->device (pages, dictionaries, "
+                    "query tables)")
+d2h_bytes = Counter("tempo_search_d2h_bytes_total",
+                    "bytes fetched device->host (scan results/demux)")
+hbm_cache_bytes = Gauge("tempo_search_hbm_cache_bytes",
+                        "staged-batch HBM cache occupancy (bytes)")
+host_cache_bytes = Gauge("tempo_search_host_cache_bytes",
+                         "host-RAM stacked-batch tier occupancy (bytes)")
+probe_dict_bytes = Gauge("tempo_search_probe_dict_bytes",
+                         "HBM held by staged device-probe dictionaries "
+                         "across resident batches (bytes)")
+coalesce_pending = Gauge("tempo_search_coalesce_pending_queries",
+                         "queries parked in coalescing windows right now "
+                         "(the coalescer queue depth)")
+
+# ---- self-tracing health (observability/tracing.py) ----
+selftrace_dropped_spans = Counter(
+    "tempo_selftrace_dropped_spans_total",
+    "self-trace spans dropped because the batch processor queue was full")
+selftrace_export_failures = Counter(
+    "tempo_selftrace_export_failures_total",
+    "self-trace export batches that raised (swallowed to protect the "
+    "flush loop; this counter is the only visible signal)")

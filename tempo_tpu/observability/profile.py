@@ -1,0 +1,392 @@
+"""Device-path dispatch profiler: per-dispatch stage telemetry.
+
+PRs 1-4 (coalescing, HBM tiering, device dict probe) each had to infer
+where device time went from bench wall-clocks — there was no first-class
+visibility into the stages the TPU lift actually changes. This module
+gives every device dispatch path (single-block, multi-block batched,
+coalesced, mesh-sharded, and the dict-probe kernel) a stage breakdown:
+
+  build    host-side predicate/table build (device-param upload prep,
+           query-table asarray; `mode=host_probe` records the host
+           dictionary prefilter — PR4's motivating cost)
+  h2d      host→device staging puts (bytes counted separately)
+  compile  the dispatch call when the jit cache missed for this shape
+           signature — tracing + XLA compile dominate that call
+  execute  the dispatch call on a cache hit, plus the
+           ``block_until_ready`` fence that attributes true kernel time
+  d2h      device→host result fetch / fused-group demux
+  lock_wait  time queued on the process-wide collective dispatch lock
+           (parallel.mesh.dispatch_lock) — mesh paths only
+
+Records land in a bounded ring buffer (``/debug/profile`` renders the
+recent ones) and aggregate into metrics:
+
+  tempo_search_dispatch_stage_seconds{stage,mode}   (histogram)
+  tempo_search_jit_cache_events_total{result}       (counter)
+  tempo_search_h2d_bytes_total / tempo_search_d2h_bytes_total
+
+Stage events also annotate the active self-trace span, so a slow
+query's own trace shows which stage ate the time.
+
+Design constraints (mirrors tracing.py's noop stance):
+- A TRUE noop path: with profiling disabled every call site pays one
+  attribute check and gets back a shared immutable noop object — no
+  allocation, no clock reads, no lock. `search_profiling_enabled: false`
+  must cost nothing measurable on the dispatch hot path.
+- Jit-compile detection needs no jax internals: the profiler keeps its
+  own bounded set of shape signatures per dispatch site; a first-seen
+  signature is a compile-cache miss (jit caches key on exactly these
+  statics — the call sites pass the same tuple the kernel's
+  static_argnames + array shapes/dtypes imply).
+- The ``execute`` fence (``block_until_ready`` after the dispatch call)
+  attributes TRUE kernel time, but converts the async enqueue into a
+  synchronous wait — which breaks the batcher's dispatch/drain
+  pipelining. It is therefore OPT-IN (``search_profiling_fence``,
+  default off): unfenced, "execute" measures the dispatch call (enqueue
+  + any synchronous work) and the device wait lands in the "d2h" stage
+  at the sync point, which still answers "which stage ate the time" at
+  dispatch granularity. Bench phase ``profile_overhead`` re-measures
+  the enabled-vs-disabled delta every round; the noop path is the <2%
+  contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from . import metrics as obs
+from . import tracing
+
+STAGES = ("build", "h2d", "compile", "execute", "d2h", "lock_wait")
+
+_COMPILE_SEEN_MAX = 4096  # shape signatures tracked before reset
+
+
+class _NoopStage:
+    """Shared, immutable, free — the disabled-profiler stage context."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+_NOOP_STAGE = _NoopStage()
+
+
+class _NoopDispatch:
+    """Shared noop dispatch record: every method is a cheap no-op so the
+    call sites never branch on `enabled` themselves."""
+
+    __slots__ = ()
+    enabled = False
+
+    def stage(self, name):
+        return _NOOP_STAGE
+
+    def add_stage(self, name, seconds):
+        return self
+
+    def add_bytes(self, h2d=0, d2h=0):
+        return self
+
+    def compile_check(self, key) -> bool:
+        return False
+
+    def fence(self, arrays):
+        return self
+
+    def set(self, **kv):
+        return self
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False
+
+
+NOOP_DISPATCH = _NoopDispatch()
+
+
+class _StageTimer:
+    __slots__ = ("_rec", "_name", "_t0")
+
+    def __init__(self, rec, name):
+        self._rec = rec
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        self._rec.add_stage(self._name,
+                            time.perf_counter() - self._t0)
+        return False
+
+
+class Dispatch:
+    """One in-flight dispatch's profile record. Context-manager; the
+    record is published (ring + metrics + span event) on close()."""
+
+    __slots__ = ("mode", "stages", "h2d_bytes", "d2h_bytes", "jit",
+                 "attrs", "t0", "_prof", "_closed")
+    enabled = True
+
+    def __init__(self, prof, mode: str):
+        self.mode = mode
+        self.stages: dict[str, float] = {}
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.jit = None       # None (no kernel), "hit" or "miss"
+        self.attrs: dict = {}
+        self.t0 = time.perf_counter()
+        self._prof = prof
+        self._closed = False
+
+    def stage(self, name: str) -> _StageTimer:
+        return _StageTimer(self, name)
+
+    def add_stage(self, name: str, seconds: float) -> "Dispatch":
+        self.stages[name] = self.stages.get(name, 0.0) + seconds
+        return self
+
+    def add_bytes(self, h2d: int = 0, d2h: int = 0) -> "Dispatch":
+        self.h2d_bytes += int(h2d)
+        self.d2h_bytes += int(d2h)
+        return self
+
+    def compile_check(self, key) -> bool:
+        """First sighting of this shape signature = jit cache miss. The
+        caller times the dispatch call under stage "compile" on a miss
+        (tracing + XLA compile dominate it) and "execute" on a hit."""
+        miss = self._prof._compile_miss(key)
+        self.jit = "miss" if miss else "hit"
+        return miss
+
+    def fence(self, arrays) -> "Dispatch":
+        """block_until_ready the kernel outputs when the profiler's
+        fence is on — called inside the "execute" stage so kernel time
+        is attributed there instead of at the later sync point."""
+        if self._prof.fence:
+            fence_arrays(arrays)
+        return self
+
+    def set(self, **kv) -> "Dispatch":
+        self.attrs.update(kv)
+        return self
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._prof._finish(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False
+
+    def as_dict(self) -> dict:
+        d = {
+            "mode": self.mode,
+            "stages_ms": {k: round(v * 1e3, 3)
+                          for k, v in self.stages.items()},
+            "total_ms": round(sum(self.stages.values()) * 1e3, 3),
+        }
+        if self.h2d_bytes:
+            d["h2d_bytes"] = self.h2d_bytes
+        if self.d2h_bytes:
+            d["d2h_bytes"] = self.d2h_bytes
+        if self.jit is not None:
+            d["jit_cache"] = self.jit
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+
+class DispatchProfiler:
+    """Process-wide profiler (module singleton ``PROFILER``, the
+    REGISTRY idiom): config flips ``enabled``; dispatch sites call
+    ``dispatch(mode)`` and get either a recording ``Dispatch`` or the
+    shared noop."""
+
+    def __init__(self, ring_size: int = 256, enabled: bool = True,
+                 fence: bool = False):
+        self.enabled = enabled
+        # fence=True adds a block_until_ready after each profiled kernel
+        # call (true kernel-time attribution, at the cost of the async
+        # dispatch pipelining — see module docstring)
+        self.fence = fence
+        self._ring: deque = deque(maxlen=ring_size)
+        self._lock = threading.Lock()
+        self._compile_seen: set = set()
+        # aggregates over the process lifetime (cheap dict sums — the
+        # histogram has the full distribution, this answers /debug/profile
+        # without a metrics scrape)
+        self._agg: dict[tuple, list] = {}   # (mode, stage) -> [n, total_s]
+        self._jit = {"hit": 0, "miss": 0}
+        self._bytes = {"h2d": 0, "d2h": 0}
+        self._dispatches = 0
+
+    # ---- call-site API ----
+
+    def dispatch(self, mode: str):
+        if not self.enabled:
+            return NOOP_DISPATCH
+        return Dispatch(self, mode)
+
+    def observe_stage(self, stage: str, mode: str, seconds: float,
+                      nbytes: int = 0) -> None:
+        """Record one stage observation outside a dispatch record (e.g.
+        staging H2D that serves many later dispatches, or the drain-side
+        D2H fetch). Noop when disabled."""
+        if not self.enabled:
+            return
+        obs.dispatch_stage_seconds.observe(seconds, stage=stage, mode=mode)
+        with self._lock:
+            k = (mode, stage)
+            a = self._agg.get(k)
+            if a is None:
+                a = self._agg[k] = [0, 0.0]
+            a[0] += 1
+            a[1] += seconds
+            if nbytes:
+                key = "h2d" if stage == "h2d" else "d2h"
+                self._bytes[key] += nbytes
+        if nbytes:
+            (obs.h2d_bytes if stage == "h2d" else obs.d2h_bytes).inc(nbytes)
+        span = tracing.current_span()
+        if span.recording:
+            span.add_event("profile.stage", stage=stage, mode=mode,
+                           ms=round(seconds * 1e3, 3))
+
+    # ---- internals ----
+
+    def _compile_miss(self, key) -> bool:
+        with self._lock:
+            if key in self._compile_seen:
+                miss = False
+            else:
+                if len(self._compile_seen) >= _COMPILE_SEEN_MAX:
+                    self._compile_seen.clear()
+                self._compile_seen.add(key)
+                miss = True
+        obs.jit_cache_events.inc(result="miss" if miss else "hit")
+        return miss
+
+    def _finish(self, rec: Dispatch) -> None:
+        for stage, sec in rec.stages.items():
+            obs.dispatch_stage_seconds.observe(sec, stage=stage,
+                                               mode=rec.mode)
+        if rec.h2d_bytes:
+            obs.h2d_bytes.inc(rec.h2d_bytes)
+        if rec.d2h_bytes:
+            obs.d2h_bytes.inc(rec.d2h_bytes)
+        with self._lock:
+            self._dispatches += 1
+            if rec.jit is not None:
+                self._jit[rec.jit] += 1
+            self._bytes["h2d"] += rec.h2d_bytes
+            self._bytes["d2h"] += rec.d2h_bytes
+            for stage, sec in rec.stages.items():
+                k = (rec.mode, stage)
+                a = self._agg.get(k)
+                if a is None:
+                    a = self._agg[k] = [0, 0.0]
+                a[0] += 1
+                a[1] += sec
+            self._ring.append(rec.as_dict())
+        span = tracing.current_span()
+        if span.recording:
+            span.add_event(
+                "dispatch.profile", mode=rec.mode,
+                jit_cache=rec.jit or "",
+                **{f"{k}_ms": round(v * 1e3, 3)
+                   for k, v in rec.stages.items()})
+
+    # ---- operator surface ----
+
+    def snapshot(self, recent: int = 32) -> dict:
+        """/debug/profile payload: recent dispatches + aggregates."""
+        with self._lock:
+            ring = list(self._ring)[-recent:] if recent > 0 else []
+            agg = {}
+            for (mode, stage), (n, total) in sorted(self._agg.items()):
+                agg.setdefault(mode, {})[stage] = {
+                    "count": n,
+                    "total_ms": round(total * 1e3, 3),
+                    "mean_ms": round(total / n * 1e3, 3),
+                }
+            return {
+                "enabled": self.enabled,
+                "dispatches": self._dispatches,
+                "jit_cache": dict(self._jit),
+                "bytes": dict(self._bytes),
+                "aggregates": agg,
+                "recent": ring,
+            }
+
+    def reset(self) -> None:
+        """Test/bench hook: clear ring + aggregates (metrics counters
+        are process-lifetime and stay)."""
+        with self._lock:
+            self._ring.clear()
+            self._agg.clear()
+            self._compile_seen.clear()
+            self._jit = {"hit": 0, "miss": 0}
+            self._bytes = {"h2d": 0, "d2h": 0}
+            self._dispatches = 0
+
+
+PROFILER = DispatchProfiler()
+
+
+def configure(enabled: bool | None = None, fence: bool | None = None,
+              ring_size: int | None = None) -> DispatchProfiler:
+    """Apply config (TempoDBConfig.search_profiling_enabled) to the
+    process profiler. Ring resize preserves nothing (the ring is
+    diagnostics, not state)."""
+    if enabled is not None:
+        PROFILER.enabled = bool(enabled)
+    if fence is not None:
+        PROFILER.fence = bool(fence)
+    if ring_size is not None:
+        with PROFILER._lock:
+            PROFILER._ring = deque(PROFILER._ring, maxlen=int(ring_size))
+    return PROFILER
+
+
+def dispatch(mode: str):
+    """Module-level convenience mirroring tracing.start_span."""
+    return PROFILER.dispatch(mode)
+
+
+def observe_stage(stage: str, mode: str, seconds: float,
+                  nbytes: int = 0) -> None:
+    PROFILER.observe_stage(stage, mode, seconds, nbytes=nbytes)
+
+
+def fence_arrays(arrays) -> None:
+    """block_until_ready every device array in `arrays` (tuples from the
+    scan kernels) — the execute-stage fence. Tolerates host scalars and
+    None leaves so call sites can pass kernel outputs verbatim."""
+    for a in arrays:
+        wait = getattr(a, "block_until_ready", None)
+        if wait is not None:
+            try:
+                wait()
+            except Exception:  # noqa: BLE001 — profiling must never fail a scan
+                pass

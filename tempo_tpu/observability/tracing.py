@@ -269,6 +269,11 @@ class BatchProcessor:
             self._q.put_nowait(span)
         except queue.Full:
             self.dropped += 1
+            # visible, not just instance state: a saturated exporter was
+            # previously indistinguishable from a healthy quiet one
+            from . import metrics as obs
+
+            obs.selftrace_dropped_spans.inc()
 
     def _drain(self) -> list:
         out = []
@@ -292,8 +297,13 @@ class BatchProcessor:
             tok = _suppressed.set(True)
             try:
                 self.exporter.export(batch)
-            except Exception:  # noqa: BLE001 — never kill the loop
-                pass
+            except Exception:  # noqa: BLE001 — never kill the loop, but
+                # COUNT it: a dead collector endpoint silently eating
+                # every batch must show up on /metrics
+                from . import metrics as obs
+
+                obs.selftrace_export_failures.inc(
+                    exporter=type(self.exporter).__name__)
             finally:
                 _suppressed.reset(tok)
 

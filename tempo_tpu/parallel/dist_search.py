@@ -57,13 +57,20 @@ class DistributedScanEngine:
     def stage(self, pages: ColumnarPages) -> ShardedPages:
         """Pad the page axis to a multiple of the shard count and place
         each array with a NamedSharding over the scan axis."""
+        import time
+
+        from tempo_tpu.observability import profile
+
         n = self.n_shards
         B = -(-pages.n_pages // n) * n
         spec = NamedSharding(self.mesh, P(SCAN_AXIS))
-        dev = {
-            name: jax.device_put(arr, spec)
-            for name, arr in pad_page_axis(pages, B).items()
-        }
+        host = pad_page_axis(pages, B)
+        t0 = time.perf_counter()
+        dev = {name: jax.device_put(arr, spec)
+               for name, arr in host.items()}
+        profile.observe_stage("h2d", "mesh", time.perf_counter() - t0,
+                              nbytes=sum(int(v.nbytes)
+                                         for v in host.values()))
         return ShardedPages(device=dev, n_pages=pages.n_pages, pages=pages)
 
     # ---- kernel ----
@@ -119,29 +126,45 @@ class DistributedScanEngine:
     # ---- public API ----
 
     def scan_staged(self, sp: ShardedPages, cq: CompiledQuery):
-        d = sp.device
-        k = self.top_k
-        while k < cq.limit:
-            k *= 2
-        from tempo_tpu.search.engine import ScanEngine
+        from tempo_tpu.observability import profile
 
-        tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(cq)
-        from tempo_tpu.parallel.mesh import dispatch_lock
+        with profile.dispatch("mesh") as rec:
+            d = sp.device
+            k = self.top_k
+            while k < cq.limit:
+                k *= 2
+            from tempo_tpu.search.engine import ScanEngine
 
-        # process-wide collective-ordering lock (parallel.mesh): shared
-        # with the multiblock engine and the dictionary probe, so no two
-        # threads can interleave per-device shard_map queues
-        with dispatch_lock:
-            out = self._dist_kernel(
-                d["kv_key"], d["kv_val"],
-                d["entry_start"], d["entry_end"], d["entry_dur"],
-                d["entry_valid"],
-                tk, vr, dlo, dhi, ws, we, getattr(cq, "val_hits", None),
-                n_terms=cq.n_terms, top_k=k,
-            )
-        from tempo_tpu.search.engine import fetch_scan_out
+            with rec.stage("build"):
+                tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(cq)
+            vh = getattr(cq, "val_hits", None)
+            miss = rec.compile_check(
+                ("dist", d["kv_key"].shape, str(d["kv_key"].dtype),
+                 str(d["kv_val"].dtype), vr.shape,
+                 None if vh is None else tuple(vh.shape), cq.n_terms, k))
+            from tempo_tpu.parallel.mesh import locked_collective
 
-        return fetch_scan_out(out)
+            # process-wide collective-ordering lock (parallel.mesh):
+            # shared with the multiblock engine and the dictionary probe,
+            # so no two threads can interleave per-device shard_map
+            # queues; time queued behind others lands in lock_wait
+            with locked_collective(rec):
+                with rec.stage("compile" if miss else "execute"):
+                    out = self._dist_kernel(
+                        d["kv_key"], d["kv_val"],
+                        d["entry_start"], d["entry_end"], d["entry_dur"],
+                        d["entry_valid"],
+                        tk, vr, dlo, dhi, ws, we, vh,
+                        n_terms=cq.n_terms, top_k=k,
+                    )
+                    rec.fence(out)
+            from tempo_tpu.search.engine import fetch_scan_out
+
+            with rec.stage("d2h"):
+                res = fetch_scan_out(out)
+            rec.add_bytes(d2h=res[2].nbytes + res[3].nbytes + 8)
+            rec.set(n_pages=sp.n_pages, shards=self.n_shards)
+        return res
 
     def scan(self, pages: ColumnarPages, cq: CompiledQuery):
         return self.scan_staged(self.stage(pages), cq)

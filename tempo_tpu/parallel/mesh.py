@@ -9,6 +9,7 @@ and DCN across slices, replacing the goroutine fan-out + Results channel.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 
 import jax
@@ -25,6 +26,22 @@ SCAN_AXIS = "shards"
 # enough, because the probe dispatches during query compilation while a
 # different engine thread may be mid-scan on the same devices.
 dispatch_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def locked_collective(rec=None):
+    """Hold the process-wide collective dispatch lock, attributing the
+    time spent QUEUED behind other dispatches to the profiler record's
+    `lock_wait` stage (rec = observability.profile dispatch record or
+    None). Under concurrent mesh searches this wait is serialization the
+    operator can't otherwise see — it looks like kernel time."""
+    import time
+
+    t0 = time.perf_counter()
+    with dispatch_lock:
+        if rec is not None:
+            rec.add_stage("lock_wait", time.perf_counter() - t0)
+        yield
 
 
 def scan_mesh_axes() -> tuple[str, ...]:

@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from tempo_tpu.observability import metrics as obs
+from tempo_tpu.observability import profile
 from tempo_tpu.observability import tracing
 
 from .engine import DEFAULT_TOP_K, fetch_coalesced_out, resolve_top_k, \
@@ -246,6 +247,10 @@ class QueryCoalescer:
                             name="coalesce-window")
                         self._sched.start()
                     self._cv.notify()
+            # queue-depth gauge AFTER the flush-now removal above: only
+            # queries actually parked in a window count as pending
+            obs.coalesce_pending.set(
+                sum(len(g.items) for g in self._pending.values()))
         if flush_now is not None:
             self._run(flush_now)
         return fut
@@ -276,6 +281,8 @@ class QueryCoalescer:
                 if pend is None or pend.gen != gen:
                     continue  # size-triggered flush beat the window
                 del self._pending[key]
+                obs.coalesce_pending.set(
+                    sum(len(g.items) for g in self._pending.values()))
                 grp = pend
             self._flush_pool.submit(self._run, grp)
 
@@ -364,6 +371,7 @@ class BlockBatcher:
         self.io_workers = io_workers
         self._cache: OrderedDict[tuple, _CachedBatch] = OrderedDict()
         self._cache_total = 0
+        self._probe_dict_total = 0  # staged-dict bytes across _cache
         # host-RAM tier between the object store and HBM: stacked numpy
         # batches, byte-budgeted separately. An HBM eviction leaves the
         # host copy, so re-staging an evicted batch is one H2D copy, not
@@ -448,6 +456,22 @@ class BlockBatcher:
     # ------------------------------------------------------------------
     # staging cache
 
+    @staticmethod
+    def _dict_bytes(batch) -> int:
+        """HBM held by a batch's staged device-probe dictionaries."""
+        return sum(int(d.nbytes)
+                   for d in getattr(batch, "staged_dicts", {}).values())
+
+    def _publish_gauges_locked(self) -> None:
+        """Occupancy gauges for /metrics (caller holds self._lock): HBM
+        + host tier bytes, and the HBM share held by staged device-probe
+        dictionaries across resident batches. All three are running
+        totals (the _cache_total idiom) — this must stay O(1), it runs
+        on every stage/evict under the global lock."""
+        obs.hbm_cache_bytes.set(self._cache_total)
+        obs.host_cache_bytes.set(self._host_total)
+        obs.probe_dict_bytes.set(self._probe_dict_total)
+
     def _evict_hbm_locked(self) -> None:
         """LRU-evict staged batches until the HBM budget holds — caller
         holds self._lock. Pinned entries (actively scanned by some
@@ -461,7 +485,9 @@ class BlockBatcher:
                 break  # everything pinned: over budget until a drain
             old = self._cache.pop(victim)
             self._cache_total -= old.nbytes
+            self._probe_dict_total -= self._dict_bytes(old.batch)
             obs.batch_cache_events.inc(result="evict")
+        self._publish_gauges_locked()
 
     def _staged(self, group: list[ScanJob]) -> _CachedBatch:
         key = tuple(j.key for j in group)
@@ -507,6 +533,7 @@ class BlockBatcher:
                         _, oldh = self._host_cache.popitem(last=False)
                         self._host_total -= oldh.nbytes
                         obs.batch_cache_events.inc(result="host_evict")
+                    self._publish_gauges_locked()
                 obs.batch_cache_events.inc(result="host_miss")
             else:
                 obs.batch_cache_events.inc(result="host_hit")
@@ -520,8 +547,10 @@ class BlockBatcher:
                 prev = self._cache.pop(key, None)
                 if prev is not None:
                     self._cache_total -= prev.nbytes
+                    self._probe_dict_total -= self._dict_bytes(prev.batch)
                 self._cache[key] = entry
                 self._cache_total += nbytes
+                self._probe_dict_total += self._dict_bytes(batch)
                 self._evict_hbm_locked()
             return entry
         finally:
@@ -536,11 +565,14 @@ class BlockBatcher:
             dead = [k for k in self._cache
                     if any(jk[0] not in live_block_ids for jk in k)]
             for k in dead:
-                self._cache_total -= self._cache.pop(k).nbytes
+                old = self._cache.pop(k)
+                self._cache_total -= old.nbytes
+                self._probe_dict_total -= self._dict_bytes(old.batch)
             dead_h = [k for k in self._host_cache
                       if any(jk[0] not in live_block_ids for jk in k)]
             for k in dead_h:
                 self._host_total -= self._host_cache.pop(k).nbytes
+            self._publish_gauges_locked()
 
     def prewarm(self, groups: list[list[ScanJob]],
                 warm_compile: bool = True,
@@ -705,8 +737,20 @@ class BlockBatcher:
             t0 = _time.perf_counter()
             gkey, cached, mq, pre, fut = inflight.popleft()
             if hasattr(fut, "result"):  # coalescer Future vs direct tuple
+                # NOT timed as d2h: a coalescer Future's wait includes
+                # the coalescing window + the group's stacking/dispatch
                 fut = fut.result()
+            # the ACTUAL device→host sync: fused-slice demux happens at
+            # unpack, the direct path syncs at the scalar/array fetches —
+            # time exactly these so stage=d2h means transfer, not queue
+            t0d = _time.perf_counter()
             count, inspected, scores, idx = fut
+            inspected = int(inspected)
+            scores = np.asarray(scores)
+            idx = np.asarray(idx)
+            profile.observe_stage(
+                "d2h", "batched", _time.perf_counter() - t0d,
+                nbytes=scores.nbytes + idx.nbytes + 8)
             # harvest the uploaded per-query tables AFTER the dispatch
             # ran: under coalescing the flush (and its H2D upload) can
             # happen on the window-timer thread, after submit returned —
@@ -733,13 +777,12 @@ class BlockBatcher:
                         if self._cache.get(gkey) is cached:
                             self._cache_total += dpb
                             self._evict_hbm_locked()
-            inspected = int(inspected) - pre["entries_skipped"]
+            inspected -= pre["entries_skipped"]
             results.metrics.inspected_blocks += pre["inspected_blocks"]
             results.metrics.inspected_bytes += pre["inspected_bytes"]
             results.metrics.truncated_entries += pre["truncated"]
             results.metrics.inspected_traces += max(0, inspected)
-            for m in self.engine.results(cached.batch, mq,
-                                         np.asarray(scores), np.asarray(idx)):
+            for m in self.engine.results(cached.batch, mq, scores, idx):
                 results.add(m)
             stages["drain"] += _time.perf_counter() - t0
 

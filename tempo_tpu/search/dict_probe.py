@@ -54,6 +54,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tempo_tpu.observability import profile
+
 # Dictionaries below this many distinct values keep the exact host path
 # (numpy / native memmem): the probe there is microseconds-to-low-ms and
 # staging dictionary bytes to HBM would cost more than it saves. Mirrors
@@ -166,6 +168,9 @@ def place_device_dict(packed: PackedDeviceDict, mesh=None,
     """H2D for a packed dictionary. With a mesh the shard axis (axis 0)
     splits across devices; `sharding` overrides (multi-host staging uses
     make_array_from_callback upstream)."""
+    import time
+
+    t0 = time.perf_counter()
     host = {"buf": packed.buf, "pos": packed.pos, "off": packed.off,
             "n_real": packed.n_real}
     if sharding is not None:
@@ -185,6 +190,8 @@ def place_device_dict(packed: PackedDeviceDict, mesh=None,
             dev = {k: jax.device_put(v, spec) for k, v in host.items()}
     else:
         dev = {k: jnp.asarray(v) for k, v in host.items()}
+    profile.observe_stage("h2d", "dict_probe", time.perf_counter() - t0,
+                          nbytes=packed.nbytes)
     return DeviceDict(packed=packed, device=dev, mesh=mesh)
 
 
@@ -311,31 +318,45 @@ def probe_value_hits(ddev: DeviceDict, needles: list[bytes]):
     lmax = max(len(n) for n in needles)
     if lmax > MAX_NEEDLE_BYTES:
         raise ValueError(f"needle exceeds {MAX_NEEDLE_BYTES} bytes")
-    Lp = _pow2(max(1, lmax))
-    arr = np.zeros((T, Lp), dtype=np.uint8)
-    lens = np.zeros(T, dtype=np.int32)
-    empties = np.zeros(T, dtype=bool)
-    for t, nb in enumerate(needles):
-        arr[t, :len(nb)] = np.frombuffer(nb, dtype=np.uint8)
-        lens[t] = len(nb)
-        empties[t] = len(nb) == 0
-    d = ddev.device
-    if ddev.mesh is not None:
-        from tempo_tpu.parallel.mesh import dispatch_lock
+    with profile.dispatch("dict_probe") as rec:
+        with rec.stage("build"):
+            Lp = _pow2(max(1, lmax))
+            arr = np.zeros((T, Lp), dtype=np.uint8)
+            lens = np.zeros(T, dtype=np.int32)
+            empties = np.zeros(T, dtype=bool)
+            for t, nb in enumerate(needles):
+                arr[t, :len(nb)] = np.frombuffer(nb, dtype=np.uint8)
+                lens[t] = len(nb)
+                empties[t] = len(nb) == 0
+        d = ddev.device
+        rec.add_bytes(h2d=arr.nbytes + lens.nbytes + empties.nbytes)
+        miss = rec.compile_check(
+            ("probe", ddev.mesh is not None, d["buf"].shape,
+             d["off"].shape, T, Lp))
+        stage = "compile" if miss else "execute"
+        rec.set(n_vals=ddev.n_vals, n_terms=T)
+        if ddev.mesh is not None:
+            from tempo_tpu.parallel.mesh import locked_collective
 
-        # collective dispatch: serialize with every other shard_map
-        # enqueue in the process (the probe fires during query compile,
-        # concurrent with scan dispatches on the same devices — an
-        # interleaved per-device queue deadlocks the collectives)
-        with dispatch_lock:
-            return dist_probe_kernel(ddev.mesh, d["buf"], d["pos"],
-                                     d["off"], d["n_real"],
-                                     jnp.asarray(arr), jnp.asarray(lens),
-                                     jnp.asarray(empties),
-                                     n_needle_max=Lp)
-    return probe_kernel(d["buf"], d["pos"], d["off"], d["n_real"],
-                        jnp.asarray(arr), jnp.asarray(lens),
+            # collective dispatch: serialize with every other shard_map
+            # enqueue in the process (the probe fires during query
+            # compile, concurrent with scan dispatches on the same
+            # devices — an interleaved per-device queue deadlocks the
+            # collectives)
+            with locked_collective(rec):
+                with rec.stage(stage):
+                    out = dist_probe_kernel(
+                        ddev.mesh, d["buf"], d["pos"], d["off"],
+                        d["n_real"], jnp.asarray(arr), jnp.asarray(lens),
                         jnp.asarray(empties), n_needle_max=Lp)
+                    rec.fence(out)
+            return out
+        with rec.stage(stage):
+            out = probe_kernel(d["buf"], d["pos"], d["off"], d["n_real"],
+                               jnp.asarray(arr), jnp.asarray(lens),
+                               jnp.asarray(empties), n_needle_max=Lp)
+            rec.fence(out)
+        return out
 
 
 def hits_to_ids(hits_row) -> np.ndarray:

@@ -29,9 +29,13 @@ from __future__ import annotations
 import functools
 from dataclasses import dataclass
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from tempo_tpu.observability import profile
 
 from .columnar import ColumnarPages
 from .pipeline import CompiledQuery
@@ -91,7 +95,11 @@ def stage(pages: ColumnarPages, page_bucket: int | None = None,
     disables). The threshold is applied HERE, at staging time — query
     compilation just uses whatever was staged."""
     B = page_bucket or _bucket(pages.n_pages)
-    dev = {k: jnp.asarray(v) for k, v in pad_page_axis(pages, B).items()}
+    host = pad_page_axis(pages, B)
+    t0 = time.perf_counter()
+    dev = {k: jnp.asarray(v) for k, v in host.items()}
+    profile.observe_stage("h2d", "single", time.perf_counter() - t0,
+                          nbytes=sum(int(v.nbytes) for v in host.values()))
     sd = stage_block_dict(pages, probe_min_vals)
     return StagedPages(device=dev, n_pages=pages.n_pages, pages=pages,
                        staged_dict=sd)
@@ -284,21 +292,43 @@ class ScanEngine:
             object.__setattr__(cq, "_device_params", cached)
         return cached
 
-    def scan_staged_async(self, sp: StagedPages, cq: CompiledQuery):
+    def scan_staged_async(self, sp: StagedPages, cq: CompiledQuery,
+                          _rec=profile.NOOP_DISPATCH):
         """Dispatch the kernel without forcing device→host transfers;
         returns device arrays (count, inspected, scores, idx). Use when
-        pipelining many blocks/queries — convert only at the end."""
+        pipelining many blocks/queries — convert only at the end.
+
+        `_rec`: a profile.Dispatch record when the caller owns one (the
+        sync scan_staged wrapper); the default noop keeps this enqueue
+        hot loop free of per-call profiling cost."""
         d = sp.device
-        tk, vr, dlo, dhi, ws, we = self.query_device_params(cq)
-        return scan_kernel(
-            d["kv_key"], d["kv_val"],
-            d["entry_start"], d["entry_end"], d["entry_dur"], d["entry_valid"],
-            tk, vr, dlo, dhi, ws, we, getattr(cq, "val_hits", None),
-            n_terms=cq.n_terms, top_k=self._resolve_top_k(cq),
-        )
+        with _rec.stage("build"):
+            tk, vr, dlo, dhi, ws, we = self.query_device_params(cq)
+        vh = getattr(cq, "val_hits", None)
+        k = self._resolve_top_k(cq)
+        miss = _rec.compile_check(
+            ("scan_kernel", d["kv_key"].shape, str(d["kv_key"].dtype),
+             str(d["kv_val"].dtype), vr.shape,
+             None if vh is None else tuple(vh.shape), cq.n_terms, k))
+        with _rec.stage("compile" if miss else "execute"):
+            out = scan_kernel(
+                d["kv_key"], d["kv_val"],
+                d["entry_start"], d["entry_end"], d["entry_dur"],
+                d["entry_valid"],
+                tk, vr, dlo, dhi, ws, we, vh,
+                n_terms=cq.n_terms, top_k=k,
+            )
+            _rec.fence(out)
+        return out
 
     def scan_staged(self, sp: StagedPages, cq: CompiledQuery):
-        return fetch_scan_out(self.scan_staged_async(sp, cq))
+        with profile.dispatch("single") as rec:
+            out = self.scan_staged_async(sp, cq, _rec=rec)
+            with rec.stage("d2h"):
+                res = fetch_scan_out(out)
+            rec.add_bytes(d2h=res[2].nbytes + res[3].nbytes + 8)
+            rec.set(n_pages=sp.n_pages)
+        return res
 
     def scan(self, pages: ColumnarPages, cq: CompiledQuery):
         return self.scan_staged(stage(pages), cq)

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from tempo_tpu import tempopb
+from tempo_tpu.observability import profile
+
 from .columnar import ColumnarPages
 from .dict_probe import _pow2
 from .engine import DEFAULT_TOP_K, masked_topk
@@ -200,8 +202,12 @@ def place_batch(host: HostBatch, sharding=None, mesh=None) -> BlockBatch:
     that mesh size (engine.stage_host packs with the engine's shard
     count); any mismatch places them unsharded — still correct, the
     probe just runs on one device."""
+    import time
+
     from . import dict_probe
 
+    mode = "mesh" if sharding is not None else "batched"
+    t0 = time.perf_counter()
     cat = host.cat
     if sharding is not None:
         if jax.process_count() > 1:
@@ -218,6 +224,10 @@ def place_batch(host: HostBatch, sharding=None, mesh=None) -> BlockBatch:
             dev = {k: jax.device_put(v, sharding) for k, v in cat.items()}
     else:
         dev = {k: jnp.asarray(v) for k, v in cat.items()}
+    # page-array H2D only; the dictionary placement below times itself
+    # (mode=dict_probe) inside place_device_dict
+    profile.observe_stage("h2d", mode, time.perf_counter() - t0,
+                          nbytes=sum(int(v.nbytes) for v in cat.values()))
     staged = {}
     for fp, pd in host.packed_dicts.items():
         dict_mesh = (mesh if mesh is not None and pd.n_shards > 1
@@ -775,23 +785,43 @@ class MultiBlockEngine:
         """Dispatch without device→host sync; returns device arrays."""
         from .engine import resolve_top_k
 
-        k = resolve_top_k(self.top_k, mq.limit)
-        d = batch.device
-        # params uploaded once per MultiQuery (duck-typed: MultiQuery has
-        # the same param attributes CompiledQuery has)
-        from .engine import ScanEngine
+        with profile.dispatch(
+                "mesh" if self.mesh is not None else "batched") as rec:
+            k = resolve_top_k(self.top_k, mq.limit)
+            d = batch.device
+            with rec.stage("build"):
+                # params uploaded once per MultiQuery (duck-typed:
+                # MultiQuery has the same param attributes CompiledQuery
+                # has)
+                from .engine import ScanEngine
 
-        tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(mq)
-        vh = getattr(mq, "val_hits", None)
-        bg = None if vh is None else jnp.asarray(mq.block_group)
-        args = (d["kv_key"], d["kv_val"], d["entry_start"], d["entry_end"],
-                d["entry_dur"], d["entry_valid"], d["page_block"],
-                tk, vr, dlo, dhi, ws, we, vh, bg)
-        if self.mesh is not None:
-            with self._dispatch_lock:  # see __init__: collective ordering
-                return dist_multi_scan_kernel(self.mesh, *args,
-                                              n_terms=mq.n_terms, top_k=k)
-        return multi_scan_kernel(*args, n_terms=mq.n_terms, top_k=k)
+                tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(mq)
+                vh = getattr(mq, "val_hits", None)
+                bg = None if vh is None else jnp.asarray(mq.block_group)
+            args = (d["kv_key"], d["kv_val"], d["entry_start"],
+                    d["entry_end"], d["entry_dur"], d["entry_valid"],
+                    d["page_block"], tk, vr, dlo, dhi, ws, we, vh, bg)
+            miss = rec.compile_check(
+                ("multi", self.mesh is not None, d["kv_key"].shape,
+                 str(d["kv_key"].dtype), str(d["kv_val"].dtype), vr.shape,
+                 None if vh is None else tuple(vh.shape), mq.n_terms, k))
+            stage = "compile" if miss else "execute"
+            rec.set(kernel="multi", blocks=len(batch.blocks))
+            if self.mesh is not None:
+                from tempo_tpu.parallel import mesh as mesh_mod
+
+                # see __init__: collective ordering; time queued behind
+                # other dispatches lands in the lock_wait stage
+                with mesh_mod.locked_collective(rec):
+                    with rec.stage(stage):
+                        out = dist_multi_scan_kernel(
+                            self.mesh, *args, n_terms=mq.n_terms, top_k=k)
+                        rec.fence(out)
+                return out
+            with rec.stage(stage):
+                out = multi_scan_kernel(*args, n_terms=mq.n_terms, top_k=k)
+                rec.fence(out)
+            return out
 
     def scan(self, batch: BlockBatch, mq: MultiQuery):
         from .engine import fetch_scan_out
@@ -804,20 +834,45 @@ class MultiBlockEngine:
         device arrays (counts [Q], inspected, scores [Q,k], idx [Q,k]).
         `top_k` is the GROUP k — max over the coalesced requests'
         resolved k, so every member's limit is covered."""
-        d = batch.device
-        vh = getattr(cq, "val_hits", None)
-        bg = None if vh is None else jnp.asarray(cq.block_group)
-        args = (d["kv_key"], d["kv_val"], d["entry_start"], d["entry_end"],
-                d["entry_dur"], d["entry_valid"], d["page_block"],
-                jnp.asarray(cq.term_keys), jnp.asarray(cq.val_ranges),
-                jnp.asarray(cq.term_active),
-                jnp.asarray(cq.dur_lo), jnp.asarray(cq.dur_hi),
-                jnp.asarray(cq.win_start), jnp.asarray(cq.win_end), vh, bg)
-        if self.mesh is not None:
-            with self._dispatch_lock:  # see __init__: collective ordering
-                return dist_coalesced_scan_kernel(
-                    self.mesh, *args, n_terms=cq.n_terms, top_k=top_k)
-        return coalesced_scan_kernel(*args, n_terms=cq.n_terms, top_k=top_k)
+        with profile.dispatch(
+                "mesh" if self.mesh is not None else "coalesced") as rec:
+            d = batch.device
+            with rec.stage("build"):
+                vh = getattr(cq, "val_hits", None)
+                bg = None if vh is None else jnp.asarray(cq.block_group)
+                tables = (
+                    jnp.asarray(cq.term_keys), jnp.asarray(cq.val_ranges),
+                    jnp.asarray(cq.term_active),
+                    jnp.asarray(cq.dur_lo), jnp.asarray(cq.dur_hi),
+                    jnp.asarray(cq.win_start), jnp.asarray(cq.win_end))
+            rec.add_bytes(h2d=cq.term_keys.nbytes + cq.val_ranges.nbytes
+                          + cq.term_active.nbytes + 16 * len(cq.dur_lo))
+            args = (d["kv_key"], d["kv_val"], d["entry_start"],
+                    d["entry_end"], d["entry_dur"], d["entry_valid"],
+                    d["page_block"], *tables, vh, bg)
+            miss = rec.compile_check(
+                ("coalesced", self.mesh is not None, d["kv_key"].shape,
+                 str(d["kv_key"].dtype), str(d["kv_val"].dtype),
+                 cq.term_keys.shape, cq.val_ranges.shape,
+                 None if vh is None else tuple(vh.shape),
+                 cq.n_terms, top_k))
+            stage = "compile" if miss else "execute"
+            rec.set(kernel="coalesced", queries=cq.n_queries)
+            if self.mesh is not None:
+                from tempo_tpu.parallel import mesh as mesh_mod
+
+                with mesh_mod.locked_collective(rec):
+                    with rec.stage(stage):
+                        out = dist_coalesced_scan_kernel(
+                            self.mesh, *args, n_terms=cq.n_terms,
+                            top_k=top_k)
+                        rec.fence(out)
+                return out
+            with rec.stage(stage):
+                out = coalesced_scan_kernel(*args, n_terms=cq.n_terms,
+                                            top_k=top_k)
+                rec.fence(out)
+            return out
 
     def results(self, batch: BlockBatch, mq: MultiQuery,
                 scores: np.ndarray, idx: np.ndarray) -> list:

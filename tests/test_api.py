@@ -399,12 +399,79 @@ def test_debug_endpoints_gated_off(app):
     (cli/config.py server.debug_endpoints, default false) can turn the
     routes off — they answer 404, everything else still works."""
     api = HTTPApi(app, debug_endpoints=False)
-    for p in ("/debug/threads", "/debug/scan"):
+    for p in ("/debug/threads", "/debug/scan", "/debug/profile"):
         code, body = api.handle("GET", p, {}, {})
         assert code == 404, (p, code)
         assert "disabled" in body["error"]
     code, _ = api.handle("GET", "/ready", {}, {})
     assert code in (200, 503)
+
+
+def test_debug_profile_endpoint(app):
+    """/debug/profile: dispatch profiler snapshot (recent + aggregates),
+    behind the same gate as the other /debug routes."""
+    from tempo_tpu.observability import profile
+
+    api = HTTPApi(app)
+    profile.configure(enabled=True)
+    profile.PROFILER.reset()
+    try:
+        with profile.dispatch("batched") as rec:
+            rec.add_stage("execute", 0.004)
+        code, body = api.handle("GET", "/debug/profile", {}, {})
+        assert code == 200
+        assert body["enabled"] is True
+        assert body["dispatches"] == 1
+        assert body["aggregates"]["batched"]["execute"]["count"] == 1
+        assert body["recent"][0]["mode"] == "batched"
+        # ?recent=0 truncates the ring listing, keeps aggregates
+        code, body = api.handle("GET", "/debug/profile",
+                                {"recent": "0"}, {})
+        assert code == 200 and body["recent"] == []
+    finally:
+        profile.PROFILER.reset()
+
+
+def test_metrics_content_type_negotiation(app):
+    """/metrics answers the classic Prometheus type by default and the
+    OpenMetrics type (with # EOF terminator) when the scraper Accepts
+    it — the parser on the other end keys off Content-Type."""
+    api = HTTPApi(app)
+    code, body = api.handle("GET", "/metrics", {}, {})
+    assert code == 200
+    assert body.content_type == "text/plain; version=0.0.4"
+    assert not body.rstrip().endswith("# EOF")
+
+    code, om = api.handle(
+        "GET", "/metrics", {},
+        {"Accept": "application/openmetrics-text; version=1.0.0"})
+    assert code == 200
+    assert om.content_type.startswith("application/openmetrics-text")
+    assert om.rstrip().endswith("# EOF")
+
+
+def test_metrics_content_type_on_the_wire(app):
+    """End-to-end through the stdlib server: the negotiated type reaches
+    the HTTP response header."""
+    import urllib.request
+
+    api = HTTPApi(app)
+    server = serve_http(api, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        r = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics")
+        assert r.headers["Content-Type"] == "text/plain; version=0.0.4"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        r = urllib.request.urlopen(req)
+        assert r.headers["Content-Type"].startswith(
+            "application/openmetrics-text")
+        assert r.read().rstrip().endswith(b"# EOF")
+    finally:
+        server.shutdown()
 
 
 def test_debug_scan_reports_stage_breakdown(app):
