@@ -891,6 +891,67 @@ def bench_high_cardinality(n_entries, cardinality, iters,
         probe["device_probe_rate"] = round(_timed_rate(
             lambda: eng.scan_staged_async(sp, cq_dev),
             lambda out: int(out[0]), n_entries, iters))
+
+        # --- offload planner calibration: feed the MEASURED host and
+        # device timings from this corpus into the cost model, take its
+        # decision, run the planner-routed compile end to end, and
+        # assert the matches are identical either way (the planner can
+        # only move time, never results). This is the detail.planner
+        # calibration table: predicted vs measured per side, the
+        # decision taken, and the chosen side's mispredict.
+        from tempo_tpu.search import planner as planner_mod
+
+        planner_mod.configure(enabled=True, reset=True, seed=True)
+        try:
+            p = planner_mod.PLANNER
+            packed_dd = sp.staged_dict.packed
+            dict_bytes = packed_dd.real_bytes
+            staged_bytes = sp.staged_dict.nbytes
+            p.observe("host_probe", compile_ms / 1e3, nbytes=dict_bytes)
+            # the measured staging wall is pack (dominant at these
+            # cardinalities: millions of strings copied into the byte
+            # buffer) PLUS the device put; book it as pack over the real
+            # dictionary bytes — stuffing it into the h2d rate would
+            # inflate seconds-per-byte 10-100x (the true h2d rate arrives
+            # from the seed microbenchmark / live profiler feed)
+            p.observe("pack", probe["device_probe_stage_ms"] / 1e3,
+                      nbytes=dict_bytes)
+            p.observe("device_probe", probe["device_probe_ms"] / 1e3,
+                      nbytes=staged_bytes)
+            d = p.decide_probe(
+                n_vals=len(pages.val_dict), dict_bytes=dict_bytes,
+                resident=True, staged_bytes=staged_bytes,
+                fp=packed_dd.fingerprint, site="compile")
+            cq_plan = compile_query(pages.key_dict, pages.val_dict, req,
+                                    packed_vals=packed,
+                                    staged_dict=sp.staged_dict)
+            p_count, _, p_scores, _p_idx = eng.scan_staged(sp, cq_plan)
+            assert int(p_count) == int(count), (
+                f"planner-routed scan diverged: {int(p_count)} != "
+                f"{int(count)}")
+            assert np.array_equal(np.asarray(p_scores),
+                                  np.asarray(h_scores)), \
+                "planner-routed top-k scores diverged from host path"
+            measured = {"host": compile_ms,
+                        "device": probe["device_probe_ms"]}
+            predicted = {"host": round(d.predicted_host_s * 1e3, 1),
+                         "device": round(d.predicted_device_s * 1e3, 1)}
+            chosen_meas = measured[d.target]
+            snap = p.snapshot(recent=0)
+            probe["planner"] = {
+                "decision": d.target,
+                "took": ("device" if cq_plan.val_hits is not None
+                         else "host"),
+                "predicted_ms": predicted,
+                "measured_ms": measured,
+                "mispredict_pct": round(
+                    abs(predicted[d.target] - chosen_meas)
+                    / max(chosen_meas, 1e-6) * 100, 1),
+                "decisions": snap["decisions"],
+                "seed_ms": snap["seed_ms"],
+            }
+        finally:
+            planner_mod.configure(enabled=False)
     return rate, int(count), compile_ms, probe
 
 
@@ -1097,6 +1158,19 @@ def phase_profile_overhead():
         f"profiling record cost {record_us - noop_us:.1f}us is "
         f"{overhead_pct:.2f}% of the {dispatch_us:.0f}us dispatch — "
         "exceeds the 2% budget")
+    # The wall-clock A/B delta rides a ±6% noise floor on shared CPU
+    # hosts (two interleaved 150-iteration loops cannot resolve a
+    # ~50us/call effect there), so its assert is OPT-IN: set
+    # BENCH_PROFILE_AB_ASSERT=1 on quiet/pinned hosts to enforce it;
+    # tier-1 and default bench runs keep only the deterministic
+    # protocol-cost assert above.
+    ab_assert = os.environ.get("BENCH_PROFILE_AB_ASSERT", "") \
+        not in ("", "0")
+    result["ab_assert_enabled"] = ab_assert
+    if ab_assert:
+        assert ab_overhead_pct < 6.0, (
+            f"enabled-vs-disabled wall clock regressed "
+            f"{ab_overhead_pct:.2f}% (> 6% even allowing for noise)")
     return result
 
 
@@ -1361,6 +1435,18 @@ def _assemble(results: dict) -> dict:
             }
     if probe_ms:
         doc["detail"]["dict_probe"] = probe_ms
+    # offload-planner calibration table (predicted vs measured stage
+    # times, decisions taken, mispredict rate) — the high-cardinality
+    # phases run planner-on with identical-match asserts and ship the
+    # verdicts here, spanning the measured crossover (1M and 10M values)
+    planner_tbl = {}
+    for ph in ("high_cardinality", "high_cardinality_full"):
+        r = results.get(ph)
+        if isinstance(r, dict) and not _failed(r) and r.get("planner"):
+            planner_tbl[ph] = dict(r["planner"],
+                                   distinct_values=r.get("distinct_values"))
+    if planner_tbl:
+        doc["detail"]["planner"] = planner_tbl
     # dispatch-profiler telemetry: the overhead contract measurement plus
     # every phase's per-(mode, stage) aggregates — the trajectory now
     # carries WHERE device time went, not just wall-clock totals

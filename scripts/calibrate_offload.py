@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Replay a dispatch-profiler dump through the offload planner offline.
+
+Feed it a saved ``/debug/profile`` JSON (``curl :3200/debug/profile >
+profile.json`` on a debug-enabled target) and it rebuilds the planner's
+cost model from the recorded dispatches — device-probe rates from the
+``dict_probe`` ring records, h2d/host-probe rates from the byte-carrying
+aggregates — then prints the host/device decision table across a
+cardinality sweep. Operators sanity-check a deployment's crossover
+points (where would the planner flip?) without live traffic or a
+restart; no process state is touched (a standalone planner instance, no
+microbenchmark seed).
+
+    python scripts/calibrate_offload.py profile.json
+    python scripts/calibrate_offload.py profile.json \
+        --terms 2 --shards 8 --avg-value-bytes 24 \
+        --cardinalities 100000,1000000,10000000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt_ms(seconds: float) -> str:
+    return f"{seconds * 1e3:>12.1f}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="offline offload-planner calibration from a "
+                    "/debug/profile dump")
+    ap.add_argument("dump", help="path to a /debug/profile JSON dump")
+    ap.add_argument("--terms", type=int, default=1,
+                    help="tag terms per query (default 1)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="mesh shard count (adds the collective cost)")
+    ap.add_argument("--avg-value-bytes", type=int, default=16,
+                    help="mean dictionary value length (default 16)")
+    ap.add_argument("--cardinalities", default=None,
+                    help="comma-separated distinct-value counts "
+                         "(default: 50k..10M sweep)")
+    ap.add_argument("--recent", type=int, default=0,
+                    help="show the N most recent replayed records")
+    args = ap.parse_args(argv)
+
+    from tempo_tpu.search.planner import OffloadPlanner
+
+    with open(args.dump) as f:
+        snap = json.load(f)
+
+    # standalone instance: never mutates the process singleton, never
+    # runs the microbenchmark seed — the dump IS the calibration
+    p = OffloadPlanner(enabled=True, seed=False)
+    n = p.ingest_profile_snapshot(snap)
+    print(f"ingested {n} observations from {args.dump} "
+          f"({snap.get('dispatches', 0)} recorded dispatches)")
+    model = p.snapshot(recent=0)["cost_model"]
+    print("\ncost model (seconds/byte; '-' = no observations, "
+          "seed defaults apply):")
+    for kind, r in model["rates"].items():
+        v = r["seconds_per_byte"]
+        print(f"  {kind:<14} {v if v is not None else '-'}"
+              f"  ({r['observations']} obs)")
+    for kind, fx in model["fixed"].items():
+        v = fx["seconds"]
+        print(f"  {kind:<14} {v if v is not None else '-'} s fixed"
+              f"  ({fx['observations']} obs)")
+
+    if args.cardinalities:
+        cards = [int(c) for c in args.cardinalities.split(",") if c]
+    else:
+        cards = [50_000, 100_000, 316_000, 1_000_000, 3_160_000,
+                 10_000_000]
+
+    hdr = (f"{'distinct_vals':>13} {'dict_mb':>8} {'host_ms':>12} "
+           f"{'device_cold_ms':>14} {'device_warm_ms':>14} "
+           f"{'cold':>6} {'warm':>6}")
+    print("\ndecision table "
+          f"(terms={args.terms}, shards={args.shards}):")
+    print(hdr)
+    print("-" * len(hdr))
+    prev_warm = None
+    crossover = None
+    for card in cards:
+        nbytes = card * args.avg_value_bytes
+        cold = p.decide_probe(n_vals=card, dict_bytes=nbytes,
+                              n_terms=args.terms, resident=False,
+                              n_shards=args.shards, site="offline")
+        warm = p.decide_probe(n_vals=card, dict_bytes=nbytes,
+                              n_terms=args.terms, resident=True,
+                              staged_bytes=cold.inputs["staged_bytes"],
+                              n_shards=args.shards, site="offline")
+        print(f"{card:>13} {nbytes / (1 << 20):>8.1f}"
+              f"{_fmt_ms(cold.predicted_host_s)}"
+              f"{_fmt_ms(cold.predicted_device_s):>15}"
+              f"{_fmt_ms(warm.predicted_device_s):>15}"
+              f" {cold.target:>6} {warm.target:>6}")
+        if prev_warm is not None and warm.target != prev_warm:
+            crossover = card
+        prev_warm = warm.target
+    if crossover is not None:
+        print(f"\nHBM-resident crossover between the sampled points "
+              f"around {crossover} distinct values")
+    else:
+        print(f"\nno crossover in the sampled range: every resident "
+              f"decision is '{prev_warm}'")
+
+    if args.recent:
+        print(f"\nlast {args.recent} replayed decisions:")
+        for d in p.snapshot(recent=args.recent)["recent"]:
+            print(f"  {json.dumps(d)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -216,6 +216,17 @@ class HTTPApi:
             except (TypeError, ValueError):
                 pass
             return 200, PROFILER.snapshot(recent=recent)
+        if path == "/debug/planner":
+            # offload planner: decision ring, cost-model rates,
+            # predicted-vs-actual calibration (search/planner.py)
+            from tempo_tpu.search.planner import PLANNER
+
+            recent = 32
+            try:
+                recent = max(0, int(query.get("recent", recent)))
+            except (TypeError, ValueError):
+                pass
+            return 200, PLANNER.snapshot(recent=recent)
         if path == "/shutdown":
             threading.Thread(target=self.app.shutdown, daemon=True).start()
             return 200, "shutting down"

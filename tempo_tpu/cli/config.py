@@ -96,6 +96,16 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         search_profiling_fence=storage.get(
             "search_profiling_fence", False),
         search_profiling_ring=storage.get("search_profiling_ring", 256),
+        # adaptive host/device offload planner
+        # (docs/search-offload-planner.md): cost-model placement of the
+        # dictionary prefilter above the device-probe floor; false
+        # (default) keeps the static threshold behavior exactly
+        search_offload_planner_enabled=storage.get(
+            "search_offload_planner_enabled", False),
+        search_offload_planner_ewma=storage.get(
+            "search_offload_planner_ewma", 0.25),
+        search_offload_planner_ring=storage.get(
+            "search_offload_planner_ring", 256),
         # restartable host state (header snapshot + persistent XLA
         # compile cache); absent = auto (<wal_dir>/host-state), "" = off
         host_state_dir=storage.get("host_state_dir"),

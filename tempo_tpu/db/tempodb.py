@@ -87,6 +87,20 @@ class TempoDBConfig:
     # the HOST scan moves to the native memmem path); <= 0 keeps every
     # probe on the exact host path. None = the dict_probe default (50k).
     search_device_probe_min_vals: int | None = None
+    # adaptive host/device offload planner (search/planner.py): above
+    # the search_device_probe_min_vals floor, a cost model over the live
+    # dispatch-profiler observations chooses host vs device for the
+    # dictionary substring prefilter per block group at plan time —
+    # self-calibrating (EWMA over recent dispatches, seeded by a
+    # one-shot microbenchmark on first decision). False (default) is
+    # behavior-identical to the static-threshold path. Decisions +
+    # predicted-vs-actual error at /debug/planner. Both placements are
+    # exact, so results never depend on this flag.
+    search_offload_planner_enabled: bool = False
+    # EWMA smoothing for the planner's observed rates (higher = adapt
+    # faster, noisier) and the decision ring rendered by /debug/planner
+    search_offload_planner_ewma: float = 0.25
+    search_offload_planner_ring: int = 256
     # stage + compile-warm hot batches in the background after each poll
     # so the first query pays neither (off by default: polls in tests and
     # write-only processes must not spin up device work)
@@ -182,6 +196,28 @@ class TempoDB:
         _profile.configure(enabled=self.cfg.search_profiling_enabled,
                            fence=self.cfg.search_profiling_fence,
                            ring_size=self.cfg.search_profiling_ring)
+        # offload planner: process-wide like the profiler it feeds from
+        from tempo_tpu.search import planner as _planner
+
+        _planner.configure(enabled=self.cfg.search_offload_planner_enabled,
+                           alpha=self.cfg.search_offload_planner_ewma,
+                           ring_size=self.cfg.search_offload_planner_ring)
+        if (self.cfg.search_offload_planner_enabled
+                and not self.cfg.search_profiling_enabled):
+            # the planner's device-side feed (device-probe rate, compile/
+            # collective costs, h2d staging rate, jit shape-signature
+            # set) arrives exclusively through the dispatch profiler —
+            # with profiling off, decisions freeze at the one-shot
+            # microbenchmark seed and every compile-site device
+            # prediction keeps paying the compile penalty, biasing the
+            # planner toward host forever. Results stay correct either
+            # way, so warn rather than override the operator's config.
+            log.warning(
+                "search_offload_planner_enabled without "
+                "search_profiling_enabled: the planner cannot "
+                "self-calibrate (no dispatch-profiler feed) and will "
+                "decide from its microbenchmark seed only; enable "
+                "search_profiling_enabled for cost-model calibration")
         self._prewarm_stop = None  # Event cancelling the running prewarm
         self._prewarm_thread = None
         self._prewarm_atexit = False

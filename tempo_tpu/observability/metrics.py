@@ -339,6 +339,18 @@ coalesce_pending = Gauge("tempo_search_coalesce_pending_queries",
                          "queries parked in coalescing windows right now "
                          "(the coalescer queue depth)")
 
+# ---- offload planner (search/planner.py) ----
+offload_decisions = Counter(
+    "tempo_search_offload_decisions_total",
+    "offload-planner probe placements (target=host|device, "
+    "site=stage|compile|offline); only counted while the planner is "
+    "enabled — the static-threshold path books nothing")
+offload_predict_error = Histogram(
+    "tempo_search_offload_predict_error_ratio",
+    "relative |predicted - actual| / actual of the planner's chosen-side "
+    "probe cost, resolved when the matching probe run is observed",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0))
+
 # ---- self-tracing health (observability/tracing.py) ----
 selftrace_dropped_spans = Counter(
     "tempo_selftrace_dropped_spans_total",

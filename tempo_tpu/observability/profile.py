@@ -235,11 +235,18 @@ class DispatchProfiler:
         self._compile_seen: set = set()
         # aggregates over the process lifetime (cheap dict sums — the
         # histogram has the full distribution, this answers /debug/profile
-        # without a metrics scrape)
-        self._agg: dict[tuple, list] = {}   # (mode, stage) -> [n, total_s]
+        # without a metrics scrape); values are [n, total_s, total_bytes]
+        # so byte-carrying stages expose a replayable rate (the offload
+        # planner's offline calibration, scripts/calibrate_offload.py)
+        self._agg: dict[tuple, list] = {}   # (mode, stage) -> [n, s, bytes]
         self._jit = {"hit": 0, "miss": 0}
         self._bytes = {"h2d": 0, "d2h": 0}
         self._dispatches = 0
+        # consumers of finished records / stage observations (the offload
+        # planner's live feed, search/planner.py) — called OUTSIDE the
+        # lock, exceptions swallowed, only when profiling is enabled
+        self._listeners: list = []
+        self._stage_listeners: list = []
 
     # ---- call-site API ----
 
@@ -248,32 +255,61 @@ class DispatchProfiler:
             return NOOP_DISPATCH
         return Dispatch(self, mode)
 
+    def add_listener(self, fn) -> None:
+        """Subscribe to finished dispatch records (called with the
+        record's as_dict form). The offload planner's live feed."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def add_stage_listener(self, fn) -> None:
+        """Subscribe to out-of-record stage observations; called with
+        (stage, mode, seconds, nbytes)."""
+        with self._lock:
+            if fn not in self._stage_listeners:
+                self._stage_listeners.append(fn)
+
     def observe_stage(self, stage: str, mode: str, seconds: float,
                       nbytes: int = 0) -> None:
         """Record one stage observation outside a dispatch record (e.g.
         staging H2D that serves many later dispatches, or the drain-side
-        D2H fetch). Noop when disabled."""
+        D2H fetch). Noop when disabled. `nbytes` feeds the transfer
+        counters only for the transfer stages; other stages (the host
+        prefilter's scanned bytes) keep it in the aggregates alone."""
         if not self.enabled:
             return
         obs.dispatch_stage_seconds.observe(seconds, stage=stage, mode=mode)
+        transfer = stage in ("h2d", "d2h")
         with self._lock:
             k = (mode, stage)
             a = self._agg.get(k)
             if a is None:
-                a = self._agg[k] = [0, 0.0]
+                a = self._agg[k] = [0, 0.0, 0]
             a[0] += 1
             a[1] += seconds
-            if nbytes:
-                key = "h2d" if stage == "h2d" else "d2h"
-                self._bytes[key] += nbytes
-        if nbytes:
+            a[2] += nbytes
+            if nbytes and transfer:
+                self._bytes[stage] += nbytes
+        if nbytes and transfer:
             (obs.h2d_bytes if stage == "h2d" else obs.d2h_bytes).inc(nbytes)
+        for fn in self._stage_listeners:
+            try:
+                fn(stage, mode, seconds, nbytes)
+            except Exception:  # noqa: BLE001 — listeners never fail a scan
+                pass
         span = tracing.current_span()
         if span.recording:
             span.add_event("profile.stage", stage=stage, mode=mode,
                            ms=round(seconds * 1e3, 3))
 
     # ---- internals ----
+
+    def seen(self, key) -> bool:
+        """Whether this shape signature has been dispatched before —
+        WITHOUT recording it. The offload planner uses this to predict
+        whether a device decision would pay an XLA compile."""
+        with self._lock:
+            return key in self._compile_seen
 
     def _compile_miss(self, key) -> bool:
         with self._lock:
@@ -295,6 +331,7 @@ class DispatchProfiler:
             obs.h2d_bytes.inc(rec.h2d_bytes)
         if rec.d2h_bytes:
             obs.d2h_bytes.inc(rec.d2h_bytes)
+        rd = rec.as_dict()
         with self._lock:
             self._dispatches += 1
             if rec.jit is not None:
@@ -305,10 +342,19 @@ class DispatchProfiler:
                 k = (rec.mode, stage)
                 a = self._agg.get(k)
                 if a is None:
-                    a = self._agg[k] = [0, 0.0]
+                    a = self._agg[k] = [0, 0.0, 0]
                 a[0] += 1
                 a[1] += sec
-            self._ring.append(rec.as_dict())
+                if stage == "h2d":
+                    a[2] += rec.h2d_bytes
+                elif stage == "d2h":
+                    a[2] += rec.d2h_bytes
+            self._ring.append(rd)
+        for fn in self._listeners:
+            try:
+                fn(rd)
+            except Exception:  # noqa: BLE001 — listeners never fail a scan
+                pass
         span = tracing.current_span()
         if span.recording:
             span.add_event(
@@ -324,12 +370,16 @@ class DispatchProfiler:
         with self._lock:
             ring = list(self._ring)[-recent:] if recent > 0 else []
             agg = {}
-            for (mode, stage), (n, total) in sorted(self._agg.items()):
-                agg.setdefault(mode, {})[stage] = {
+            for (mode, stage), (n, total, nbytes) in sorted(
+                    self._agg.items()):
+                entry = {
                     "count": n,
                     "total_ms": round(total * 1e3, 3),
                     "mean_ms": round(total / n * 1e3, 3),
                 }
+                if nbytes:
+                    entry["bytes"] = nbytes
+                agg.setdefault(mode, {})[stage] = entry
             return {
                 "enabled": self.enabled,
                 "dispatches": self._dispatches,

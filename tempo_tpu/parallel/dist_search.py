@@ -40,26 +40,46 @@ class ShardedPages:
     device: dict          # name -> jnp array sharded over the page axis
     n_pages: int          # real page count (pre-padding)
     pages: ColumnarPages  # host container
+    # dict_probe.DeviceDict sharded over the VALUE axis when the block's
+    # dictionary cleared the device-probe threshold (and, with the
+    # offload planner enabled, its cost model — which charges the mesh
+    # probe's all_gather/collective overhead) at staging time
+    staged_dict: object = None
 
 
 class DistributedScanEngine:
     """Mesh-wide scan engine. API mirrors search.engine.ScanEngine but
     arrays live sharded across devices and the kernel runs under
-    shard_map."""
+    shard_map.
 
-    def __init__(self, mesh: Mesh, top_k: int = DEFAULT_TOP_K):
+    `probe_min_vals`: the device-probe staging threshold, with
+    cfg.search_device_probe_min_vals semantics everywhere: None = the
+    dict_probe default (50k), <= 0 forces host-only. The PARAMETER
+    default is 0 — constructing this engine without the knob keeps its
+    historical never-stage-dictionaries behavior (the serving path's
+    mesh batching lives in MultiBlockEngine, which has its own
+    plumbing)."""
+
+    def __init__(self, mesh: Mesh, top_k: int = DEFAULT_TOP_K,
+                 probe_min_vals: int | None = 0):
         self.mesh = mesh
         self.top_k = top_k
         self.n_shards = mesh.devices.size
+        self.probe_min_vals = probe_min_vals
 
     # ---- staging ----
 
     def stage(self, pages: ColumnarPages) -> ShardedPages:
         """Pad the page axis to a multiple of the shard count and place
-        each array with a NamedSharding over the scan axis."""
+        each array with a NamedSharding over the scan axis. Value
+        dictionaries above the probe threshold stage value-axis-sharded
+        for the mesh probe kernel (planner-vetoed like every other
+        staging site — the decision accounts the all_gather cost via its
+        n_shards input)."""
         import time
 
         from tempo_tpu.observability import profile
+        from tempo_tpu.search.engine import stage_block_dict
 
         n = self.n_shards
         B = -(-pages.n_pages // n) * n
@@ -71,7 +91,10 @@ class DistributedScanEngine:
         profile.observe_stage("h2d", "mesh", time.perf_counter() - t0,
                               nbytes=sum(int(v.nbytes)
                                          for v in host.values()))
-        return ShardedPages(device=dev, n_pages=pages.n_pages, pages=pages)
+        sd = stage_block_dict(pages, self.probe_min_vals,
+                              n_shards=self.n_shards, mesh=self.mesh)
+        return ShardedPages(device=dev, n_pages=pages.n_pages, pages=pages,
+                            staged_dict=sd)
 
     # ---- kernel ----
 

@@ -99,6 +99,18 @@ class PackedDeviceDict:
         return int(self.buf.nbytes + self.pos.nbytes + self.off.nbytes
                    + self.n_real.nbytes)
 
+    @property
+    def real_bytes(self) -> int:
+        """Unpadded dictionary byte length (the host scan's work unit;
+        the offload planner's host-cost input). Derived from the shard
+        offsets — no dictionary walk."""
+        hit = getattr(self, "_real_bytes", None)
+        if hit is None:
+            S = self.n_shards
+            hit = int(self.off[np.arange(S), self.n_real].sum())
+            self._real_bytes = hit
+        return hit
+
 
 @dataclass
 class DeviceDict:
@@ -126,6 +138,9 @@ def pack_device_dict(val_dict: list, n_shards: int = 1,
     `n_shards` contiguous value ranges (the mesh's value-axis split; 1
     when unsharded). Byte and value axes pad to power-of-two buckets so
     the probe kernel compiles once per (size-bucket, needle-bucket)."""
+    import time as _time
+
+    t_pack0 = _time.perf_counter()
     n_vals = len(val_dict)
     S = max(1, int(n_shards))
     v_shard = _pow2(max(1, -(-n_vals // S)))
@@ -158,9 +173,16 @@ def pack_device_dict(val_dict: list, n_shards: int = 1,
             buf[s, :nb] = np.frombuffer(blob, dtype=np.uint8)
             pos[s, :nb] = np.repeat(
                 np.arange(hi - lo, dtype=np.int32), ln)
-    return PackedDeviceDict(n_vals=n_vals, n_shards=S, v_shard=v_shard,
-                            buf=buf, pos=pos, off=off, n_real=n_real,
-                            fingerprint=fingerprint)
+    out = PackedDeviceDict(n_vals=n_vals, n_shards=S, v_shard=v_shard,
+                           buf=buf, pos=pos, off=off, n_real=n_real,
+                           fingerprint=fingerprint)
+    from . import planner
+
+    # pack cost is part of a non-resident device decision: feed the
+    # planner's rate (noop when the planner is disabled)
+    planner.PLANNER.observe("pack", _time.perf_counter() - t_pack0,
+                            nbytes=out.real_bytes)
+    return out
 
 
 def place_device_dict(packed: PackedDeviceDict, mesh=None,
@@ -334,7 +356,14 @@ def probe_value_hits(ddev: DeviceDict, needles: list[bytes]):
             ("probe", ddev.mesh is not None, d["buf"].shape,
              d["off"].shape, T, Lp))
         stage = "compile" if miss else "execute"
-        rec.set(n_vals=ddev.n_vals, n_terms=T)
+        # probe_bytes/fp: the offload planner's device-rate feed — it
+        # listens on finished dispatch records (mode=dict_probe) and
+        # needs the work size (terms × staged bytes) plus the dictionary
+        # identity to resolve predicted-vs-actual error
+        rec.set(n_vals=ddev.n_vals, n_terms=T,
+                probe_bytes=T * ddev.nbytes,
+                fp=(ddev.packed.fingerprint.hex()[:16]
+                    if ddev.packed.fingerprint else None))
         if ddev.mesh is not None:
             from tempo_tpu.parallel.mesh import locked_collective
 

@@ -27,6 +27,8 @@ bucket and reuses; everything is int32/uint32/bool — VPU-native, no MXU
 from __future__ import annotations
 
 import functools
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import time
@@ -105,11 +107,20 @@ def stage(pages: ColumnarPages, page_bucket: int | None = None,
                        staged_dict=sd)
 
 
-def stage_block_dict(pages: ColumnarPages, probe_min_vals: int | None):
+def stage_block_dict(pages: ColumnarPages, probe_min_vals: int | None,
+                     n_shards: int = 1, mesh=None):
     """DeviceDict for one block's value dictionary when it clears the
-    device-probe threshold, else None. Shared by the single-block stage
-    and the batched stack_host staging."""
-    from . import dict_probe
+    device-probe threshold, else None. Shared by the single-block stage,
+    the batched stack_host staging, and the distributed engine
+    (n_shards/mesh shard the value axis).
+
+    The static threshold is the FLOOR: below it (or <= 0) the probe
+    stays on host unconditionally. Above it, the offload planner — when
+    enabled — can veto the staging ("host" decision), so a CPU-bound
+    process never uploads hundreds of MB of dictionary bytes the probe
+    kernel would lose on anyway; planner disabled keeps the static
+    behavior exactly."""
+    from . import dict_probe, planner
     from .pipeline import _dict_fingerprint
 
     mv = (dict_probe.DEVICE_PROBE_MIN_VALS if probe_min_vals is None
@@ -117,7 +128,10 @@ def stage_block_dict(pages: ColumnarPages, probe_min_vals: int | None):
     if mv <= 0 or len(pages.val_dict) < mv:
         return None
     fp = _dict_fingerprint(pages, pages.key_dict, pages.val_dict)
-    return dict_probe.stage_val_dict(pages.val_dict, fingerprint=fp,
+    if planner.stage_veto(pages, fp, n_shards=n_shards):
+        return None
+    return dict_probe.stage_val_dict(pages.val_dict, n_shards=n_shards,
+                                     mesh=mesh, fingerprint=fp,
                                      cache_on=pages)
 
 
@@ -264,6 +278,34 @@ def scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
     return count, inspected, top_scores, top_idx
 
 
+_SCALAR_CACHE: OrderedDict = OrderedDict()
+_scalar_lock = threading.Lock()
+_SCALAR_CACHE_MAX = 512
+
+
+def device_scalar(v: int):
+    """uint32 scalar as a device array, memoized by VALUE across
+    dispatches and queries. Every compiled query uploads four of these
+    (duration/window bounds) and the common values — 0 and UINT32_MAX
+    for unbounded requests — recur on essentially every query; through a
+    TPU relay each tiny H2D put costs ~ms (the engine.py query-param
+    docstring's measured 3x), so re-putting the same four scalars per
+    query was pure relay tax. Bounded LRU; jit treats equal-valued
+    scalars identically, so sharing is invisible to the cache keys."""
+    v = int(v)
+    with _scalar_lock:
+        hit = _SCALAR_CACHE.get(v)
+        if hit is not None:
+            _SCALAR_CACHE.move_to_end(v)
+            return hit
+    arr = jnp.uint32(v)
+    with _scalar_lock:
+        _SCALAR_CACHE[v] = arr
+        while len(_SCALAR_CACHE) > _SCALAR_CACHE_MAX:
+            _SCALAR_CACHE.popitem(last=False)
+    return arr
+
+
 class ScanEngine:
     """Single-device scan orchestration: staging cache + kernel dispatch +
     host-side result rendering. The distributed variant lives in
@@ -281,13 +323,18 @@ class ScanEngine:
         cached on the CompiledQuery — one search fans out over many
         blocks/pages with the same query, and through a TPU relay each
         small H2D transfer costs ~ms (measured: uncached params tripled
-        per-scan latency)."""
+        per-scan latency). The scalar bounds additionally memoize BY
+        VALUE across queries (device_scalar), so a fresh query with the
+        default unbounded window re-uploads nothing but its term
+        tables."""
         cached = getattr(cq, "_device_params", None)
         if cached is None:
             cached = (
                 jnp.asarray(cq.term_keys), jnp.asarray(cq.val_ranges),
-                jnp.uint32(cq.dur_lo), jnp.uint32(min(cq.dur_hi, 0xFFFFFFFF)),
-                jnp.uint32(cq.win_start), jnp.uint32(min(cq.win_end, 0xFFFFFFFF)),
+                device_scalar(cq.dur_lo),
+                device_scalar(min(cq.dur_hi, 0xFFFFFFFF)),
+                device_scalar(cq.win_start),
+                device_scalar(min(cq.win_end, 0xFFFFFFFF)),
             )
             object.__setattr__(cq, "_device_params", cached)
         return cached

@@ -103,8 +103,18 @@ def _pack_batch_dicts(blocks: list[ColumnarPages],
     """fp -> PackedDeviceDict for every DISTINCT value dictionary above
     the device-probe threshold (None = dict_probe default; <= 0
     disables). Packing memoizes on the immutable block container, so an
-    evicted batch restacked from the same blocks packs nothing."""
-    from . import dict_probe
+    evicted batch restacked from the same blocks packs nothing.
+
+    With the offload planner enabled, dictionaries above the floor get a
+    per-GROUP stage-time decision (once per distinct dictionary per
+    staged batch — a fused multi-query dispatch over this batch then
+    inherits one verdict, never re-plans per member): a "host" verdict
+    skips the pack+stage entirely, so the HBM and H2D investment is only
+    made where the cost model says the device probe pays it back. The
+    verdict is frozen into the staged batch until it re-stages (HBM
+    eviction, blocklist churn) — the same lifetime every other staging
+    property has."""
+    from . import dict_probe, planner
     from .pipeline import _dict_fingerprint
 
     mv = (dict_probe.DEVICE_PROBE_MIN_VALS if probe_min_vals is None
@@ -113,14 +123,21 @@ def _pack_batch_dicts(blocks: list[ColumnarPages],
     if mv <= 0:
         return out
     S = max(1, int(n_shards))
+    vetoed: set = set()  # host verdicts memoize like device ones: ONE
+    # decision per distinct dictionary per staged batch, even when many
+    # blocks share a vetoed dictionary (no per-block ring/metric spam)
     for b in blocks:
         if len(b.val_dict) < mv:
             continue
         fp = _dict_fingerprint(b, b.key_dict, b.val_dict)
-        if fp in out:
+        if fp in out or fp in vetoed:
+            continue
+        if planner.stage_veto(b, fp, n_shards=S):
+            vetoed.add(fp)
             continue
         hit = getattr(b, "_device_dict_packed", None)
-        if hit is not None and hit.n_shards == S:
+        packed_ok = hit is not None and hit.n_shards == S
+        if packed_ok:
             out[fp] = hit
         else:
             out[fp] = b._device_dict_packed = dict_probe.pack_device_dict(

@@ -207,6 +207,7 @@ def compile_query(key_dict: list, val_dict: list,
     versa) — both are exact, only the kernel's membership test
     differs."""
     sig = None
+    fp = None
     if cache_on is not None:
         sig = _tags_sig(req)
         fp = _dict_fingerprint(cache_on, key_dict, val_dict)
@@ -226,7 +227,7 @@ def compile_query(key_dict: list, val_dict: list,
             return None if isinstance(hit, str) else _from_probe(hit, req)
 
     out = _probe_tags(key_dict, val_dict, req, packed_vals,
-                      staged_dict=staged_dict)
+                      staged_dict=staged_dict, fp=fp)
     if sig is not None:
         with _compile_cache_lock:
             cache = _COMPILE_CACHE.get(fp)
@@ -298,17 +299,51 @@ def _device_probe_tags(terms, key_dict, staged_dict, exhaustive):
     return term_keys, term_vals, val_ranges, hits
 
 
+def _use_device_probe(staged_dict, terms, fp) -> bool:
+    """Placement for a staged dictionary's substring probe. Static path
+    (planner disabled): staged == device, exactly the pre-planner
+    behavior. Planner enabled: the cost model chooses — its "host"
+    verdict falls through to the exact host scan even though the packed
+    bytes sit in HBM (both paths are exact; only the time moves). The
+    decision memoizes through the compile cache: one verdict per
+    (dictionary, tag-set), shared by every block of the group and every
+    member of a coalesced dispatch."""
+    from . import dict_probe, planner
+
+    p = planner.PLANNER
+    if not p.enabled:
+        return True
+    lmax = max(len(v.encode("utf-8")) for _, v in terms)
+    if lmax > dict_probe.MAX_NEEDLE_BYTES:
+        return False  # host fallback regardless — no decision burned
+    packed = staged_dict.packed
+    T = len(terms)
+    Lp = dict_probe._pow2(max(1, lmax))
+    # the probe kernel's jit signature (dict_probe.probe_value_hits) —
+    # lets the planner predict whether a device choice pays a compile
+    shape_key = ("probe", staged_dict.mesh is not None,
+                 tuple(packed.buf.shape), tuple(packed.off.shape), T, Lp)
+    d = p.decide_probe(
+        n_vals=packed.n_vals, dict_bytes=packed.real_bytes, n_terms=T,
+        resident=True, packed=True, staged_bytes=staged_dict.nbytes,
+        n_shards=(packed.n_shards if staged_dict.mesh is not None else 1),
+        shape_key=shape_key, fp=packed.fingerprint or fp, site="compile")
+    return d.target == "device"
+
+
 def _probe_tags(key_dict: list, val_dict: list, req,
-                packed_vals: tuple | None, staged_dict=None):
+                packed_vals: tuple | None, staged_dict=None, fp=None):
     """The expensive, tags-only part of compilation: binary-search keys,
     then either the host substring scan folded to range sets, or the
-    device probe (staged_dict present) yielding a device hit mask.
+    device probe (staged_dict present, and — when the offload planner is
+    enabled — the cost model picks device) yielding a device hit mask.
     Returns (term_keys, term_vals, val_ranges, val_hits) or None
     (pruned)."""
     exhaustive = is_exhaustive(req)
     terms = sorted((k, v) for k, v in req.tags.items()
                    if k != EXHAUSTIVE_SEARCH_TAG)
-    if staged_dict is not None and terms:
+    if staged_dict is not None and terms \
+            and _use_device_probe(staged_dict, terms, fp):
         try:
             return _device_probe_tags(terms, key_dict, staged_dict,
                                       exhaustive)
@@ -317,18 +352,29 @@ def _probe_tags(key_dict: list, val_dict: list, req,
     if terms:
         # the host memmem walk is PR4's motivating cost (312ms at 10M
         # distinct values) — record it under its own mode so the stage
-        # histogram shows host-vs-device probe cost side by side
+        # histogram shows host-vs-device probe cost side by side, and
+        # feed the offload planner's host-side rate (with the dictionary
+        # fingerprint, so predicted-vs-actual error resolves)
         import time as _time
 
         from tempo_tpu.observability import profile
+        from . import planner
 
+        # bytes are estimated unconditionally (O(256) sample): a
+        # planner-DISABLED deployment's /debug/profile dump must still
+        # carry the host-probe byte totals, or the offline calibration
+        # replay (scripts/calibrate_offload.py) — whose whole point is
+        # deciding if the planner is worth enabling — falls back to the
+        # hardcoded default host rate instead of this host's measured one
+        nb = len(terms) * planner.dict_bytes_est(val_dict)
         t0 = _time.perf_counter()
         try:
             return _host_probe_tags(terms, key_dict, val_dict,
                                     packed_vals, exhaustive)
         finally:
-            profile.observe_stage("build", "host_probe",
-                                  _time.perf_counter() - t0)
+            dt = _time.perf_counter() - t0
+            profile.observe_stage("build", "host_probe", dt, nbytes=nb)
+            planner.PLANNER.observe("host_probe", dt, nbytes=nb, fp=fp)
     return _host_probe_tags(terms, key_dict, val_dict, packed_vals,
                             exhaustive)
 

@@ -399,7 +399,8 @@ def test_debug_endpoints_gated_off(app):
     (cli/config.py server.debug_endpoints, default false) can turn the
     routes off — they answer 404, everything else still works."""
     api = HTTPApi(app, debug_endpoints=False)
-    for p in ("/debug/threads", "/debug/scan", "/debug/profile"):
+    for p in ("/debug/threads", "/debug/scan", "/debug/profile",
+              "/debug/planner"):
         code, body = api.handle("GET", p, {}, {})
         assert code == 404, (p, code)
         assert "disabled" in body["error"]
