@@ -1174,6 +1174,139 @@ def phase_profile_overhead():
     return result
 
 
+def phase_query_stats_overhead():
+    """Per-query inspector contract (docs/search-query-stats.md):
+    `search_query_stats_enabled: false` is a TRUE noop — byte-identical
+    results either way — and the enabled per-query record protocol
+    (begin + contextvar activation + the typical per-group records +
+    finish/publish) must cost < 2% of a query. Same shape as
+    profile_overhead: the asserted bound is the deterministic protocol
+    cost (the wall A/B delta rides along, informational)."""
+    from tempo_tpu import tempopb
+    from tempo_tpu.search import query_stats
+    from tempo_tpu.search.batcher import BlockBatcher, ScanJob
+
+    n_entries = int(os.environ.get("BENCH_QSTATS_ENTRIES", 65_536))
+    iters = int(os.environ.get("BENCH_QSTATS_ITERS", 60))
+    n_blocks = 4
+    blocks = [build_corpus(max(1024, n_entries // n_blocks), seed=s)
+              for s in range(n_blocks)]
+
+    def mk_jobs():
+        jobs = []
+        for i, b in enumerate(blocks):
+            hdr = dict(b.header)
+            jobs.append(ScanJob(
+                key=(f"qs-{i}", 0, b.n_pages),
+                pages_fn=(lambda b=b: b), header=hdr,
+                n_pages=b.n_pages, n_entries=hdr["n_entries"],
+                geometry=(hdr["entries_per_page"], hdr["kv_per_entry"])))
+        return jobs
+
+    req = tempopb.SearchRequest()
+    req.tags["service.name"] = "svc-007"
+    req.tags["http.status_code"] = "500"
+    req.limit = 20
+    batcher = BlockBatcher()
+    jobs = mk_jobs()
+
+    def one_query(enabled: bool):
+        qs = query_stats.begin("bench", req) if enabled else None
+        with query_stats.activate(qs):
+            res = batcher.search(jobs, req)
+        if qs is not None:
+            qs.finish()
+        return res.response()
+
+    query_stats.configure(enabled=True)
+    warm = one_query(True)  # stage + compile
+    t_on, t_off = [], []
+    r_on = r_off = None
+    try:
+        for _ in range(3):
+            query_stats.configure(enabled=False)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r_off = one_query(False)
+            t_off.append(time.perf_counter() - t0)
+            query_stats.configure(enabled=True)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r_on = one_query(True)
+            t_on.append(time.perf_counter() - t0)
+    finally:
+        query_stats.configure(enabled=True)
+    query_us = min(t_on) / iters * 1e6
+    ab_overhead_pct = (min(t_on) - min(t_off)) / min(t_off) * 100
+
+    # byte-identity: the disabled and enabled paths must return the
+    # same traces, and the LEGACY metrics must match exactly — only the
+    # stats fields may differ
+    def strip(resp):
+        r = tempopb.SearchResponse()
+        r.CopyFrom(resp)
+        r.metrics.device_seconds = 0
+        r.metrics.inspected_bytes_device = 0
+        r.metrics.query_stats_json = ""
+        return r.SerializeToString()
+
+    identical = strip(r_on) == strip(r_off) == strip(warm)
+    assert identical, "query-stats on/off responses diverged"
+
+    # deterministic protocol cost: the exact per-query record sequence
+    # a 4-group search performs, enabled vs disabled
+    def protocol_loop(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            qs = query_stats.begin("bench", req)
+            with query_stats.activate(qs):
+                inner = query_stats.current()
+                if inner is not None:
+                    for _g in range(4):
+                        inner.add_cache("hbm_hit")
+                        inner.add_inspected(blocks=1, nbytes=4096)
+                        inner.add_device_stages({"execute": 1e-6},
+                                                fused_q=2)
+                        inner.add_device_stages({"d2h": 1e-7},
+                                                count=False)
+                    inner.add_skip("time_range", 2)
+                    for st in ("header_prune", "staging", "prepare",
+                               "dispatch", "drain"):
+                        inner.add_stage(st, 1e-6)
+            if qs is not None:
+                qs.finish()
+        return time.perf_counter() - t0
+
+    N_PROTO = 5_000
+    protocol_loop(500)  # warm
+    query_stats.configure(enabled=True)
+    record_us = min(protocol_loop(N_PROTO) for _ in range(3)) \
+        / N_PROTO * 1e6
+    query_stats.configure(enabled=False)
+    try:
+        noop_us = min(protocol_loop(N_PROTO) for _ in range(3)) \
+            / N_PROTO * 1e6
+    finally:
+        query_stats.configure(enabled=True)
+    overhead_pct = (record_us - noop_us) / query_us * 100
+    result = {
+        "n_entries": n_entries,
+        "iters_per_rep": iters,
+        "query_us": round(query_us, 1),
+        "record_cost_us": round(record_us - noop_us, 2),
+        "noop_cost_us": round(noop_us, 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "ab_overhead_pct": round(ab_overhead_pct, 3),
+        "within_2pct": overhead_pct < 2.0,
+        "byte_identical": identical,
+    }
+    assert overhead_pct < 2.0, (
+        f"query-stats record cost {record_us - noop_us:.1f}us is "
+        f"{overhead_pct:.2f}% of the {query_us:.0f}us query — exceeds "
+        "the 2% budget")
+    return result
+
+
 def phase_scale_10k():
     n_blocks = int(os.environ.get("BENCH_SCALE_BLOCKS", 10_000))
     if not n_blocks:
@@ -1202,6 +1335,7 @@ PHASES = {
     "high_cardinality": phase_high_cardinality,
     "high_cardinality_full": phase_high_cardinality_full,
     "profile_overhead": phase_profile_overhead,
+    "query_stats_overhead": phase_query_stats_overhead,
     "scale_10k": phase_scale_10k,
     "scale_large_blocks": phase_scale_large_blocks,
 }
@@ -1218,6 +1352,7 @@ PHASE_TIMEOUTS = {
     "high_cardinality": 300.0,
     "high_cardinality_full": 420.0,
     "profile_overhead": 300.0,
+    "query_stats_overhead": 300.0,
     "scale_10k": 900.0,
     "scale_large_blocks": 1200.0,
 }
@@ -1460,6 +1595,12 @@ def _assemble(results: dict) -> dict:
         prof["stages"] = prof_stages
     if prof:
         doc["detail"]["profile"] = prof
+    # per-query stats noop/overhead contract rides the trajectory like
+    # the profiler's (byte_identical + within_2pct are the acceptance)
+    qso = results.get("query_stats_overhead")
+    if isinstance(qso, dict):
+        doc["detail"]["query_stats"] = (
+            qso if not _failed(qso) else {"error": qso.get("error")})
     if not ok:
         err = (single or {}).get(
             "error", "headline phase 'single' did not run")
@@ -1475,9 +1616,14 @@ def _assemble(results: dict) -> dict:
         if isinstance(degraded, str) and degraded.startswith("cpu-fallback"):
             # the headline metric contract is TPU-vs-CPU; a CPU-only run
             # must read as an infra failure to consumers that only look at
-            # value/vs_baseline — its numbers live in detail.configs only
+            # value/vs_baseline — its numbers live in detail.configs only.
+            # device_wedged + wedge_reason make the failure FIRST-CLASS in
+            # the headline: r04/r05 recorded zeroed fallback numbers that
+            # were indistinguishable from a real perf regression
             doc["value"] = 0
             doc["vs_baseline"] = 0
+            doc["device_wedged"] = True
+            doc["wedge_reason"] = degraded
             doc["error"] = ("TPU preflight failed; CPU-fallback numbers "
                             "recorded in detail.configs only")
     return doc

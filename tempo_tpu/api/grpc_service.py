@@ -194,7 +194,11 @@ def make_module_grpc_server(address: str, *, pusher=None, ingester=None,
         handlers.append(make_oc_handler(otlp_push, tenant_from=_tenant_from))
 
     server.add_generic_rpc_handlers(tuple(handlers))
-    server.add_insecure_port(address)
+    # keep the ACTUAL bound port on the server: an ephemeral bind
+    # (":0") only knows its port here, and callers (ModuleProcess)
+    # advertise it over gossip — the race-free alternative to probing
+    # for a free port and hoping it is still free at bind time
+    server.bound_port = server.add_insecure_port(address)
     return server
 
 

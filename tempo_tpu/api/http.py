@@ -57,6 +57,15 @@ class TextBody(str):
         return self
 
 
+def _int_param(query: dict, key: str, default: int) -> int:
+    """Non-negative int query param with a default (the /debug routes'
+    `recent` knob); garbage falls back rather than 500s a debug page."""
+    try:
+        return max(0, int(query.get(key, default)))
+    except (TypeError, ValueError):
+        return default
+
+
 def _route_template(path: str) -> str:
     """Collapse variable path segments so span names stay low-cardinality
     (OTel convention: name by route, real path in http.target)."""
@@ -210,23 +219,23 @@ class HTTPApi:
             # process-lifetime aggregates (observability/profile.py)
             from tempo_tpu.observability.profile import PROFILER
 
-            recent = 32
-            try:
-                recent = max(0, int(query.get("recent", recent)))
-            except (TypeError, ValueError):
-                pass
-            return 200, PROFILER.snapshot(recent=recent)
+            return 200, PROFILER.snapshot(
+                recent=_int_param(query, "recent", 32))
         if path == "/debug/planner":
             # offload planner: decision ring, cost-model rates,
             # predicted-vs-actual calibration (search/planner.py)
             from tempo_tpu.search.planner import PLANNER
 
-            recent = 32
-            try:
-                recent = max(0, int(query.get("recent", recent)))
-            except (TypeError, ValueError):
-                pass
-            return 200, PLANNER.snapshot(recent=recent)
+            return 200, PLANNER.snapshot(
+                recent=_int_param(query, "recent", 32))
+        if path == "/debug/querystats":
+            # per-query inspector: recent queries, per-tenant
+            # device-seconds/bytes aggregates, top-K by cost
+            # (search/query_stats.py)
+            from tempo_tpu.search.query_stats import REGISTRY
+
+            return 200, REGISTRY.snapshot(
+                recent=_int_param(query, "recent", 32))
         if path == "/shutdown":
             threading.Thread(target=self.app.shutdown, daemon=True).start()
             return 200, "shutting down"
@@ -250,13 +259,31 @@ class HTTPApi:
             return code, json_format.MessageToDict(resp.trace)
         if path == PATH_SEARCH:
             req = parse_search_request(query)
+            # explain opt-in: ?explain=1 (parse_search_request) or the
+            # X-Tempo-Explain header — the response then carries the
+            # full per-query execution breakdown. Same value set as the
+            # query param: "X-Tempo-Explain: 0" must NOT opt in
+            if hasattr(headers, "get") and \
+                    (headers.get("X-Tempo-Explain") or "").strip().lower() \
+                    in ("1", "true", "yes"):
+                req.explain = True
             resp = self.app.search(tenant, req)
             # tolerated block failures = partial answer (reference
             # frontend.go:144-146 semantics, extended to search)
             code = 206 if resp.metrics.failed_blocks else 200
             if want_proto:
                 return code, resp.SerializeToString()
-            return code, json_format.MessageToDict(resp)
+            doc = json_format.MessageToDict(resp)
+            if resp.metrics.query_stats_json:
+                # inline the breakdown as a real JSON object instead of
+                # an escaped string riding the metrics message
+                try:
+                    doc["queryStats"] = json.loads(
+                        resp.metrics.query_stats_json)
+                    doc.get("metrics", {}).pop("queryStatsJson", None)
+                except ValueError:
+                    pass
+            return code, doc
         if path == PATH_SEARCH_TAGS:
             resp = self.app.queriers[0].search_tags(tenant)
             return 200, json_format.MessageToDict(resp)
@@ -317,6 +344,8 @@ class HTTPApi:
         if path == "/status/config":
             # reference /status/config?mode=diff|defaults (app.go:332-378)
             return self._status_config((query or {}).get("mode", ""))
+        from tempo_tpu.observability.profile import device_status
+
         out = {
             "ready": app.ready(),
             "ring": {
@@ -324,6 +353,11 @@ class HTTPApi:
                 "healthy": app.ring.healthy_count(),
                 "replication_factor": app.ring.rf,
             },
+            # accelerator health at a glance: backend, device count,
+            # age of the last successful dispatch — the wedge-vs-idle
+            # signal bench r04/r05 lacked (never initializes a backend
+            # on processes that haven't touched the device)
+            "device": device_status(),
         }
         db = getattr(app, "reader_db", None)
         if db is not None:  # targets without a storage reader (distributor)

@@ -74,6 +74,13 @@ def parse_search_request(query: dict[str, str]) -> tempopb.SearchRequest:
         req.limit = int(query.get("limit", 0) or 0)
         req.start = int(query.get("start", 0) or 0)
         req.end = int(query.get("end", 0) or 0)
+        # per-query execution breakdown opt-in (docs/search-query-stats
+        # .md); in the param set so the frontend↔querier URL round-trip
+        # stays lossless. Same normalization as the X-Tempo-Explain
+        # header path (api/http.py)
+        if query.get("explain", "").strip().lower() in ("1", "true",
+                                                        "yes"):
+            req.explain = True
         return req
     except ValueError as e:
         # query-param parse failures are CLIENT errors (400), never the
@@ -95,6 +102,8 @@ def build_search_request(req: tempopb.SearchRequest) -> str:
         q["start"] = str(req.start)
     if req.end:
         q["end"] = str(req.end)
+    if req.explain:
+        q["explain"] = "1"
     return urllib.parse.urlencode(q)
 
 

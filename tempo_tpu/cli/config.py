@@ -96,6 +96,16 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         search_profiling_fence=storage.get(
             "search_profiling_fence", False),
         search_profiling_ring=storage.get("search_profiling_ring", 256),
+        # per-query execution inspector (docs/search-query-stats.md):
+        # per-tenant device-seconds accounting, slow-query log,
+        # /debug/querystats, ?explain=1; false is a true noop on the
+        # search path
+        search_query_stats_enabled=storage.get(
+            "search_query_stats_enabled", True),
+        search_slow_query_log_s=storage.get(
+            "search_slow_query_log_s", 10.0),
+        search_query_stats_ring=storage.get(
+            "search_query_stats_ring", 256),
         # adaptive host/device offload planner
         # (docs/search-offload-planner.md): cost-model placement of the
         # dictionary prefilter above the device-probe floor; false

@@ -118,6 +118,23 @@ class TempoDBConfig:
     search_profiling_fence: bool = False
     # recent-dispatch ring rendered by /debug/profile
     search_profiling_ring: int = 256
+    # per-query execution inspector (search/query_stats.py): every
+    # search accumulates blocks scanned/skipped (and why), bytes split
+    # host vs device, cache hits vs re-stages, planner decisions, and
+    # per-stage device-seconds attributed from its (possibly fused)
+    # dispatches — feeding the per-tenant accounting counters, the
+    # slow-query log, /debug/querystats, and the opt-in ?explain=1
+    # response breakdown. False is a true noop on the search path
+    # (bench phase query_stats_overhead asserts the contract);
+    # results are byte-identical either way.
+    search_query_stats_enabled: bool = True
+    # slow-query log threshold (seconds): a query slower than this
+    # emits ONE structured JSON log line (tenant, self-trace id, the
+    # complete QueryStats), rate-limited process-wide. <= 0 disables
+    # the log; the tempo_search_slow_queries_total counter still counts.
+    search_slow_query_log_s: float = 10.0
+    # recent-query ring rendered by /debug/querystats
+    search_query_stats_ring: int = 256
     # shard batches over the device mesh when >1 device is visible
     auto_mesh: bool = True
     # restartable host state (VERDICT r4 #3): None = auto (persistent
@@ -196,6 +213,14 @@ class TempoDB:
         _profile.configure(enabled=self.cfg.search_profiling_enabled,
                            fence=self.cfg.search_profiling_fence,
                            ring_size=self.cfg.search_profiling_ring)
+        # per-query stats: process-wide like the profiler (most recent
+        # TempoDB's config wins, the REGISTRY idiom)
+        from tempo_tpu.search import query_stats as _query_stats
+
+        _query_stats.configure(
+            enabled=self.cfg.search_query_stats_enabled,
+            slow_s=self.cfg.search_slow_query_log_s,
+            ring_size=self.cfg.search_query_stats_ring)
         # offload planner: process-wide like the profiler it feeds from
         from tempo_tpu.search import planner as _planner
 
@@ -593,11 +618,14 @@ class TempoDB:
         result limit. Blocks without a search container fall back to the
         trace-block proto scan (reference backend_block.go:159-209)."""
         from tempo_tpu.backend.raw import DoesNotExist
+        from tempo_tpu.search import query_stats
 
         results = results or SearchResults.for_request(req)
         self._ensure_mesh()
+        qs = query_stats.begin(tenant, req)
         with obs.query_seconds.time(op="search"), \
-                tracing.start_span("tempodb.Search", tenant=tenant) as span:
+                tracing.start_span("tempodb.Search", tenant=tenant) as span, \
+                query_stats.activate(qs):
             # the job list is a function of the blocklist alone (time
             # pruning happens in the batcher's memoized header prune, so
             # stale-window blocks cost a cached skip, not staging): cache
@@ -642,6 +670,8 @@ class TempoDB:
                 live = [m for m in fallback
                         if self._include_block(m, "", "", req.start, req.end)]
                 results.metrics.skipped_blocks += len(fallback) - len(live)
+                if qs is not None and len(fallback) > len(live):
+                    qs.add_skip("time_range", len(fallback) - len(live))
                 if live:
                     self._fallback_search(live, req, results)
             span.set_attributes(
@@ -649,8 +679,29 @@ class TempoDB:
                 inspected_blocks=results.metrics.inspected_blocks,
                 skipped_blocks=results.metrics.skipped_blocks,
                 fallback_blocks=len(fallback))
+            if qs is not None:
+                self._finalize_query_stats(qs, req, results)
         obs.search_inspected.inc(results.metrics.inspected_traces, tenant=tenant)
         return results
+
+    @staticmethod
+    def _finalize_query_stats(qs, req, results) -> None:
+        """Close the per-query record and surface it on the response:
+        the device-seconds / device-bytes totals ALWAYS ride the
+        SearchMetrics proto (they cross the frontend/querier process
+        boundary and sum in the frontend merge); the full JSON
+        breakdown rides only under the explain opt-in. finish() also
+        publishes to the registry: per-tenant counters, the
+        /debug/querystats ring, and the slow-query log."""
+        import json as _json
+
+        d = qs.finish()
+        m = results.metrics
+        m.device_seconds += d["device_seconds"]
+        m.inspected_bytes_device += int(qs.bytes_device)
+        if getattr(req, "explain", False):
+            m.query_stats_json = _json.dumps(d, separators=(",", ":"),
+                                             sort_keys=True)
 
     def _fallback_search(self, metas: list[BlockMeta], req,
                          results: SearchResults) -> None:
@@ -661,20 +712,32 @@ class TempoDB:
         page ranges address the container's page space, not this one."""
         from tempo_tpu.model.matches import matches as proto_matches
         from tempo_tpu.model.matches import trace_search_metadata
+        from tempo_tpu.search import query_stats
 
-        for m in metas:
-            block = BackendBlock(self.backend, m)
-            codec = codec_for(m.data_encoding)
-            obs.fallback_scans.inc(tenant=m.tenant_id)
-            results.metrics.inspected_blocks += 1
-            results.metrics.inspected_bytes += block.bytes_in_pages(0, None)
-            for oid, obj in block.iter_objects():
-                results.metrics.inspected_traces += 1
-                trace = codec.prepare_for_read(obj)
-                if proto_matches(trace, req):
-                    results.add(trace_search_metadata(oid, trace))
-                if results.complete:
-                    return
+        qs = query_stats.current()
+        t0 = time.perf_counter()
+        try:
+            for m in metas:
+                block = BackendBlock(self.backend, m)
+                codec = codec_for(m.data_encoding)
+                obs.fallback_scans.inc(tenant=m.tenant_id)
+                results.metrics.inspected_blocks += 1
+                nbytes = block.bytes_in_pages(0, None)
+                results.metrics.inspected_bytes += nbytes
+                if qs is not None:
+                    # whole-block proto decode: pure HOST work
+                    qs.add_inspected(blocks=1, nbytes=nbytes,
+                                     placement="host")
+                for oid, obj in block.iter_objects():
+                    results.metrics.inspected_traces += 1
+                    trace = codec.prepare_for_read(obj)
+                    if proto_matches(trace, req):
+                        results.add(trace_search_metadata(oid, trace))
+                    if results.complete:
+                        return
+        finally:
+            if qs is not None:
+                qs.add_stage("fallback_scan", time.perf_counter() - t0)
 
     def search_block(self, req: tempopb.SearchBlockRequest) -> SearchResults:
         """One search job (the SearchBlockRequest protocol unit). The block
@@ -691,28 +754,38 @@ class TempoDB:
             start_time=req.start_time, end_time=req.end_time,
         )
         from tempo_tpu.backend.raw import DoesNotExist
+        from tempo_tpu.search import query_stats
 
         results = SearchResults.for_request(req.search_req)
         self._ensure_mesh()
-        start = req.start_page
-        count = req.pages_to_search or None
-        try:
-            job = self._scan_job(meta, start, count)
-        except DoesNotExist:
-            # No search container. Page ranges address CONTAINER pages, a
-            # different page space from trace-block pages, so a range is
-            # meaningless here: the start_page==0 job scans the whole
-            # trace block once; sibling range jobs contribute nothing
-            # (coverage stays exactly-once across the job set).
-            sr = req.search_req
-            if start == 0:
-                if self._include_block(meta, "", "", sr.start, sr.end):
-                    self._fallback_search([meta], sr, results)
-                else:
-                    results.metrics.skipped_blocks += 1
-            return results
-        if job.n_pages > 0:
-            self.batcher.search([job], req.search_req, results)
+        qs = query_stats.begin(req.tenant_id, req.search_req)
+        with query_stats.activate(qs):
+            start = req.start_page
+            count = req.pages_to_search or None
+            try:
+                job = self._scan_job(meta, start, count)
+            except DoesNotExist:
+                # No search container. Page ranges address CONTAINER
+                # pages, a different page space from trace-block pages,
+                # so a range is meaningless here: the start_page==0 job
+                # scans the whole trace block once; sibling range jobs
+                # contribute nothing (coverage stays exactly-once across
+                # the job set).
+                sr = req.search_req
+                if start == 0:
+                    if self._include_block(meta, "", "", sr.start, sr.end):
+                        self._fallback_search([meta], sr, results)
+                    else:
+                        results.metrics.skipped_blocks += 1
+                        if qs is not None:
+                            qs.add_skip("time_range")
+                if qs is not None:
+                    self._finalize_query_stats(qs, req.search_req, results)
+                return results
+            if job.n_pages > 0:
+                self.batcher.search([job], req.search_req, results)
+            if qs is not None:
+                self._finalize_query_stats(qs, req.search_req, results)
         return results
 
     def search_blocks(self, breq: tempopb.SearchBlocksRequest) -> SearchResults:
@@ -726,10 +799,20 @@ class TempoDB:
         every query over a stable blocklist, and rebuilding + re-sorting
         10K jobs per request is the kind of O(blocks) host cost the north
         star forbids (VERDICT r3 #1)."""
-        from tempo_tpu.backend.raw import DoesNotExist
+        from tempo_tpu.search import query_stats
 
         results = SearchResults.for_request(breq.search_req)
         self._ensure_mesh()
+        qs = query_stats.begin(breq.tenant_id, breq.search_req)
+        with query_stats.activate(qs):
+            self._search_blocks_impl(breq, results, qs)
+            if qs is not None:
+                self._finalize_query_stats(qs, breq.search_req, results)
+        return results
+
+    def _search_blocks_impl(self, breq, results, qs) -> None:
+        from tempo_tpu.backend.raw import DoesNotExist
+
         # full-fidelity key (every job field that shapes the ScanJob) used
         # AS the map key: a bare hash() would let a collision or an
         # encoding/version-only difference silently serve another
@@ -811,9 +894,10 @@ class TempoDB:
                 break
             if not self._include_block(meta, "", "", sr.start, sr.end):
                 results.metrics.skipped_blocks += 1
+                if qs is not None:
+                    qs.add_skip("time_range")
                 continue
             self._fallback_search([meta], sr, results)
-        return results
 
     # ------------------------------------------------------------------
     # Compactor

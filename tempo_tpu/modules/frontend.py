@@ -60,6 +60,31 @@ class FrontendConfig:
     max_queriers_per_tenant: int = 0
 
 
+def _metrics_remainder(m, parts: list[dict]) -> "tempopb.SearchMetrics":
+    """The share of merged SearchMetrics NOT covered by the explain
+    breakdowns — sub-responses from the ingester live leg or a
+    stats-disabled querier carry plain metrics only, and the frontend's
+    merged record must account them too (clamped at zero: float sums
+    and partial fields never go negative)."""
+    part_blocks = sum(int(p.get("blocks_inspected", 0)) for p in parts)
+    part_dev_b = sum(int((p.get("bytes_inspected") or {}).get("device", 0))
+                     for p in parts)
+    part_host_b = sum(int((p.get("bytes_inspected") or {}).get("host", 0))
+                      for p in parts)
+    part_dev_s = sum(float(p.get("device_seconds", 0.0)) for p in parts)
+    part_skip = sum(sum((p.get("skipped_blocks") or {}).values())
+                    for p in parts)
+    rem = tempopb.SearchMetrics()
+    rem.inspected_blocks = max(0, m.inspected_blocks - part_blocks)
+    rem.inspected_bytes_device = max(
+        0, m.inspected_bytes_device - part_dev_b)
+    rem.inspected_bytes = max(
+        0, m.inspected_bytes - part_dev_b - part_host_b)
+    rem.device_seconds = max(0.0, m.device_seconds - part_dev_s)
+    rem.skipped_blocks = max(0, m.skipped_blocks - part_skip)
+    return rem
+
+
 def create_block_boundaries(shards: int) -> list[str]:
     """Split the 128-bit block-id (uuid) space into `shards` ranges
     (reference tracebyidsharding.go createBlockBoundaries)."""
@@ -264,9 +289,18 @@ class QueryFrontend:
                 req: tempopb.SearchRequest) -> tuple[tempopb.SearchResponse, int]:
         import threading
 
+        from tempo_tpu.search import query_stats
+
         batches = self._search_batches(tenant)
         jobs = [("recent", None)] + [("blocks", b) for b in batches]
 
+        # request-scope stats: one record for the WHOLE external
+        # request, merged from its sub-responses' metrics (and their
+        # full breakdowns under explain). Feeds the ring + slow-query
+        # log only — the per-tenant counters are booked at the
+        # execution layer (the queriers), where the kernels ran;
+        # re-booking here would double count in single-binary mode.
+        qstats = query_stats.begin(tenant, req, scope="request")
         merged = SearchResults.for_request(req)
         merge_lock = threading.Lock()
         quit_event = threading.Event()
@@ -286,6 +320,13 @@ class QueryFrontend:
         recent_failed = [False]
 
         def run(job):
+            # in-process sub-requests run under the fronted() mark so
+            # their exec-scope slow-log lines defer to THIS request's
+            # line (remote queriers never see the mark and log theirs)
+            with query_stats.fronted():
+                return _run(job)
+
+        def _run(job):
             kind, payload = job
             if kind == "recent":
                 try:
@@ -329,4 +370,34 @@ class QueryFrontend:
         # "pruned" (reference frontend.go:144-146; HTTP layer maps
         # failed_blocks > 0 to 206)
         merged.metrics.failed_blocks += len(failed_block_ids)
+        if qstats is not None:
+            import json
+
+            if merged.explain_parts:
+                for part in merged.explain_parts:
+                    qstats.merge_child(part)
+                # sub-responses WITHOUT a breakdown (the ingester live
+                # leg, a querier running stats-disabled) still
+                # contributed plain metrics — absorb the remainder so
+                # the explain never contradicts the metrics beside it
+                qstats.absorb_metrics(
+                    _metrics_remainder(merged.metrics,
+                                       merged.explain_parts))
+            else:
+                qstats.absorb_metrics(merged.metrics)
+            d = qstats.finish()
+            if req.explain:
+                # the response carries ONE merged breakdown, replacing
+                # the per-sub-request parts the executors attached
+                merged.metrics.query_stats_json = json.dumps(
+                    d, separators=(",", ":"), sort_keys=True)
+        if not req.explain:
+            # measured wall time varies run to run; the EXTERNAL
+            # response stays deterministic (cacheable, diffable —
+            # repeated identical queries must compare equal) unless the
+            # caller opted into the breakdown. The field still rode the
+            # querier→frontend sub-responses, so the request-scope
+            # accounting above saw the real total; the deterministic
+            # byte split stays either way.
+            merged.metrics.device_seconds = 0.0
         return merged.response(), len(batches)

@@ -114,6 +114,18 @@ class Memberlist:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    def set_grpc_addr(self, grpc_addr: str) -> None:
+        """Update this member's advertised gRPC address after the fact —
+        the ephemeral-port flow (grpc_port=0) only knows the real port
+        once the server has bound. Heartbeat bumps so peers that already
+        merged the address-less record take the update on the next
+        exchange (merge keeps the higher counter)."""
+        with self._lock:
+            me = self._members.get(self.id)
+            if me is not None:
+                me.grpc_addr = grpc_addr
+                me.heartbeat += 1
+
     # ---- views ----
 
     def ring(self, role: str) -> Ring:

@@ -155,13 +155,16 @@ class ModuleProcess:
         adv_host = ml_cfg.get("advertise_host", "127.0.0.1")
         needs_grpc = target in ("ingester", "querier", "distributor",
                                 "metrics-generator")
-        if needs_grpc and not grpc_port:
-            raise ValueError("grpc_port must be set for gRPC-serving targets")
         # a query-frontend WITH a grpc_port serves the Frontend/Process
-        # pull stream; without one it falls back to push dispatch
+        # pull stream; without one it falls back to push dispatch.
+        # grpc-serving targets accept grpc_port=0 = EPHEMERAL: the
+        # server binds port 0, reads the assigned port, and gossip
+        # advertises it — picking a "free" port up front and binding it
+        # later is a race (the observed test_microservices flake).
         serves_grpc = needs_grpc or (target == "query-frontend"
                                      and bool(grpc_port))
-        self.grpc_addr = f"{adv_host}:{grpc_port}" if serves_grpc else ""
+        self.grpc_addr = (f"{adv_host}:{grpc_port}"
+                          if serves_grpc and grpc_port else "")
         self.http_addr = f"{adv_host}:{http_port}" if http_port else ""
 
         self.ingester = None
@@ -256,6 +259,18 @@ class ModuleProcess:
                 max_workers=(cfg.frontend_grpc_max_workers
                              if self.dispatcher is not None else 16),
             )
+            bound = getattr(self.grpc_server, "bound_port", grpc_port)
+            if not bound:
+                raise RuntimeError(
+                    f"gRPC bind failed on port {grpc_port} "
+                    f"(target {target}, instance {instance_id})")
+            if not grpc_port:
+                # ephemeral bind: advertise the ASSIGNED port — peers
+                # that merged the address-less record update on the
+                # next gossip exchange, before any client could have
+                # cached an address for this member
+                self.grpc_addr = f"{adv_host}:{bound}"
+                self.ml.set_grpc_addr(self.grpc_addr)
             self.grpc_server.start()
 
         if target == "querier":

@@ -351,6 +351,34 @@ offload_predict_error = Histogram(
     "probe cost, resolved when the matching probe run is observed",
     buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0))
 
+# ---- per-query execution inspector (search/query_stats.py) ----
+query_device_seconds = Counter(
+    "tempo_search_query_device_seconds_total",
+    "device-seconds attributed to queries per tenant: fused coalesced "
+    "dispatches apportion their stage times across member queries by "
+    "padded predicate rows (shares sum to the dispatch total), so this "
+    "is the fleet's device-time bill by tenant")
+query_bytes_inspected = Counter(
+    "tempo_search_query_bytes_inspected_total",
+    "bytes inspected by queries per tenant, split by placement=device "
+    "(scan kernels over staged batches) vs placement=host (fallback "
+    "proto scans, host dictionary probes)")
+query_stage_seconds = Histogram(
+    "tempo_search_query_stage_seconds",
+    "per-QUERY stage wall time: host stages (header_prune|staging|"
+    "prepare|dispatch|drain|fallback_scan) plus attributed device "
+    "stages (device_build|device_h2d|device_compile|device_execute|"
+    "device_d2h|device_lock_wait); exemplars link buckets to "
+    "self-traces",
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1,
+             5, 30))
+slow_queries = Counter(
+    "tempo_search_slow_queries_total",
+    "queries slower than search_slow_query_log_s per tenant, booked "
+    "ONCE per query per process (in-process sub-requests of a slow "
+    "request don't re-count); the log line is additionally rate-limited "
+    "per tenant")
+
 # ---- self-tracing health (observability/tracing.py) ----
 selftrace_dropped_spans = Counter(
     "tempo_selftrace_dropped_spans_total",

@@ -52,6 +52,7 @@ Design constraints (mirrors tracing.py's noop stance):
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from collections import deque
@@ -60,6 +61,31 @@ from . import metrics as obs
 from . import tracing
 
 STAGES = ("build", "h2d", "compile", "execute", "d2h", "lock_wait")
+
+# per-thread stack of record sinks (collect_records): a dispatch record
+# finishing on this thread is ALSO handed to the innermost open
+# collector. Thread-local rather than a contextvar: dispatch + close
+# always happen on the thread that ran the engine call, and the
+# coalescer's flush threads must not inherit a submitter's collector.
+_collect_local = threading.local()
+
+
+@contextlib.contextmanager
+def collect_records():
+    """Collect the dispatch records (as_dict form) finished on THIS
+    thread inside the body — the query-stats attribution hook: the
+    caller apportions the record's stages to the query (or queries)
+    the dispatch served. Nests; profiling disabled yields no records
+    (the noop dispatch never finishes)."""
+    stack = getattr(_collect_local, "stack", None)
+    if stack is None:
+        stack = _collect_local.stack = []
+    recs: list[dict] = []
+    stack.append(recs)
+    try:
+        yield recs
+    finally:
+        stack.pop()
 
 _COMPILE_SEEN_MAX = 4096  # shape signatures tracked before reset
 
@@ -247,10 +273,20 @@ class DispatchProfiler:
         # lock, exceptions swallowed, only when profiling is enabled
         self._listeners: list = []
         self._stage_listeners: list = []
+        # unix time of the last successfully finished dispatch/stage —
+        # the /status device block's "is the chip still answering"
+        # signal (None until the first device op of the process)
+        self.last_dispatch_t: float | None = None
 
     # ---- call-site API ----
 
     def dispatch(self, mode: str):
+        # liveness stamp even when profiling is off: /status's
+        # wedge-vs-idle signal (device_status) must not depend on the
+        # profiling knob — one coarse clock read; the noop contract's
+        # no-locks/no-allocation still holds and the record protocol
+        # itself stays free
+        self.last_dispatch_t = time.time()
         if not self.enabled:
             return NOOP_DISPATCH
         return Dispatch(self, mode)
@@ -276,6 +312,12 @@ class DispatchProfiler:
         D2H fetch). Noop when disabled. `nbytes` feeds the transfer
         counters only for the transfer stages; other stages (the host
         prefilter's scanned bytes) keep it in the aggregates alone."""
+        # liveness stamp (see dispatch()) — but NOT for host-only work:
+        # mode=host_probe runs with the device wedged just fine, and a
+        # fresh last_dispatch_age_s fed by host scans would mask exactly
+        # the wedge the /status device block exists to expose
+        if mode != "host_probe":
+            self.last_dispatch_t = time.time()
         if not self.enabled:
             return
         obs.dispatch_stage_seconds.observe(seconds, stage=stage, mode=mode)
@@ -332,8 +374,12 @@ class DispatchProfiler:
         if rec.d2h_bytes:
             obs.d2h_bytes.inc(rec.d2h_bytes)
         rd = rec.as_dict()
+        stack = getattr(_collect_local, "stack", None)
+        if stack:
+            stack[-1].append(rd)
         with self._lock:
             self._dispatches += 1
+            self.last_dispatch_t = time.time()
             if rec.jit is not None:
                 self._jit[rec.jit] += 1
             self._bytes["h2d"] += rec.h2d_bytes
@@ -427,6 +473,44 @@ def dispatch(mode: str):
 def observe_stage(stage: str, mode: str, seconds: float,
                   nbytes: int = 0) -> None:
     PROFILER.observe_stage(stage, mode, seconds, nbytes=nbytes)
+
+
+def device_status() -> dict:
+    """The /status "device" block: accelerator backend + device count
+    (WITHOUT initializing a backend — write-only processes must never
+    claim a chip for a status probe) and the age of the last successful
+    dispatch, the operator's first wedge-vs-idle signal (bench r04/r05
+    recorded zeroed CPU-fallback headlines that were indistinguishable
+    from a regression because nothing surfaced this)."""
+    out: dict = {
+        "dispatches": PROFILER._dispatches,
+        "profiling_enabled": PROFILER.enabled,
+    }
+    t = PROFILER.last_dispatch_t
+    out["last_dispatch_age_s"] = (round(time.time() - t, 3)
+                                  if t is not None else None)
+    try:
+        from jax._src import xla_bridge as _xb
+
+        initialized = bool(getattr(_xb, "_backends", None))
+    except Exception:  # noqa: BLE001 — internal API moves across versions
+        # can't tell whether a backend exists: report unknown rather
+        # than probe — jax.default_backend() would INITIALIZE one, and
+        # on TPU that claims the chip out from under the serving process
+        out["backend"] = "unknown"
+        return out
+    if not initialized:
+        out["backend"] = "uninitialized"
+        return out
+    try:
+        import jax
+
+        out["backend"] = jax.default_backend()
+        out["device_count"] = jax.device_count()
+    except Exception as e:  # noqa: BLE001 — a wedged tunnel must not 500 /status
+        out["backend"] = "error"
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 def fence_arrays(arrays) -> None:

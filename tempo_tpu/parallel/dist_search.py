@@ -150,8 +150,13 @@ class DistributedScanEngine:
 
     def scan_staged(self, sp: ShardedPages, cq: CompiledQuery):
         from tempo_tpu.observability import profile
+        from tempo_tpu.search import query_stats
 
-        with profile.dispatch("mesh") as rec:
+        # attributed: a query running through the distributed engine
+        # bills its mesh dispatch (stages incl. lock_wait) to the
+        # active QueryStats — same contract as the batched paths
+        with query_stats.attributed_dispatch(), \
+                profile.dispatch("mesh") as rec:
             d = sp.device
             k = self.top_k
             while k < cq.limit:

@@ -21,7 +21,7 @@ from tempo_tpu.encoding.v2.compression import compress, decompress
 from .columnar import ColumnarPages, PageGeometry
 from .data import SearchData
 from .engine import ScanEngine, StagedPages, stage
-from .pipeline import compile_query, matches_block_header
+from .pipeline import block_header_skip_reason, compile_query
 from .results import SearchResults
 
 _DEFAULT_ENGINE = None
@@ -129,12 +129,18 @@ class BackendSearchBlock:
     def search(self, req: tempopb.SearchRequest,
                results: SearchResults | None = None,
                engine: ScanEngine | None = None) -> SearchResults:
+        from . import query_stats
+
         engine = engine or default_engine()
         results = results or SearchResults.for_request(req)
         results.metrics.inspected_blocks += 1
+        qs = query_stats.current()
 
-        if not matches_block_header(self.header(), req):
+        reason = block_header_skip_reason(self.header(), req)
+        if reason is not None:
             results.metrics.skipped_blocks += 1
+            if qs is not None:
+                qs.add_skip(reason)
             return results
 
         sp = self.staged()
@@ -147,21 +153,27 @@ class BackendSearchBlock:
         # staged_dict present → the substring probe runs on device
         # (staging already applied the size threshold); the host memmem
         # path above stays the exact fallback for oversized needles
-        cq = compile_query(sp.pages.key_dict, sp.pages.val_dict, req,
-                           packed_vals=packed, cache_on=sp.pages,
-                           staged_dict=sp.staged_dict)
+        with query_stats.attributed_dispatch(qs, fallback_wall=False):
+            # attributed: compilation can fire the device dict probe
+            cq = compile_query(sp.pages.key_dict, sp.pages.val_dict, req,
+                               packed_vals=packed, cache_on=sp.pages,
+                               staged_dict=sp.staged_dict)
         if cq is None:  # dictionary prefilter pruned the block
             results.metrics.skipped_blocks += 1
+            if qs is not None:
+                qs.add_skip("dict")
             return results
 
-        count, inspected, scores, idx = engine.scan_staged(sp, cq)
+        with query_stats.attributed_dispatch(qs):
+            count, inspected, scores, idx = engine.scan_staged(sp, cq)
         from tempo_tpu.observability import metrics as obs
 
         obs.scan_dispatches.inc(mode="single")
         results.metrics.inspected_traces += inspected
-        results.metrics.inspected_bytes += int(
-            self.header().get("compressed_size", 0)
-        )
+        nbytes = int(self.header().get("compressed_size", 0))
+        results.metrics.inspected_bytes += nbytes
+        if qs is not None:
+            qs.add_inspected(blocks=1, nbytes=nbytes, placement="device")
         results.metrics.truncated_entries += int(
             self.header().get("truncated_entries", 0) or 0)
         for m in engine.results(sp, cq, scores, idx):

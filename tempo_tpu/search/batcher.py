@@ -43,10 +43,11 @@ from tempo_tpu.observability import metrics as obs
 from tempo_tpu.observability import profile
 from tempo_tpu.observability import tracing
 
+from . import query_stats
 from .engine import DEFAULT_TOP_K, fetch_coalesced_out, resolve_top_k, \
     start_fetch
 from .multiblock import MultiBlockEngine, compile_multi, stack_queries
-from .pipeline import matches_block_header
+from .pipeline import block_header_skip_reason
 from .results import SearchResults
 
 
@@ -111,7 +112,7 @@ class _PendingCoalesce:
     def __init__(self, batch, gen):
         self.batch = batch
         self.gen = gen
-        self.items = []     # [(mq, top_k, Future, t_submit)]
+        self.items = []     # [(mq, top_k, Future, t_submit, QueryStats|None)]
 
 
 class _FusedOut:
@@ -208,7 +209,12 @@ class QueryCoalescer:
         resolving to the engine's (count, inspected, scores, idx) — the
         same host types drain code gets from a direct dispatch. `peers`
         is the caller's count of in-flight searches that could target
-        THIS batch (self included); <=1 flushes immediately."""
+        THIS batch (self included); <=1 flushes immediately.
+
+        The submitter's active QueryStats is captured WITH the item
+        (the contextvar does not survive into the window-timer flush
+        thread): at flush time the dispatch's profiled stage times are
+        apportioned across the member queries' stats."""
         import concurrent.futures
         import heapq
         import time as _time
@@ -221,7 +227,8 @@ class QueryCoalescer:
             if grp is None:
                 self._gen += 1
                 grp = self._pending[key] = _PendingCoalesce(batch, self._gen)
-            grp.items.append((mq, top_k, fut, _time.perf_counter()))
+            grp.items.append((mq, top_k, fut, _time.perf_counter(),
+                              query_stats.current()))
             if len(grp.items) >= self.max_queries:
                 del self._pending[key]
                 flush_now = grp
@@ -286,13 +293,44 @@ class QueryCoalescer:
                 grp = pend
             self._flush_pool.submit(self._run, grp)
 
+    @staticmethod
+    def _attribute(items, recs, wall_s: float) -> None:
+        """Apportion one (possibly fused) dispatch's cost across the
+        member queries' stats by their padded predicate-table rows,
+        CONSERVING the totals: per stage, the attributed shares sum to
+        the dispatch total exactly (query_stats.apportion gives the
+        last member the float remainder). With profiling disabled there
+        are no records; the measured wall books as "execute" so the
+        per-tenant device-seconds bill degrades to wall-clock rather
+        than to zero."""
+        stats = [it[4] for it in items]
+        if all(s is None for s in stats):
+            return
+        totals: dict[str, float] = {}
+        h2d = 0
+        for rd in recs:
+            for k, v in (rd.get("stages_ms") or {}).items():
+                totals[k] = totals.get(k, 0.0) + v / 1e3
+            h2d += rd.get("h2d_bytes", 0)
+        if not totals:
+            totals = {"execute": wall_s}
+        weights = [max(1, int(it[0].term_keys.size)) for it in items]
+        shares = query_stats.apportion(totals, weights)
+        byte_shares = query_stats.apportion({"b": float(h2d)}, weights)
+        for qs, share, bs in zip(stats, shares, byte_shares):
+            if qs is not None:
+                qs.add_device_stages(share, h2d_bytes=bs["b"],
+                                     fused_q=len(items))
+
     def _run(self, grp: _PendingCoalesce) -> None:
         import time as _time
+
+        from tempo_tpu.observability import profile
 
         items = grp.items
         try:
             now = _time.perf_counter()
-            for _mq, _k, _fut, t0 in items:
+            for _mq, _k, _fut, t0, _qs in items:
                 obs.coalesce_wait_seconds.observe(now - t0)
             with self._lock:  # _run races: window thread vs size flush
                 self.dispatches += 1
@@ -300,16 +338,22 @@ class QueryCoalescer:
                 if len(items) > 1:
                     self.fused += 1
             if len(items) == 1:
-                mq, _k, fut, _t0 = items[0]
-                out = self.engine.scan_async(grp.batch, mq)
+                mq, _k, fut, _t0, _qs = items[0]
+                t0d = _time.perf_counter()
+                with profile.collect_records() as recs:
+                    out = self.engine.scan_async(grp.batch, mq)
+                self._attribute(items, recs, _time.perf_counter() - t0d)
                 start_fetch(out)
                 obs.scan_dispatches.inc(mode="batched")
                 fut.set_result(out)
                 return
-            mqs = [mq for mq, _k, _f, _t in items]
+            mqs = [mq for mq, _k, _f, _t, _qs in items]
             cq = stack_queries(mqs)
-            k = max(k for _mq, k, _f, _t in items)
-            out = self.engine.coalesced_scan_async(grp.batch, cq, k)
+            k = max(k for _mq, k, _f, _t, _qs in items)
+            t0d = _time.perf_counter()
+            with profile.collect_records() as recs:
+                out = self.engine.coalesced_scan_async(grp.batch, cq, k)
+            self._attribute(items, recs, _time.perf_counter() - t0d)
             obs.scan_dispatches.inc(mode="coalesced")
             obs.coalesced_queries.inc(len(items))
             # D2H starts async NOW; the one blocking sync point happens
@@ -318,10 +362,10 @@ class QueryCoalescer:
             # which still has its own dispatch loop to overlap
             start_fetch(out)
             shared = _FusedOut(out)
-            for qi, (_mq, _k, fut, _t0) in enumerate(items):
+            for qi, (_mq, _k, fut, _t0, _qs) in enumerate(items):
                 fut.set_result(_FusedSlice(shared, qi))
         except BaseException as e:  # noqa: BLE001 — delivered via futures
-            for _mq, _k, fut, _t0 in items:
+            for _mq, _k, fut, _t0, _qs in items:
                 if not fut.done():
                     fut.set_exception(e)
 
@@ -695,6 +739,11 @@ class BlockBatcher:
 
         results = results or SearchResults.for_request(req)
         exhaustive = is_exhaustive(req)
+        # the active per-query stats (None when the layer is off): this
+        # search's skip reasons, cache events, placement bytes and
+        # attributed device time all land here. Read ONCE — every
+        # recording site below is behind this None check.
+        qs = query_stats.current()
         if groups is None and plan_key is not None:
             # one entry per plan_key[0] (tenant): a stale generation is
             # never hittable again (the epoch only moves forward), so
@@ -748,9 +797,18 @@ class BlockBatcher:
             inspected = int(inspected)
             scores = np.asarray(scores)
             idx = np.asarray(idx)
+            d2h_s = _time.perf_counter() - t0d
             profile.observe_stage(
-                "d2h", "batched", _time.perf_counter() - t0d,
+                "d2h", "batched", d2h_s,
                 nbytes=scores.nbytes + idx.nbytes + 8)
+            if qs is not None:
+                # the wait THIS query paid for its results (for a fused
+                # group the first drainer pays the real sync); count=False
+                # — the dispatch itself was already attributed at launch
+                qs.add_device_stages({"d2h": d2h_s}, count=False)
+                qs.add_inspected(blocks=pre["inspected_blocks"],
+                                 nbytes=pre["inspected_bytes"],
+                                 placement="device")
             # harvest the uploaded per-query tables AFTER the dispatch
             # ran: under coalescing the flush (and its H2D upload) can
             # happen on the window-timer thread, after submit returned —
@@ -786,14 +844,29 @@ class BlockBatcher:
                 results.add(m)
             stages["drain"] += _time.perf_counter() - t0
 
-        def prepare(group, cached, skip) -> dict:
+        def _skip_reason_counts(skip, reasons) -> dict:
+            """reason -> count for the skipped blocks: the header prune
+            knows why (time_range/duration); anything skipped beyond it
+            was dictionary-pruned (no value can satisfy a term)."""
+            out: dict = {}
+            for s, r in zip(skip, reasons):
+                if s:
+                    key = r or "dict"
+                    out[key] = out.get(key, 0) + 1
+            return out
+
+        def prepare(group, cached, skip, reasons) -> dict:
             """O(group) predicate work, memoized per (batch, predicate):
             per-block compile + metric sums. `skip` is the header-prune
-            list (already computed for the pre-staging fast path)."""
+            list (already computed for the pre-staging fast path);
+            `reasons` its why-column, carried into the per-query stats'
+            skipped-blocks breakdown."""
             mq = compile_multi([b for b in cached.batch.blocks], req,
                                skip=skip, cache_on=cached.batch)
             if mq is None:
-                return {"all_skip": True, "skipped": len(group)}
+                return {"all_skip": True, "skipped": len(group),
+                        "skip_reasons": _skip_reason_counts(
+                            [True] * len(group), reasons)}
             # dictionary-pruned jobs (term key -1 across all terms) count
             # as skipped; under the exhaustive flag nothing is skipped —
             # every page is scanned by definition
@@ -802,6 +875,7 @@ class BlockBatcher:
                 skip = [s or bool(dict_pruned[i])
                         for i, s in enumerate(skip)]
             pre = {
+                "skip_reasons": _skip_reason_counts(skip, reasons),
                 "all_skip": False,
                 "term_keys": mq.term_keys,
                 "val_ranges": mq.val_ranges,
@@ -828,29 +902,33 @@ class BlockBatcher:
 
         sig = _predicate_sig(req)
 
-        def hdr_skip_for(group):
+        def hdr_reasons_for(group):
             """Header-only prune BEFORE staging: a decidably-dead group
-            (time window, tag rollup) costs no IO and no HBM; the skip
-            list is memoized so repeats are O(1)."""
+            (time window, tag rollup) costs no IO and no HBM. Returns
+            the per-job skip REASON list (None = scan it) — truthiness
+            keeps `all(...)`/`any(...)` semantics of the old bool list
+            while the why survives into the query stats. Memoized so
+            repeats are O(1)."""
             t0 = _time.perf_counter()
             try:
-                return _hdr_skip_for(group)
+                return _hdr_reasons_for(group)
             finally:
                 stages["header_prune"] += _time.perf_counter() - t0
 
-        def _hdr_skip_for(group):
+        def _hdr_reasons_for(group):
             gkey = tuple(j.key for j in group)
             with self._lock:
-                skip = self._prune_cache.get((gkey, sig))
-                if skip is not None:
+                reasons = self._prune_cache.get((gkey, sig))
+                if reasons is not None:
                     self._prune_cache.move_to_end((gkey, sig))
-                    return skip
-            skip = [not matches_block_header(j.header, req) for j in group]
+                    return reasons
+            reasons = [block_header_skip_reason(j.header, req)
+                       for j in group]
             with self._lock:
-                self._prune_cache[(gkey, sig)] = skip
+                self._prune_cache[(gkey, sig)] = reasons
                 while len(self._prune_cache) > _PRUNE_CACHE_MAX:
                     self._prune_cache.popitem(last=False)
-            return skip
+            return reasons
 
         prefetched: dict = {}
 
@@ -858,16 +936,23 @@ class BlockBatcher:
             """One-slot staging lookahead: stage the NEXT live group in a
             background thread while this group's kernel runs — H2D
             overlaps compute (double-buffering; _staged's dedupe makes a
-            racing inline stage safe)."""
+            racing inline stage safe). The cache event is judged NOW:
+            by the time the main loop reaches a prefetched group, the
+            prefetch has inserted it into the caches and residency
+            would misread this query's own cold stage as a hit."""
             for gi in range(from_idx, len(groups)):
                 g = groups[gi]
-                if all(hdr_skip_for(g)):
+                if all(hdr_reasons_for(g)):
                     continue
                 k = tuple(j.key for j in g)
                 with self._lock:
                     resident = k in self._cache
+                    host_res = k in self._host_cache
                 if not resident and k not in prefetched:
-                    prefetched[k] = self._prefetcher.submit(self._staged, g)
+                    prefetched[k] = (
+                        self._prefetcher.submit(self._staged, g),
+                        "hbm_miss_host_hit" if host_res
+                        else "hbm_miss_cold")
                 return
 
         # HBM-resident groups dispatch FIRST: an evicted group's re-stage
@@ -891,17 +976,41 @@ class BlockBatcher:
                 if results.complete:
                     break
                 gkey = tuple(j.key for j in group)
-                hdr_skip = hdr_skip_for(group)
-                if all(hdr_skip):
+                hdr_reasons = hdr_reasons_for(group)
+                if all(hdr_reasons):
                     results.metrics.skipped_blocks += len(group)
+                    if qs is not None:
+                        for r in hdr_reasons:
+                            qs.add_skip(r)
                     continue
                 # memo lookup needs the staged batch's identity; the memo
                 # itself lives on the cached batch so it dies with it
                 t0 = _time.perf_counter()
-                fut_staged = prefetched.pop(gkey, None)
+                pf = prefetched.pop(gkey, None)
+                fut_staged, pf_event = pf if pf is not None else (None, None)
+                if qs is not None:
+                    # cache behavior as THIS query saw it (the global
+                    # batch_cache_events counters can't say whose re-stage
+                    # it was). A prefetched group carries the event judged
+                    # at SUBMIT time — its own lookahead has since
+                    # inserted the batch, so reading residency here would
+                    # report this query's cold stage as a hit.
+                    if pf_event is not None:
+                        _event = pf_event
+                    else:
+                        with self._lock:
+                            _event = ("hbm_hit" if gkey in self._cache
+                                      else ("hbm_miss_host_hit"
+                                            if gkey in self._host_cache
+                                            else "hbm_miss_cold"))
                 cached = (fut_staged.result() if fut_staged is not None
                           else self._staged(group))
                 stages["staging"] += _time.perf_counter() - t0
+                if qs is not None:
+                    qs.add_cache(_event)
+                    if _event != "hbm_hit" and cached.batch.staged_dicts:
+                        qs.add_cache("probe_dict_staged",
+                                     len(cached.batch.staged_dicts))
                 with self._lock:
                     cached.pins += 1
                 pinned.append(cached)
@@ -912,7 +1021,15 @@ class BlockBatcher:
                         cached.query_cache.move_to_end(sig)
                 if pre is None:
                     t0 = _time.perf_counter()
-                    pre = prepare(group, cached, list(hdr_skip))
+                    # attributed: query compilation can fire the device
+                    # dictionary probe (mode=dict_probe) — that dispatch
+                    # belongs to this query's bill (no wall fallback:
+                    # most of prepare() is host compile work)
+                    with query_stats.attributed_dispatch(
+                            qs, fallback_wall=False):
+                        pre = prepare(group, cached,
+                                      [r is not None for r in hdr_reasons],
+                                      hdr_reasons)
                     stages["prepare"] += _time.perf_counter() - t0
                     with self._lock:
                         cached.query_cache[sig] = pre
@@ -927,6 +1044,9 @@ class BlockBatcher:
                             # double-subtract and drift the budget
                             if self._cache.get(gkey) is cached:
                                 self._cache_total -= dpb
+                if qs is not None:
+                    for r, n in pre.get("skip_reasons", {}).items():
+                        qs.add_skip(r, n)
                 if pre["all_skip"]:
                     results.metrics.skipped_blocks += pre["skipped"]
                     continue
@@ -961,7 +1081,8 @@ class BlockBatcher:
                         resolve_top_k(self.engine.top_k, mq.limit),
                         peers=peers)
                 else:
-                    fut = self.engine.scan_async(cached.batch, mq)
+                    with query_stats.attributed_dispatch(qs):
+                        fut = self.engine.scan_async(cached.batch, mq)
                     start_fetch(fut)  # D2H begins now, overlapping groups
                 stages["dispatch"] += _time.perf_counter() - t0
                 dispatches += 1
@@ -993,7 +1114,7 @@ class BlockBatcher:
             # not-yet-started stage doesn't burn IO+decompress+H2D (and
             # possibly evict a hotter batch) for a group nobody needs; an
             # already-running one completes harmlessly via _staged dedupe
-            for f in prefetched.values():
+            for f, _ev in prefetched.values():
                 f.cancel()
             span.set_attributes(groups=len(groups), scan_dispatches=dispatches,
                                 inspected_blocks=results.metrics.inspected_blocks,
@@ -1003,6 +1124,9 @@ class BlockBatcher:
             # flush time (mode="batched" solo, mode="coalesced" fused) —
             # counting submits here would double-book shared launches
             obs.scan_dispatches.inc(dispatches, mode="batched")
+        if qs is not None:
+            for k, v in stages.items():
+                qs.add_stage(k, v)
         self.last_dispatches = dispatches
         self.last_scan = {
             "total_ms": round((_time.perf_counter() - t_search0) * 1000, 3),

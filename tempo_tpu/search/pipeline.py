@@ -77,20 +77,24 @@ def ids_to_ranges(ids: np.ndarray) -> np.ndarray:
     return np.stack([ids[lo], ids[hi]], axis=1).astype(np.int32)
 
 
-def matches_block_header(header: dict, req: tempopb.SearchRequest) -> bool:
-    """Block-level pruning from the search header rollup (time range and
-    duration bounds)."""
+def block_header_skip_reason(header: dict,
+                             req: tempopb.SearchRequest) -> str | None:
+    """Why the header rollup prunes this block — None when it doesn't.
+    The reason string feeds the per-query stats' skipped-blocks
+    breakdown (search/query_stats.py): an operator reading an explain
+    must be able to tell "out of the time window" from "no span that
+    long" without re-deriving it."""
     if is_exhaustive(req):
-        return True  # debug flag: never prune
+        return None  # debug flag: never prune
     if req.start and header.get("max_end_s", UINT32_MAX) < req.start:
-        return False
+        return "time_range"
     if req.end and header.get("min_start_s", 0) > req.end:
-        return False
+        return "time_range"
     if req.min_duration_ms and header.get("max_dur_ms", UINT32_MAX) < req.min_duration_ms:
-        return False
+        return "duration"
     if req.max_duration_ms and header.get("min_dur_ms", 0) > req.max_duration_ms:
-        return False
-    return True
+        return "duration"
+    return None
 
 
 NATIVE_SCAN_THRESHOLD = 50_000
@@ -375,6 +379,14 @@ def _probe_tags(key_dict: list, val_dict: list, req,
             dt = _time.perf_counter() - t0
             profile.observe_stage("build", "host_probe", dt, nbytes=nb)
             planner.PLANNER.observe("host_probe", dt, nbytes=nb, fp=fp)
+            from . import query_stats
+
+            qs = query_stats.current()
+            if qs is not None:
+                # the host memmem walk is HOST work this query paid for
+                # — the per-query bytes-by-placement split counts it
+                qs.add_host_probe(dt, nb)
+                qs.add_inspected(nbytes=nb, placement="host")
     return _host_probe_tags(terms, key_dict, val_dict, packed_vals,
                             exhaustive)
 

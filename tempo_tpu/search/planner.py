@@ -374,6 +374,14 @@ class OffloadPlanner:
             self._ring.append(d)
             self._decisions[target] += 1
         obs.offload_decisions.inc(target=target, site=site)
+        from . import query_stats
+
+        qs = query_stats.current()
+        if qs is not None:
+            # the query this decision was made FOR sees it in its own
+            # explain: target + the chosen side's predicted cost
+            qs.add_planner(target, d.predicted_device_s
+                           if target == "device" else d.predicted_host_s)
         return d
 
     # ------------------------------------------------------------------

@@ -22,6 +22,11 @@ class SearchResults:
         self.no_quit = no_quit
         self._by_id: dict[str, tempopb.TraceSearchMetadata] = {}
         self.metrics = tempopb.SearchMetrics()
+        # explain breakdowns carried by merged sub-responses
+        # (metrics.query_stats_json, present only under the explain
+        # opt-in) — the frontend folds these into its request-level
+        # QueryStats instead of concatenating opaque strings
+        self.explain_parts: list[dict] = []
 
     @classmethod
     def for_request(cls, req) -> "SearchResults":
@@ -58,6 +63,19 @@ class SearchResults:
         m.skipped_blocks += resp.metrics.skipped_blocks
         m.truncated_entries += resp.metrics.truncated_entries
         m.failed_blocks += resp.metrics.failed_blocks
+        # per-query accounting fields sum like the counters above —
+        # this is how device-seconds attribution crosses the
+        # frontend/querier process boundary
+        m.device_seconds += resp.metrics.device_seconds
+        m.inspected_bytes_device += resp.metrics.inspected_bytes_device
+        if resp.metrics.query_stats_json:
+            import json
+
+            try:
+                self.explain_parts.append(
+                    json.loads(resp.metrics.query_stats_json))
+            except ValueError:
+                pass  # a malformed part never fails a merge
 
     @property
     def complete(self) -> bool:

@@ -400,6 +400,7 @@ def test_debug_endpoints_gated_off(app):
     routes off — they answer 404, everything else still works."""
     api = HTTPApi(app, debug_endpoints=False)
     for p in ("/debug/threads", "/debug/scan", "/debug/profile",
+              "/debug/querystats",
               "/debug/planner"):
         code, body = api.handle("GET", p, {}, {})
         assert code == 404, (p, code)

@@ -1,0 +1,56 @@
+"""Configuration-docs drift guard (the configuration twin of
+test_observability.py's metrics-catalog test).
+
+PRs 1-6 each added TempoDBConfig knobs, and nothing enforced that
+docs/configuration.md kept up — knob/doc skew was only caught by
+review. Two invariants:
+
+  1. every `TempoDBConfig` dataclass field name appears in
+     docs/configuration.md (as the YAML knob, or in the documented
+     constructor-only / renamed-knob lists);
+  2. every YAML key the config loader actually reads
+     (`*.get("<key>"...)` in cli/config.py) appears in
+     docs/configuration.md.
+"""
+
+import dataclasses
+import os
+import re
+
+from tempo_tpu.db import TempoDBConfig
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _doc() -> str:
+    with open(os.path.join(_ROOT, "docs", "configuration.md"),
+              encoding="utf-8") as f:
+        return f.read()
+
+
+def test_every_tempodb_config_field_documented():
+    doc = _doc()
+    missing = sorted(
+        f.name for f in dataclasses.fields(TempoDBConfig)
+        if f.name not in doc
+    )
+    assert not missing, (
+        "TempoDBConfig fields missing from docs/configuration.md "
+        f"(document the knob, or list it under 'fields without their "
+        f"own YAML knob'): {missing}")
+
+
+_GET_RE = re.compile(r"""\.get\(\s*["']([a-z0-9_]+)["']""")
+
+
+def test_every_yaml_knob_documented():
+    with open(os.path.join(_ROOT, "tempo_tpu", "cli", "config.py"),
+              encoding="utf-8") as f:
+        src = f.read()
+    keys = set(_GET_RE.findall(src))
+    assert len(keys) >= 30, f"config-loader grep looks broken: {sorted(keys)}"
+    doc = _doc()
+    missing = sorted(k for k in keys if k not in doc)
+    assert not missing, (
+        "YAML knobs read by cli/config.py but absent from "
+        f"docs/configuration.md: {missing}")

@@ -112,10 +112,11 @@ def topology(tmp_path):
     procs = []
 
     def mk(target, iid, join=()):
+        # grpc_port=0 = ephemeral: the server binds port 0 and gossip
+        # advertises the assigned port — free_port() probing raced other
+        # test processes for the same port (observed flaky collision)
         p = ModuleProcess(
-            cfg, target, instance_id=iid,
-            grpc_port=free_port() if target in
-            ("ingester", "querier", "distributor") else 0,
+            cfg, target, instance_id=iid, grpc_port=0,
             memberlist_cfg={"join": list(join), "gossip_interval_s": 0.1,
                             "suspect_timeout_s": 5.0},
         )
@@ -209,6 +210,11 @@ def test_microservice_ingester_crash_tolerated(topology):
 @pytest.mark.slow
 def test_cli_microservices_subprocess(tmp_path):
     gossip_seed = f"127.0.0.1:{free_port()}"
+    # subprocess e2e keeps free_port(): the CLI's `-grpc-port=0` means
+    # "config default" (falsy falls back to 9095 — the frontend pull
+    # test below RELIES on that), so the race-free ephemeral bind is
+    # only reachable through ModuleProcess directly (the topology
+    # fixture above, where the PR 6 flake actually lived)
     ing_grpc = free_port()
     dist_grpc = free_port()
     quer_grpc = free_port()
@@ -374,7 +380,7 @@ def test_metrics_generator_target_receives_forwarded_spans(topology):
     seed = [ing.ml.gossip_addr]
     gen = ModuleProcess(
         cfg, "metrics-generator", instance_id="gen-1",
-        grpc_port=free_port(),
+        grpc_port=0,  # ephemeral bind, gossip advertises the real port
         memberlist_cfg={"join": seed, "gossip_interval_s": 0.1,
                         "suspect_timeout_s": 5.0},
     )
@@ -439,8 +445,10 @@ def test_push_bytes_v2_method_name_accepted():
         def push_bytes(self, tenant, req):
             got.append((tenant, list(req.ids)))
 
-    port = free_port()
-    server = make_module_grpc_server(f"127.0.0.1:{port}", pusher=FakePusher())
+    # bind port 0 and read the assignment — never probe-then-bind
+    server = make_module_grpc_server("127.0.0.1:0", pusher=FakePusher())
+    port = server.bound_port
+    assert port, "ephemeral gRPC bind failed"
     server.start()
     try:
         ch = grpc.insecure_channel(f"127.0.0.1:{port}")
