@@ -1307,6 +1307,243 @@ def phase_query_stats_overhead():
     return result
 
 
+def phase_freshness():
+    """Search-freshness SLO (ROADMAP item 4's acceptance instrument):
+    drive a soak-style concurrent write load through the full
+    distributor -> ingester -> WAL -> flush -> poll pipeline and
+    measure push->searchable end to end with REAL canary round trips.
+    Contracts asserted every round:
+
+      - the white-box freshness gauge (tempo_search_freshness_seconds,
+        stamped at poll from block end_times) and the black-box canary
+        measurement agree within one poll interval;
+      - `ingest_telemetry_enabled: false` is a TRUE noop — the WAL
+        bytes a push produces are identical on/off;
+      - the enabled telemetry record protocol costs < 2% of a push ack.
+    """
+    import tempfile
+    import threading
+
+    from tempo_tpu.modules import App, AppConfig
+    from tempo_tpu.observability import ingest_telemetry
+    from tempo_tpu.observability import metrics as obs
+    from tempo_tpu.observability.ingest_telemetry import (
+        TELEMETRY, IngestCanary)
+    from tempo_tpu.utils.test_data import make_trace
+
+    soak_s = float(os.environ.get("BENCH_FRESH_SECONDS", 6.0))
+    writers = int(os.environ.get("BENCH_FRESH_WRITERS", 2))
+    probes = int(os.environ.get("BENCH_FRESH_PROBES", 6))
+    flush_every = float(os.environ.get("BENCH_FRESH_FLUSH_S", 0.25))
+    poll_every = float(os.environ.get("BENCH_FRESH_POLL_S", 0.5))
+
+    from tempo_tpu.modules import Limits
+
+    tmp = tempfile.mkdtemp(prefix="bench-freshness-")
+    # soak limits: the phase measures the pipeline, not tenant pushback
+    lim = Limits(ingestion_rate_bytes=1 << 30,
+                 ingestion_burst_bytes=1 << 30,
+                 max_live_traces=1_000_000)
+    app = App(AppConfig(wal_dir=os.path.join(tmp, "wal"),
+                        ingest_telemetry_enabled=True, limits=lim))
+
+    def _now_trace(seed: int):
+        """A make_trace stamped NOW: the freshness gauge derives from
+        block end_times, so soak spans must carry real wall clock."""
+        tr = make_trace(os.urandom(16), seed=seed)
+        now_ns = time.time_ns()
+        for b in tr.batches:
+            for ss in b.scope_spans:
+                for sp in ss.spans:
+                    dur = max(1, (sp.end_time_unix_nano
+                                  - sp.start_time_unix_nano)
+                              % 1_000_000_000)
+                    sp.start_time_unix_nano = now_ns - dur
+                    sp.end_time_unix_nano = now_ns
+        return tr
+
+    stop = threading.Event()
+    pushed = [0] * writers
+
+    def writer(w: int) -> None:
+        i = 0
+        while not stop.is_set():
+            tr = _now_trace(w * 1_000_003 + i)
+            try:
+                app.push(f"soak-{w}", list(tr.batches))
+                pushed[w] += 1
+            except Exception:  # noqa: BLE001 — limits under soak are fine
+                pass
+            i += 1
+            # yield: a zero-sleep loop per writer starves the GIL and
+            # turns the measurement into a scheduler bench — the load
+            # should stress the pipeline, not freeze the poll loop
+            time.sleep(0.001)
+
+    def maintenance() -> None:
+        last_poll = 0.0
+        while not stop.wait(flush_every):
+            try:
+                app.flush_tick(force=True)
+                if time.monotonic() - last_poll >= poll_every:
+                    app.poll_tick()
+                    last_poll = time.monotonic()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                pass
+
+    threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+               for w in range(writers)]
+    threads.append(threading.Thread(target=maintenance, daemon=True))
+    soak_t0 = time.monotonic()
+    for t in threads:
+        t.start()
+
+    canary = IngestCanary(app.push, app.reader_db.search,
+                          tenant="canary", poll_step_s=0.05)
+    # warmup probe (not sampled): the FIRST canary search pays the scan
+    # kernels' XLA compile, which belongs to the query path, not the
+    # write path this phase measures — steady-state probes hit the
+    # compile cache like a real deployment's standing canary
+    canary.probe_once(timeout_s=60.0)
+    canary.probes = canary.failures = 0
+    samples: list[float] = []
+    gauge_diffs: list[float] = []
+    deadline = time.monotonic() + max(soak_s, probes * 2.0) + 30.0
+    while len(samples) + canary.failures < probes \
+            and time.monotonic() < deadline:
+        f = canary.probe_once(timeout_s=15.0)
+        if f is None:
+            continue
+        samples.append(f)
+        # the gauge was stamped at the poll that made the canary block
+        # visible: it and the measured round trip may differ by at most
+        # the time between that poll and the probe's next check — one
+        # poll interval (+ the probe's own step)
+        gauge = obs.search_freshness.value(tenant="canary")
+        if gauge:
+            gauge_diffs.append(abs(gauge - f))
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    # writers run until the probe loop finishes (warmup included), so
+    # the rate divides by the ACTUAL elapsed soak wall time — dividing
+    # by the nominal soak_s would overstate it by the probe duration
+    soak_elapsed = time.monotonic() - soak_t0
+    soak_pushed = sum(pushed)
+
+    # ---- ack-overhead contract: telemetry record protocol < 2% ----
+    # per-push ack time measured enabled (the shipping default), then
+    # the EXACT protocol an enabled push adds (one enabled-check + two
+    # perf_counter reads + one histogram observe) timed against the
+    # disabled path — deterministic, immune to shared-host noise
+    # (profile_overhead's lesson)
+    N_ACK = int(os.environ.get("BENCH_FRESH_ACK_ITERS", 300))
+    # distinct trace ids per push: re-pushing one id appends to the same
+    # live trace until max_bytes_per_trace turns the loop into a limit
+    # bench instead of an ack bench
+    ack_batches = [list(_now_trace(i).batches) for i in range(64)]
+
+    def ack_loop(n):
+        t0 = time.perf_counter()
+        for i in range(n):
+            app.push("ackbench", ack_batches[i % len(ack_batches)])
+        return time.perf_counter() - t0
+
+    ack_loop(30)  # warm
+    push_us = min(ack_loop(N_ACK) for _ in range(3)) / N_ACK * 1e6
+
+    def protocol_loop(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if TELEMETRY.enabled:
+                t1 = time.perf_counter()
+                TELEMETRY.record_push_ack(time.perf_counter() - t1)
+        return time.perf_counter() - t0
+
+    N_PROTO = 20_000
+    protocol_loop(1000)
+    record_us = min(protocol_loop(N_PROTO) for _ in range(3)) \
+        / N_PROTO * 1e6
+    ingest_telemetry.configure(enabled=False)
+    try:
+        noop_us = min(protocol_loop(N_PROTO) for _ in range(3)) \
+            / N_PROTO * 1e6
+    finally:
+        ingest_telemetry.configure(enabled=True)
+    overhead_pct = (record_us - noop_us) / push_us * 100
+
+    # ---- noop contract: identical WAL bytes with telemetry off ----
+    def wal_bytes(enabled: bool) -> bytes:
+        ingest_telemetry.configure(enabled=enabled)
+        try:
+            a = App(AppConfig(
+                wal_dir=os.path.join(tmp, f"noop-{enabled}"),
+                ingest_telemetry_enabled=enabled))
+            for i in range(8):
+                tr = make_trace(bytes([i]) * 16, seed=i)
+                a.push("noop", list(tr.batches))
+            for ing in a.ingesters.values():
+                ing.instance("noop").cut_complete_traces(force=True)
+            inst = next(iter(a.ingesters.values())).instance("noop")
+            with open(inst.head.path, "rb") as f:
+                data = f.read()
+            with open(inst.head.path + ".search", "rb") as f:
+                return data + b"\x00SEARCH\x00" + f.read()
+        finally:
+            ingest_telemetry.configure(enabled=True)
+
+    byte_identical = wal_bytes(True) == wal_bytes(False)
+
+    samples.sort()
+
+    def pct(p):
+        if not samples:
+            return None
+        return round(samples[min(len(samples) - 1,
+                                 int(p * len(samples)))], 3)
+
+    max_diff = round(max(gauge_diffs), 3) if gauge_diffs else None
+    # tolerance: one poll interval (the agreement contract) + 1s for the
+    # gauge's inherent quantization (BlockMeta.end_time is unix SECONDS,
+    # so the gauge floors the push time) + scheduling margin
+    tolerance = poll_every + 1.0 + 0.25
+    agree = max_diff is not None and max_diff <= tolerance
+    result = {
+        "soak_s": round(soak_elapsed, 2),
+        "writers": writers,
+        "traces_pushed": soak_pushed,
+        "push_rate_per_s": round(soak_pushed / max(soak_elapsed, 1e-9), 1),
+        "flush_interval_s": flush_every,
+        "poll_interval_s": poll_every,
+        "probes": canary.probes,
+        "probe_failures": canary.failures,
+        "push_to_searchable_p50_s": pct(0.50),
+        "push_to_searchable_p99_s": pct(0.99),
+        "gauge_vs_canary_max_diff_s": max_diff,
+        "gauge_agrees_within_poll": agree,
+        "push_ack_us": round(push_us, 1),
+        "record_cost_us": round(record_us - noop_us, 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "within_2pct": overhead_pct < 2.0,
+        "byte_identical": byte_identical,
+    }
+    assert samples, (
+        f"no canary probe became searchable ({canary.failures} failures: "
+        f"{canary.last_error}) — the flush/poll pipeline is wedged")
+    assert agree, (
+        f"freshness gauge and canary disagree by {max_diff}s — more than "
+        f"one poll interval ({poll_every}s) + the 1s end_time "
+        "quantization")
+    assert byte_identical, (
+        "telemetry on/off produced different WAL bytes — the noop "
+        "contract is broken")
+    assert overhead_pct < 2.0, (
+        f"ingest telemetry record cost {record_us - noop_us:.2f}us is "
+        f"{overhead_pct:.2f}% of the {push_us:.0f}us push ack — exceeds "
+        "the 2% budget")
+    return result
+
+
 def phase_scale_10k():
     n_blocks = int(os.environ.get("BENCH_SCALE_BLOCKS", 10_000))
     if not n_blocks:
@@ -1336,6 +1573,7 @@ PHASES = {
     "high_cardinality_full": phase_high_cardinality_full,
     "profile_overhead": phase_profile_overhead,
     "query_stats_overhead": phase_query_stats_overhead,
+    "freshness": phase_freshness,
     "scale_10k": phase_scale_10k,
     "scale_large_blocks": phase_scale_large_blocks,
 }
@@ -1353,6 +1591,7 @@ PHASE_TIMEOUTS = {
     "high_cardinality_full": 420.0,
     "profile_overhead": 300.0,
     "query_stats_overhead": 300.0,
+    "freshness": 420.0,
     "scale_10k": 900.0,
     "scale_large_blocks": 1200.0,
 }
@@ -1601,6 +1840,14 @@ def _assemble(results: dict) -> dict:
     if isinstance(qso, dict):
         doc["detail"]["query_stats"] = (
             qso if not _failed(qso) else {"error": qso.get("error")})
+    # search-freshness SLO: push->searchable p50/p99 under soak write
+    # load + the write-path telemetry contracts (gauge-vs-canary
+    # agreement, noop byte-identity, <2% ack overhead) — ROADMAP item
+    # 4's acceptance instrumentation, tracked round over round
+    fr = results.get("freshness")
+    if isinstance(fr, dict):
+        doc["detail"]["freshness"] = (
+            fr if not _failed(fr) else {"error": fr.get("error")})
     if not ok:
         err = (single or {}).get(
             "error", "headline phase 'single' did not run")

@@ -200,42 +200,19 @@ class HTTPApi:
         if path == "/flush":
             completed = self.app.flush_tick(force=True)
             return 200, {"completed_blocks": len(completed)}
-        if path.startswith("/debug/") and not self.debug_endpoints:
-            return 404, {"error": "debug endpoints disabled "
-                                  "(server.debug_endpoints: true enables)"}
-        if path == "/debug/threads":
-            # faulthandler-style all-thread stack dump (reference pprof
-            # goroutine profile role, cmd/tempo/main.go:54-115): the
-            # first tool for "this process is stuck where?"
-            return 200, self._debug_threads()
-        if path == "/debug/scan":
-            # per-stage breakdown of the last scan + cache occupancy
-            db = getattr(self.app, "reader_db", None)
-            if db is None:
-                return 404, {"error": "no storage reader in this target"}
-            return 200, db.batcher.debug_stats()
-        if path == "/debug/profile":
-            # dispatch profiler: recent per-dispatch stage breakdowns +
-            # process-lifetime aggregates (observability/profile.py)
-            from tempo_tpu.observability.profile import PROFILER
-
-            return 200, PROFILER.snapshot(
-                recent=_int_param(query, "recent", 32))
-        if path == "/debug/planner":
-            # offload planner: decision ring, cost-model rates,
-            # predicted-vs-actual calibration (search/planner.py)
-            from tempo_tpu.search.planner import PLANNER
-
-            return 200, PLANNER.snapshot(
-                recent=_int_param(query, "recent", 32))
-        if path == "/debug/querystats":
-            # per-query inspector: recent queries, per-tenant
-            # device-seconds/bytes aggregates, top-K by cost
-            # (search/query_stats.py)
-            from tempo_tpu.search.query_stats import REGISTRY
-
-            return 200, REGISTRY.snapshot(
-                recent=_int_param(query, "recent", 32))
+        if path.startswith("/debug/"):
+            # ONE gate + ONE registry for every /debug route: a route
+            # registered in DEBUG_ROUTES is automatically covered by the
+            # server.debug_endpoints gate and by the tier-1 contract
+            # test (tests/test_debug_routes.py — every route must answer
+            # valid JSON when enabled and 404 when gated off)
+            if not self.debug_endpoints:
+                return 404, {"error": "debug endpoints disabled "
+                                      "(server.debug_endpoints: true "
+                                      "enables)"}
+            handler = DEBUG_ROUTES.get(path)
+            if handler is not None:
+                return handler(self, query)
         if path == "/shutdown":
             threading.Thread(target=self.app.shutdown, daemon=True).start()
             return 200, "shutting down"
@@ -323,6 +300,54 @@ class HTTPApi:
             return 200, data
         return 404, {"error": f"no jaeger route {sub}"}
 
+    # ---- /debug/* route handlers (registered in DEBUG_ROUTES) ----
+
+    def _debug_threads_route(self, query):
+        # faulthandler-style all-thread stack dump (reference pprof
+        # goroutine profile role, cmd/tempo/main.go:54-115): the
+        # first tool for "this process is stuck where?"
+        return 200, self._debug_threads()
+
+    def _debug_scan_route(self, query):
+        # per-stage breakdown of the last scan + cache occupancy
+        db = getattr(self.app, "reader_db", None)
+        if db is None:
+            return 404, {"error": "no storage reader in this target"}
+        return 200, db.batcher.debug_stats()
+
+    def _debug_profile_route(self, query):
+        # dispatch profiler: recent per-dispatch stage breakdowns +
+        # process-lifetime aggregates (observability/profile.py)
+        from tempo_tpu.observability.profile import PROFILER
+
+        return 200, PROFILER.snapshot(
+            recent=_int_param(query, "recent", 32))
+
+    def _debug_planner_route(self, query):
+        # offload planner: decision ring, cost-model rates,
+        # predicted-vs-actual calibration (search/planner.py)
+        from tempo_tpu.search.planner import PLANNER
+
+        return 200, PLANNER.snapshot(
+            recent=_int_param(query, "recent", 32))
+
+    def _debug_querystats_route(self, query):
+        # per-query inspector: recent queries, per-tenant
+        # device-seconds/bytes aggregates, top-K by cost
+        # (search/query_stats.py)
+        from tempo_tpu.search.query_stats import REGISTRY
+
+        return 200, REGISTRY.snapshot(
+            recent=_int_param(query, "recent", 32))
+
+    def _debug_ingest_route(self, query):
+        # write-path telemetry: per-tenant live/unflushed/backlog state,
+        # last flush/poll ages, WAL replay, slow-flush ring, canary
+        # (observability/ingest_telemetry.py)
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+
+        return 200, TELEMETRY.debug_snapshot(app=self.app)
+
     def _debug_threads(self) -> str:
         """All-thread stack dump as plain text. Pure-Python equivalent of
         faulthandler.dump_traceback (which needs a real fd, not a
@@ -344,6 +369,7 @@ class HTTPApi:
         if path == "/status/config":
             # reference /status/config?mode=diff|defaults (app.go:332-378)
             return self._status_config((query or {}).get("mode", ""))
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
         from tempo_tpu.observability.profile import device_status
 
         out = {
@@ -358,6 +384,10 @@ class HTTPApi:
             # signal bench r04/r05 lacked (never initializes a backend
             # on processes that haven't touched the device)
             "device": device_status(),
+            # search freshness at a glance (the write-path twin of the
+            # device block): per-tenant staleness, oldest unflushed
+            # trace age, last poll age, canary verdict
+            "ingest": TELEMETRY.status(),
         }
         db = getattr(app, "reader_db", None)
         if db is not None:  # targets without a storage reader (distributor)
@@ -424,6 +454,19 @@ class HTTPApi:
 
             return diff(current, to_dict(AppConfig()))
         return current
+
+
+# every /debug route: path -> handler(api, query) -> (code, body).
+# Adding a route HERE is all it takes — the server.debug_endpoints gate
+# in _route and the tier-1 JSON/gating contract test iterate this map.
+DEBUG_ROUTES = {
+    "/debug/threads": HTTPApi._debug_threads_route,
+    "/debug/scan": HTTPApi._debug_scan_route,
+    "/debug/profile": HTTPApi._debug_profile_route,
+    "/debug/planner": HTTPApi._debug_planner_route,
+    "/debug/querystats": HTTPApi._debug_querystats_route,
+    "/debug/ingest": HTTPApi._debug_ingest_route,
+}
 
 
 def _accepts_gzip(header: str | None) -> bool:

@@ -146,6 +146,18 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "frontend_worker_parallelism", 2),
         frontend_grpc_max_workers=frontend_doc.get("grpc_max_workers", 256),
         flush_tick_s=ingester.get("flush_tick_s", 10.0),
+        # write-path telemetry + freshness canary
+        # (docs/observability.md write-path section): telemetry-off is a
+        # true noop on the ingest path; the canary is opt-in because it
+        # writes real (tiny) blocks into its tenant every interval
+        ingest_telemetry_enabled=ingester.get(
+            "ingest_telemetry_enabled", True),
+        ingest_slow_flush_log_s=ingester.get(
+            "ingest_slow_flush_log_s", 30.0),
+        ingest_canary_enabled=ingester.get("ingest_canary_enabled", False),
+        ingest_canary_interval_s=ingester.get(
+            "ingest_canary_interval_s", 30.0),
+        ingest_canary_tenant=ingester.get("ingest_canary_tenant", "canary"),
         poll_tick_s=storage.get("poll_tick_s", 30.0),
         compaction_tick_s=compactor.get("tick_s", 30.0),
         db=db,

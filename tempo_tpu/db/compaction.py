@@ -39,17 +39,16 @@ class TimeWindowBlockSelector:
     max_block_bytes: int = DEFAULT_MAX_BLOCK_BYTES
     active_window_s: int = 24 * 3600
 
-    def blocks_to_compact(self, metas: list[BlockMeta], now_s: int) -> list[BlockMeta]:
+    def blocks_to_compact(self, metas: list[BlockMeta], now_s: int,
+                          groups: dict | None = None) -> list[BlockMeta]:
         """Pick one compaction job: the first group of >= min_inputs
         same-(level, window) blocks, most-populated window first. Inside
         the active window blocks group by (level, window); outside, by
-        window only (levels mix — cf. reference selector)."""
-        groups: dict[tuple, list[BlockMeta]] = {}
-        for m in metas:
-            window = m.end_time // self.window_s if self.window_s else 0
-            active = (now_s - m.end_time) < self.active_window_s
-            key = (m.compaction_level if active else -1, window)
-            groups.setdefault(key, []).append(m)
+        window only (levels mix — cf. reference selector). `groups`: a
+        precomputed _groups(metas, now_s) result, so a caller that also
+        reads outstanding() pays the O(blocks) grouping once."""
+        if groups is None:
+            groups = self._groups(metas, now_s)
 
         def order(item):
             (_level, window), blocks = item
@@ -71,6 +70,32 @@ class TimeWindowBlockSelector:
             if len(picked) >= self.min_inputs:
                 return picked
         return []
+
+    def _groups(self, metas: list[BlockMeta], now_s: int) -> dict:
+        groups: dict[tuple, list[BlockMeta]] = {}
+        for m in metas:
+            window = m.end_time // self.window_s if self.window_s else 0
+            active = (now_s - m.end_time) < self.active_window_s
+            key = (m.compaction_level if active else -1, window)
+            groups.setdefault(key, []).append(m)
+        return groups
+
+    def outstanding(self, metas: list[BlockMeta], now_s: int,
+                    groups: dict | None = None) -> tuple[int, int]:
+        """The compactor's input backlog: (blocks, bytes) across ALL
+        groups that have enough members to compact — what
+        blocks_to_compact would eventually chew through if no new data
+        arrived. One job per tick against a growing value means the
+        compaction loop is behind the write rate."""
+        n_blocks = n_bytes = 0
+        if groups is None:
+            groups = self._groups(metas, now_s)
+        for blocks in groups.values():
+            if len(blocks) < self.min_inputs:
+                continue
+            n_blocks += len(blocks)
+            n_bytes += sum(m.size for m in blocks)
+        return n_blocks, n_bytes
 
 
 def compact_blocks(backend: RawBackend, tenant: str, inputs: list[BlockMeta],

@@ -103,6 +103,13 @@ class Poller:
             if m is not None:
                 self._index_cache[tenant] = (digest, idx)
             created_at = idx.created_at
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+
+        if TELEMETRY.enabled:
+            # index staleness: a growing age means the elected builder
+            # stopped writing — readers keep serving an old blocklist
+            # long before stale_index_s forces the expensive direct poll
+            TELEMETRY.record_index_age(tenant, time.time() - created_at)
         if self.stale_index_s and time.time() - created_at > self.stale_index_s:
             return None
         return idx

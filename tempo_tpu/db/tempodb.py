@@ -356,8 +356,20 @@ class TempoDB:
     # Reader
 
     def poll(self) -> None:
-        metas, compacted = self.poller.poll()
-        self.blocklist.apply_poll_results(metas, compacted)
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+
+        t0 = time.perf_counter()
+        with tracing.start_span("tempodb.Poll") as span:
+            metas, compacted = self.poller.poll()
+            self.blocklist.apply_poll_results(metas, compacted)
+            span.set_attributes(
+                tenants=len(metas),
+                blocks=sum(len(ms) for ms in metas.values()))
+        if TELEMETRY.enabled:
+            # duration + per-tenant blocklist length + the freshness
+            # gauge, and the flush->poll_visible pairing that closes the
+            # push->searchable stage record (ingest_telemetry)
+            TELEMETRY.record_poll(time.perf_counter() - t0, metas)
         live = {m.block_id for ms in metas.values() for m in ms}
         with self._search_lock:
             for bid in [b for b in self._search_blocks if b not in live]:
@@ -903,15 +915,37 @@ class TempoDB:
     # Compactor
 
     def compact_tenant_once(self, tenant: str, now_s: int | None = None) -> BlockMeta | None:
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+
         now_s = int(time.time()) if now_s is None else now_s
-        inputs = self.selector.blocks_to_compact(self.blocklist.metas(tenant), now_s)
+        metas = self.blocklist.metas(tenant)
+        # one grouping pass serves both the job pick and the backlog
+        # gauge — _groups is O(blocks) and this runs per tenant per tick
+        groups = self.selector._groups(metas, now_s)  # noqa: SLF001
+        inputs = self.selector.blocks_to_compact(metas, now_s,
+                                                 groups=groups)
+        if TELEMETRY.enabled:
+            # input backlog BEFORE the run: bytes sitting in compactable
+            # groups — a gauge that keeps climbing means the compactor
+            # loop can't keep up with the write rate
+            n_blocks, n_bytes = self.selector.outstanding(metas, now_s,
+                                                          groups=groups)
+            TELEMETRY.record_compaction_backlog(tenant, n_bytes, n_blocks)
         if not inputs:
             return None
-        new_meta = compact_blocks(self.backend, tenant, inputs,
-                                  page_size=self.cfg.block_page_size,
-                                  search_geometry=self.cfg.search_geometry,
-                                  search_encoding=self.cfg.search_encoding,
-                                  flush_size=self.cfg.compaction_flush_bytes)
+        t0 = time.perf_counter()
+        with tracing.start_span("tempodb.Compact", tenant=tenant) as span:
+            new_meta = compact_blocks(
+                self.backend, tenant, inputs,
+                page_size=self.cfg.block_page_size,
+                search_geometry=self.cfg.search_geometry,
+                search_encoding=self.cfg.search_encoding,
+                flush_size=self.cfg.compaction_flush_bytes)
+            span.set_attributes(inputs=len(inputs),
+                                input_bytes=sum(m.size for m in inputs),
+                                out_block=new_meta.block_id)
+        if TELEMETRY.enabled:
+            TELEMETRY.record_compaction_run(time.perf_counter() - t0)
         obs.compactions.inc(tenant=tenant)
         from tempo_tpu.backend.types import CompactedBlockMeta
 

@@ -61,6 +61,28 @@ class AppConfig:
     # streams each querier opens per discovered query-frontend for pull
     # dispatch (reference querier.frontend_worker parallelism)
     frontend_worker_parallelism: int = 2
+    # write-path telemetry (observability/ingest_telemetry.py): stage
+    # histograms push->searchable, freshness/backlog gauges, slow-flush
+    # log, /debug/ingest. False is a true noop on the ingest path —
+    # record sites branch out on one attribute read, ingest output is
+    # byte-identical (asserted by bench.py's freshness phase)
+    ingest_telemetry_enabled: bool = True
+    # slow-flush JSON log threshold (seconds): a successful block
+    # completion slower than this emits ONE structured line on
+    # tempo_tpu.slowflush (token-bucket rate-limited per tenant under a
+    # global ceiling, the slow-query log's idiom); <= 0 disables the
+    # line — tempo_ingester_slow_flushes_total still counts every one
+    ingest_slow_flush_log_s: float = 30.0
+    # synthetic freshness canary: every interval, push one tagged trace
+    # and poll BACKEND search until it is visible, exporting measured
+    # push->searchable as tempo_ingest_canary_freshness_seconds (+ a
+    # failure counter past the deadline). The black-box complement to
+    # the white-box stage metrics — a wedged flush/poll loop looks
+    # "idle" to each stage individually but times the canary out. Off
+    # by default: it writes real (tiny) blocks into its tenant.
+    ingest_canary_enabled: bool = False
+    ingest_canary_interval_s: float = 30.0
+    ingest_canary_tenant: str = "canary"
     # gRPC executor threads on the query-frontend: every pull stream
     # PARKS one thread for its lifetime, so size this above queriers ×
     # parallelism + unary headroom — a starved stream is silent
@@ -124,6 +146,23 @@ class App:
         from tempo_tpu.observability import tracing
         self.tracer = tracing.init_tracing(self.cfg.self_tracing,
                                            push=self.push)
+        # write-path telemetry + freshness canary (process-wide sink,
+        # the profiler idiom: the most recent App's config wins)
+        from tempo_tpu.observability import ingest_telemetry
+        ingest_telemetry.configure(
+            enabled=self.cfg.ingest_telemetry_enabled,
+            slow_flush_log_s=self.cfg.ingest_slow_flush_log_s)
+        self.canary = None
+        if self.cfg.ingest_canary_enabled:
+            # the canary searches the READER db, not the frontend: the
+            # frontend's ingester leg would see the live trace instantly
+            # and mask the very flush/poll wedge the probe exists for
+            self.canary = ingest_telemetry.IngestCanary(
+                push_fn=self.push,
+                search_fn=self.reader_db.search,
+                tenant=self.cfg.ingest_canary_tenant,
+                interval_s=self.cfg.ingest_canary_interval_s)
+        ingest_telemetry.TELEMETRY.canary = self.canary
 
     # ---- public API surface (what api/http.py routes onto) ----
 
@@ -184,6 +223,8 @@ class App:
         loop(5.0, self.heartbeat_tick)
         if self.remote_write is not None:
             self.remote_write.start()
+        if self.canary is not None:
+            self.canary.start()
         self.start_receivers()
 
     def start_receivers(self) -> None:
@@ -208,6 +249,8 @@ class App:
     def shutdown(self) -> None:
         """Graceful: flush everything, stop loops (reference /shutdown)."""
         self._stop.set()
+        if self.canary is not None:
+            self.canary.stop()
         for rx in self._receivers:
             rx.stop()
         self._receivers.clear()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 
 from tempo_tpu import tempopb
@@ -96,7 +97,19 @@ class Distributor:
 
     def push_batches(self, tenant: str, batches: list) -> None:
         """The write hot path (reference PushBatches → requestsByTraceID →
-        sendToIngestersViaBytes, SURVEY.md §3.1)."""
+        sendToIngestersViaBytes, SURVEY.md §3.1). The push_ack stage
+        observation wraps the whole method — it is the latency a client
+        experiences before its spans are durable on RF ingesters' WALs
+        (telemetry-off pays one attribute read, no clock)."""
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+
+        if not TELEMETRY.enabled:
+            return self._push_batches(tenant, batches)
+        t0 = time.perf_counter()
+        self._push_batches(tenant, batches)
+        TELEMETRY.record_push_ack(time.perf_counter() - t0)
+
+    def _push_batches(self, tenant: str, batches: list) -> None:
         if not tenant:
             raise IngestError("missing tenant")
         blobs = None

@@ -53,6 +53,11 @@ class _LiveTrace:
     segments: list = field(default_factory=list)
     nbytes: int = 0
     last_append: float = 0.0
+    # monotonic stamp of the FIRST push — the head of the write-path
+    # telemetry record (push -> cut -> flush -> poll visibility). Set
+    # from the clock read the push path already makes, so stamping
+    # costs nothing even with telemetry disabled.
+    first_push: float = 0.0
     # encoded SearchData fragments, decoded+merged LAZILY: the ack path
     # runs per push, while folding is only needed at live-search or cut
     # time — decode-per-push was ~10% of distributor→ingester latency
@@ -94,6 +99,11 @@ class _Completing:
     retry_at: float = 0.0   # monotonic time before which we skip it
     backoff_s: float = 0.0
     in_flight: bool = False  # being completed right now (still queryable)
+    attempts: int = 0        # failed completion attempts (retry telemetry)
+    cut_at: float = 0.0      # monotonic time the block was cut
+    # oldest first_push among the traces in this block (None for
+    # replayed blocks — their live traces predate this process)
+    oldest_ingest: float | None = None
 
 
 class TenantInstance:
@@ -121,6 +131,8 @@ class TenantInstance:
         self.head = self.db.wal.new_block(self.tenant)
         self.head_search = StreamingSearchBlock(self.head.path + ".search")
         self.head_created = time.monotonic()
+        # oldest first_push cut into THIS head block (inf = none yet)
+        self.head_oldest = float("inf")
 
     # ---- write path ----
 
@@ -128,6 +140,7 @@ class TenantInstance:
              search_data: bytes = b"") -> None:
         tid = pad_trace_id(trace_id)
         lim = self.overrides.limits(self.tenant)
+        now = time.monotonic()
         with self.lock:
             t = self.live.get(tid)
             if t is None:
@@ -135,12 +148,12 @@ class TenantInstance:
                     raise LimitError(
                         f"max live traces ({lim.max_live_traces}) reached"
                     )
-                t = self.live[tid] = _LiveTrace()
+                t = self.live[tid] = _LiveTrace(first_push=now)
             if t.nbytes + len(segment) > lim.max_bytes_per_trace:
                 raise LimitError("max bytes per trace reached")
             t.segments.append(segment)
             t.nbytes += len(segment)
-            t.last_append = time.monotonic()
+            t.last_append = now
             obs.live_traces.set(len(self.live), tenant=self.tenant)
             if search_data:
                 t.search_raw.append(search_data)
@@ -149,8 +162,11 @@ class TenantInstance:
 
     def cut_complete_traces(self, max_idle_s: float = 10.0,
                             force: bool = False) -> int:
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+
         now = time.monotonic()
         cut = 0
+        cut_ages: list[float] = []
         with self.lock:
             for tid in list(self.live):
                 t = self.live[tid]
@@ -162,24 +178,40 @@ class TenantInstance:
                 sd = t.search_data(tid)
                 if sd is not None:
                     self.head_search.append(tid, sd)
+                if t.first_push:
+                    if t.first_push < self.head_oldest:
+                        self.head_oldest = t.first_push
+                    if TELEMETRY.enabled:
+                        cut_ages.append(now - t.first_push)
                 del self.live[tid]
                 cut += 1
             obs.live_traces.set(len(self.live), tenant=self.tenant)
+        for age in cut_ages:  # outside the instance lock — observe locks
+            TELEMETRY.record_live_cut(age)
         return cut
 
     def cut_block_if_ready(self, max_block_bytes: int = 500 << 20,
                            max_block_age_s: float = 1800.0,
                            force: bool = False) -> bool:
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+
+        now = time.monotonic()
         with self.lock:
             if len(self.head) == 0:
                 return False
-            age = time.monotonic() - self.head_created
+            age = now - self.head_created
             if not (force or self.head.data_length >= max_block_bytes
                     or age >= max_block_age_s):
                 return False
-            self.completing.append(_Completing(self.head, self.head_search))
+            oldest = (self.head_oldest
+                      if self.head_oldest != float("inf") else None)
+            self.completing.append(_Completing(
+                self.head, self.head_search, cut_at=now,
+                oldest_ingest=oldest))
             self._new_head()
-            return True
+        if TELEMETRY.enabled:
+            TELEMETRY.record_block_cut(age)
+        return True
 
     def complete_one(self, block_id: str | None = None,
                      ignore_backoff: bool = False) -> "tempopb.Trace | None":
@@ -208,6 +240,9 @@ class TenantInstance:
                 return None
             c.in_flight = True
         from tempo_tpu.observability import tracing
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+
+        t0 = time.perf_counter()
         with tracing.start_span("ingester.CompleteBlock",
                                 tenant=self.tenant) as span:
             try:
@@ -220,19 +255,33 @@ class TenantInstance:
                                else min(c.backoff_s * 2,
                                         self.FLUSH_BACKOFF_MAX_S))
                 c.retry_at = time.monotonic() + c.backoff_s
+                c.attempts += 1
                 obs.flush_failures.inc(tenant=self.tenant)
+                if TELEMETRY.enabled:
+                    TELEMETRY.record_flush_retry(c.attempts)
                 with self.lock:
                     c.in_flight = False
                 raise
+            flush_trace_id = (span.context.trace_id.hex()
+                              if span.recording else None)
+        done = time.monotonic()
         with self.lock:
             # atomic hand-off: queryable via `recent` (backend) the same
             # instant it leaves `completing` (WAL)
             self.completing.remove(c)
-            self.recent.append((meta, time.monotonic()))
+            self.recent.append((meta, done))
         c.blk.clear()
         c.search.clear()
         obs.blocks_completed.inc(tenant=self.tenant)
         obs.live_traces.set(len(self.live), tenant=self.tenant)
+        if TELEMETRY.enabled:
+            TELEMETRY.record_flush(
+                self.tenant, meta.block_id,
+                write_s=time.perf_counter() - t0,
+                cut_to_flush_s=(done - c.cut_at) if c.cut_at else -1.0,
+                oldest_ingest=c.oldest_ingest,
+                objects=meta.total_objects, attempts=c.attempts,
+                trace_id=flush_trace_id)
         return meta
 
     def clear_flushed(self) -> None:
@@ -486,7 +535,37 @@ class Ingester:
                 t.start()
             for t in threads:
                 t.join()
+        self._publish_queue_state()
         return completed
+
+    def _publish_queue_state(self) -> None:
+        """Post-drain backlog gauges: per-tenant flush-queue depth and
+        the age of the oldest trace not yet flushed (head + completing)
+        — the white-box 'how far behind is this ingester' signal."""
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+
+        if not TELEMETRY.enabled:
+            return
+        now = time.monotonic()
+        for tenant in self.tenants():
+            inst = self.instance(tenant)
+            with inst.lock:
+                qlen = len(inst.completing)
+                # replayed blocks carry no push stamp (oldest_ingest is
+                # None) — fall back to their enqueue time so a wedged
+                # post-restart backlog ages instead of reading 0
+                candidates = [c.oldest_ingest if c.oldest_ingest is not None
+                              else c.cut_at
+                              for c in inst.completing if c.cut_at]
+                if len(inst.head) and inst.head_oldest != float("inf"):
+                    candidates.append(inst.head_oldest)
+                live_oldest = [t.first_push
+                               for t in inst.live.values() if t.first_push]
+                if live_oldest:
+                    candidates.append(min(live_oldest))
+            oldest = min(candidates, default=None)
+            TELEMETRY.set_queue_state(
+                tenant, qlen, (now - oldest) if oldest is not None else 0.0)
 
     def flush_all(self, settle_timeout_s: float = 60.0) -> list:
         """Graceful shutdown / scale-down: force everything to the backend
@@ -550,7 +629,26 @@ class Ingester:
     # ---- replay (reference replayWal ingester.go:327-416) ----
 
     def _replay(self) -> None:
+        from tempo_tpu.observability import get_logger
+        from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+
         blocks, _removed = self.db.wal.replay_all()
+        stats = self.db.wal.last_replay or {}
+        # replay happens exactly once per process start and gates
+        # readiness — log it always, export it when telemetry is on, so
+        # a 90-second restart is attributable to the N GB it re-scanned
+        if blocks or stats.get("removed_files"):
+            get_logger("tempo_tpu.ingester").info(
+                "wal replay: %d block(s), %d bytes, %d corrupt record(s) "
+                "dropped, %d file(s) removed in %.3fs",
+                stats.get("blocks", 0), stats.get("bytes", 0),
+                stats.get("corrupt_records", 0),
+                stats.get("removed_files", 0),
+                stats.get("duration_s", 0.0))
+        if TELEMETRY.enabled:
+            TELEMETRY.record_wal_replay(
+                stats.get("duration_s", 0.0), stats.get("blocks", 0),
+                stats.get("bytes", 0), stats.get("corrupt_records", 0))
         for blk in blocks:
             tenant = blk.meta.tenant_id
             inst = self.instance(tenant)
@@ -563,6 +661,13 @@ class Ingester:
                 ssb = StreamingSearchBlock(spath)
             # replayed head blocks go straight to completing: they will be
             # completed by the next sweep (reference re-enqueues completion
-            # ops for replayed blocks)
-            inst.completing.append(_Completing(blk, ssb))
+            # ops for replayed blocks). cut_at stamps NOW — the traces'
+            # real push times predate this process, so the queue-age
+            # gauge counts from restart (it must read nonzero and GROW
+            # while a backlogged restart can't flush, not report 0 =
+            # "fully flushed"); oldest_ingest stays None so the
+            # push_to_searchable histogram is never fed restart-relative
+            # values
+            inst.completing.append(_Completing(blk, ssb,
+                                               cut_at=time.monotonic()))
             self.replayed_blocks += 1

@@ -1,7 +1,9 @@
 from .metrics import REGISTRY, Counter, Gauge, Histogram
-from .log import get_logger, RateLimitedLogger
+from .log import get_logger, RateLimitedLogger, TenantTokenBucket
 
 __all__ = ["REGISTRY", "Counter", "Gauge", "Histogram", "get_logger",
-           "RateLimitedLogger", "profile"]
+           "RateLimitedLogger", "TenantTokenBucket", "profile",
+           "ingest_telemetry"]
 
 from . import profile  # noqa: E402 — imports metrics+tracing above
+from . import ingest_telemetry  # noqa: E402 — same ordering constraint

@@ -1,0 +1,485 @@
+"""Write-path telemetry: how stale is search, and where is the time?
+
+The read path is deeply observable (the dispatch profiler, the
+per-query inspector); this module gives the WRITE path that feeds it
+the same treatment. An ingest-time record threads through
+
+    push ack -> live-trace cut -> block cut -> backend flush
+             -> blocklist-poll visibility
+
+and every hand-off lands in ``tempo_ingest_stage_seconds{stage}`` so
+"push->searchable" decomposes into the stage that actually ate it.
+Three layers:
+
+1. **Stage timestamps.** The distributor times the ack; the ingester
+   stamps each live trace's first push (reusing the clock read the ack
+   path already pays), carries the oldest stamp through the head block
+   and every ``_Completing`` entry, and the flush books cut->flushed.
+   The reader's poll pairs newly visible block ids against flush
+   records registered here and closes the loop with ``poll_visible``
+   and the end-to-end ``push_to_searchable`` observation.
+
+2. **Backlog visibility.** Flush queue depth, retry/backoff attempts,
+   WAL replay duration/bytes, poll cycle duration + per-tenant
+   blocklist length + tenant-index staleness, compaction outstanding
+   bytes + per-run duration — with self-trace spans on flush/poll/
+   compaction so a slow cycle links to an exemplar trace.
+
+3. **The freshness canary** (:class:`IngestCanary`, opt-in): a real
+   tagged trace pushed per interval and polled through real search
+   until visible — the black-box check that catches a wedged
+   flush/poll loop that every white-box stage metric individually
+   misses (each stage looks "idle", none looks "stuck").
+
+Noop contract (the profiler / query-stats stance):
+``ingest_telemetry_enabled: false`` means record sites branch out on
+one attribute read — no clock reads beyond the ones ingest already
+makes, no locks, and byte-identical ingest output (the bench
+``freshness`` phase asserts both the noop and the <2% enabled ack
+overhead).
+
+Surfaces: ``/debug/ingest`` (per-tenant live/unflushed/backlog + last
+flush/poll ages + canary state), the ``/status`` ``ingest`` block, a
+rate-limited slow-flush JSON log on ``tempo_tpu.slowflush`` past
+``ingest_slow_flush_log_s`` (the slow-query log's token-bucket
+limiter, shared class), and the bench ``freshness`` phase.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+
+from . import metrics as obs
+from .log import TenantTokenBucket, get_logger
+
+log = get_logger("tempo_tpu.ingest")
+slow_flush_log = get_logger("tempo_tpu.slowflush")
+
+# flush->visibility pairing entries kept (tenant, block_id) -> record.
+# Bounded: a reader that never polls (write-only process) must not
+# grow this forever; dropped entries just lose one histogram point.
+_PENDING_MAX = 4096
+_SLOW_RING = 32
+
+
+def _attempt_bucket(attempt: int) -> str:
+    return str(attempt) if attempt < 4 else "4+"
+
+
+class IngestTelemetry:
+    """Process-wide write-path telemetry sink (module singleton
+    ``TELEMETRY``, the PROFILER/REGISTRY idiom: the most recent App's
+    config wins)."""
+
+    def __init__(self):
+        self.enabled = True
+        self.slow_flush_log_s = 30.0
+        self._lock = threading.Lock()
+        # (tenant, block_id) -> (flush_done_mono, oldest_ingest_mono)
+        self._pending: OrderedDict[tuple, tuple] = OrderedDict()
+        # tenant -> {t_mono, duration_s, block_id, objects}
+        self._last_flush: dict[str, dict] = {}
+        # tenant -> {queue_length, oldest_unflushed_s, t_mono}
+        self._queues: dict[str, dict] = {}
+        self._last_poll: dict = {}
+        self._wal_replay: dict = {}
+        self._freshness: dict[str, float] = {}
+        self._polled_tenants: set[str] = set()
+        self._slow_flushes: deque = deque(maxlen=_SLOW_RING)
+        self._limiter = TenantTokenBucket()
+        self.canary = None  # IngestCanary, attached by the App
+
+    # ---- write-path recording (callers gate on .enabled) ----
+
+    # stage observations are deliberately tenant-UNLABELED (the stage
+    # histogram's cardinality is |stages|, not |stages| x |tenants|),
+    # so these take no tenant — per-tenant write-path state lives in
+    # the gauges (queue length, oldest unflushed, freshness)
+
+    def record_push_ack(self, seconds: float) -> None:
+        obs.ingest_stage_seconds.observe(seconds, stage="push_ack")
+
+    def record_live_cut(self, age_s: float) -> None:
+        obs.ingest_stage_seconds.observe(age_s, stage="live_cut")
+
+    def record_block_cut(self, age_s: float) -> None:
+        obs.ingest_stage_seconds.observe(age_s, stage="block_cut")
+
+    def record_flush(self, tenant: str, block_id: str, *,
+                     write_s: float, cut_to_flush_s: float,
+                     oldest_ingest: float | None, objects: int = 0,
+                     attempts: int = 0, trace_id: str | None = None
+                     ) -> None:
+        """One SUCCESSFUL block completion. Registers the block for the
+        poll-visibility pairing and emits the slow-flush log line past
+        the threshold."""
+        now = time.monotonic()
+        obs.flush_duration_seconds.observe(write_s, tenant=tenant)
+        obs.ingest_stage_seconds.observe(write_s, stage="flush_write")
+        if cut_to_flush_s >= 0:
+            obs.ingest_stage_seconds.observe(cut_to_flush_s, stage="flush")
+        entry = None
+        if self.slow_flush_log_s > 0 and write_s >= self.slow_flush_log_s:
+            entry = {"msg": "slow flush", "tenant": tenant,
+                     "block_id": block_id,
+                     "threshold_s": self.slow_flush_log_s,
+                     "duration_s": round(write_s, 3),
+                     "cut_to_flush_s": round(max(0.0, cut_to_flush_s), 3),
+                     "objects": objects, "attempts": attempts}
+            if trace_id:
+                entry["trace_id"] = trace_id
+        with self._lock:
+            self._pending[(tenant, block_id)] = (now, oldest_ingest)
+            while len(self._pending) > _PENDING_MAX:
+                self._pending.popitem(last=False)
+            self._last_flush[tenant] = {
+                "t_mono": now, "duration_s": write_s,
+                "block_id": block_id, "objects": objects,
+            }
+            if entry is not None:
+                self._slow_flushes.append(entry)
+        if entry is not None:
+            obs.slow_flushes.inc(tenant=tenant)
+            if self._limiter.allow(tenant):
+                slow_flush_log.warning("%s", json.dumps(
+                    entry, separators=(",", ":"), sort_keys=True))
+
+    def record_flush_retry(self, attempt: int) -> None:
+        # attempt-bucket only, no tenant: the failure itself is already
+        # tenant-attributed by tempo_ingester_failed_flushes_total
+        obs.flush_retries.inc(attempt=_attempt_bucket(attempt))
+
+    def set_queue_state(self, tenant: str, queue_length: int,
+                        oldest_unflushed_s: float) -> None:
+        obs.flush_queue_length.set(queue_length, tenant=tenant)
+        obs.oldest_unflushed.set(round(oldest_unflushed_s, 3),
+                                 tenant=tenant)
+        with self._lock:
+            self._queues[tenant] = {
+                "queue_length": queue_length,
+                "oldest_unflushed_s": round(oldest_unflushed_s, 3),
+                "t_mono": time.monotonic(),
+            }
+
+    def record_wal_replay(self, duration_s: float, blocks: int,
+                          nbytes: int, corrupt_records: int = 0) -> None:
+        obs.wal_replay_seconds.set(round(duration_s, 6))
+        obs.wal_replayed_blocks.set(blocks)
+        obs.wal_replayed_bytes.set(nbytes)
+        with self._lock:
+            self._wal_replay = {
+                "duration_s": round(duration_s, 6), "blocks": blocks,
+                "bytes": nbytes, "corrupt_records": corrupt_records,
+            }
+
+    # ---- read-side recording (poller / compaction feed) ----
+
+    def record_poll(self, duration_s: float, metas: dict) -> None:
+        """One blocklist poll cycle: duration + per-tenant blocklist
+        length + freshness gauge, and resolve flush->visibility pairs
+        for block ids this poll made searchable. Tenants that vanished
+        from the poll (or lost all blocks) get their per-tenant series
+        REMOVED — a frozen last value would read as 'fresh' for a
+        tenant whose searchable data is gone."""
+        now = time.monotonic()
+        now_unix = time.time()
+        obs.blocklist_poll_seconds.observe(duration_s)
+        live_by_tenant: dict[str, set] = {}
+        fresh_now: dict[str, float] = {}
+        for tenant, ms in metas.items():
+            live_by_tenant[tenant] = {m.block_id for m in ms}
+            obs.blocklist_length.set(len(ms), tenant=tenant)
+            newest = max((m.end_time for m in ms), default=0)
+            if newest:
+                fresh_now[tenant] = round(max(0.0, now_unix - newest), 3)
+                obs.search_freshness.set(fresh_now[tenant], tenant=tenant)
+        with self._lock:
+            fresh_gone = [t for t in self._freshness if t not in fresh_now]
+            self._freshness = fresh_now
+            tenants_gone = self._polled_tenants - set(metas)
+            self._polled_tenants = set(metas)
+            resolved = [k for k in self._pending
+                        if k[1] in live_by_tenant.get(k[0], ())]
+            pairs = [(k, self._pending.pop(k)) for k in resolved]
+            self._last_poll = {
+                "t_mono": now, "duration_s": round(duration_s, 6),
+                "tenants": len(metas),
+                "blocks": sum(len(ms) for ms in metas.values()),
+            }
+        for t in fresh_gone:
+            obs.search_freshness.remove(tenant=t)
+        for t in tenants_gone:
+            # drop EVERY per-tenant series this sink owns: a tenant
+            # that lost its backend presence must not keep exporting
+            # frozen index-age/backlog values (the ingester re-sets the
+            # queue gauges on its next sweep for instances it still
+            # holds, so a live-but-unflushed tenant self-heals)
+            obs.blocklist_length.remove(tenant=t)
+            obs.blocklist_index_age.remove(tenant=t)
+            obs.flush_queue_length.remove(tenant=t)
+            obs.oldest_unflushed.remove(tenant=t)
+            with self._lock:
+                self._queues.pop(t, None)
+        for (_tenant, _bid), (flush_t, oldest_ingest) in pairs:
+            obs.ingest_stage_seconds.observe(max(0.0, now - flush_t),
+                                             stage="poll_visible")
+            if oldest_ingest is not None:
+                obs.ingest_stage_seconds.observe(
+                    max(0.0, now - oldest_ingest),
+                    stage="push_to_searchable")
+
+    def record_index_age(self, tenant: str, age_s: float) -> None:
+        obs.blocklist_index_age.set(round(max(0.0, age_s), 3),
+                                    tenant=tenant)
+
+    def record_compaction_backlog(self, tenant: str, nbytes: int,
+                                  blocks: int = 0) -> None:
+        obs.compaction_outstanding_bytes.set(nbytes, tenant=tenant)
+        obs.compaction_outstanding_blocks.set(blocks, tenant=tenant)
+
+    def record_compaction_run(self, duration_s: float) -> None:
+        obs.compaction_duration_seconds.observe(duration_s)
+
+    # ---- surfaces ----
+
+    def status(self) -> dict:
+        """The compact /status ``ingest`` block: freshness + backlog at
+        a glance (ages relative to now, so the block is directly
+        readable)."""
+        now = time.monotonic()
+        with self._lock:
+            tenants = sorted(set(self._freshness) | set(self._queues))
+            out = {
+                "freshness_seconds": dict(self._freshness),
+                "oldest_unflushed_seconds": {
+                    t: self._queues[t]["oldest_unflushed_s"]
+                    for t in tenants if t in self._queues},
+                "last_poll_age_s": (
+                    round(now - self._last_poll["t_mono"], 3)
+                    if self._last_poll else None),
+            }
+            if self.canary is not None:
+                out["canary"] = self.canary.state()
+        return out
+
+    def debug_snapshot(self, app=None) -> dict:
+        """The full /debug/ingest document. `app` (when this process
+        runs ingesters) contributes the LIVE view — tenants' in-memory
+        traces and completing queues — next to the history this sink
+        holds."""
+        now = time.monotonic()
+        with self._lock:
+            out = {
+                "enabled": self.enabled,
+                "slow_flush_log_s": self.slow_flush_log_s,
+                "freshness_seconds": dict(self._freshness),
+                "queues": {
+                    t: {"queue_length": q["queue_length"],
+                        "oldest_unflushed_s": q["oldest_unflushed_s"],
+                        "age_s": round(now - q["t_mono"], 3)}
+                    for t, q in sorted(self._queues.items())},
+                "last_flush": {
+                    t: {"age_s": round(now - f["t_mono"], 3),
+                        "duration_s": round(f["duration_s"], 6),
+                        "block_id": f["block_id"],
+                        "objects": f["objects"]}
+                    for t, f in sorted(self._last_flush.items())},
+                "last_poll": (
+                    {k: v for k, v in dict(
+                        self._last_poll,
+                        age_s=round(now - self._last_poll["t_mono"], 3)
+                    ).items() if k != "t_mono"}
+                    if self._last_poll else None),
+                "wal_replay": dict(self._wal_replay) or None,
+                "pending_visibility": len(self._pending),
+                "slow_flushes": list(self._slow_flushes),
+            }
+        if self.canary is not None:
+            out["canary"] = self.canary.state()
+        if app is not None and getattr(app, "ingesters", None):
+            live = {}
+            for iid, ing in app.ingesters.items():
+                for tenant in ing.tenants():
+                    inst = ing.instance(tenant)
+                    with inst.lock:
+                        d = live.setdefault(tenant, {
+                            "live_traces": 0, "head_objects": 0,
+                            "completing_blocks": 0, "recent_blocks": 0})
+                        d["live_traces"] += len(inst.live)
+                        d["head_objects"] += len(inst.head)
+                        d["completing_blocks"] += len(inst.completing)
+                        d["recent_blocks"] += len(inst.recent)
+            out["live"] = live
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pending.clear()
+            self._last_flush.clear()
+            self._queues.clear()
+            self._last_poll = {}
+            self._wal_replay = {}
+            self._freshness = {}
+            self._polled_tenants = set()
+            self._slow_flushes.clear()
+            self._limiter = TenantTokenBucket()
+
+
+TELEMETRY = IngestTelemetry()
+
+
+def configure(enabled: bool | None = None,
+              slow_flush_log_s: float | None = None) -> IngestTelemetry:
+    """Apply AppConfig.ingest_telemetry_enabled / ingest_slow_flush_log_s
+    to the process sink (most recent App wins, the profiler idiom)."""
+    if enabled is not None:
+        TELEMETRY.enabled = bool(enabled)
+    if slow_flush_log_s is not None:
+        TELEMETRY.slow_flush_log_s = float(slow_flush_log_s)
+    return TELEMETRY
+
+
+class IngestCanary:
+    """Synthetic freshness prober: push one tagged trace per interval,
+    poll BACKEND search until it is visible, export the measured
+    push->searchable. Deliberately black-box — it exercises the same
+    distributor -> ingester -> WAL -> flush -> poll -> scan pipeline a
+    tenant's data takes (the search_fn the App wires is the reader
+    TempoDB, which sees a trace only after flush+poll; the ingester
+    live path would report ~0 and mask the very wedge this exists to
+    catch).
+
+    Off by default (``ingest_canary_enabled``): it writes real blocks
+    into its tenant and keeps a poll loop running. Tests and the bench
+    drive :meth:`probe_once` directly instead of the thread."""
+
+    def __init__(self, push_fn, search_fn, tenant: str = "canary",
+                 interval_s: float = 30.0, timeout_s: float | None = None,
+                 poll_step_s: float = 0.25):
+        self.push_fn = push_fn
+        self.search_fn = search_fn
+        self.tenant = tenant
+        self.interval_s = interval_s
+        # a probe that outlives flush tick + poll tick + margin is a
+        # failure; default scales with the probe interval so operators
+        # tightening the interval tighten the alarm with it
+        self.timeout_s = timeout_s if timeout_s else max(60.0,
+                                                         2 * interval_s)
+        self.poll_step_s = poll_step_s
+        self.probes = 0
+        self.failures = 0
+        self.last_freshness_s: float | None = None
+        self.last_error: str | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _make_batch(self, canary_id: str):
+        """One single-span trace stamped NOW, tagged canary.id=<id> —
+        the unique tag is what the probe searches for; real wall-clock
+        times keep the freshness gauge honest for the canary tenant."""
+        import os
+
+        from tempo_tpu import tempopb
+
+        rs = tempopb.ResourceSpans()
+        kv = rs.resource.attributes.add()
+        kv.key = "service.name"
+        kv.value.string_value = "tempo-canary"
+        ss = rs.scope_spans.add()
+        ss.scope.name = "ingest-canary"
+        span = ss.spans.add()
+        span.trace_id = os.urandom(16)
+        span.span_id = os.urandom(8)
+        span.name = "canary-probe"
+        now_ns = time.time_ns()
+        span.start_time_unix_nano = now_ns - 1_000_000
+        span.end_time_unix_nano = now_ns
+        kv = span.attributes.add()
+        kv.key = "canary.id"
+        kv.value.string_value = canary_id
+        return rs
+
+    def probe_once(self, timeout_s: float | None = None) -> float | None:
+        """One full round trip. Returns the measured push->searchable
+        seconds, or None on timeout/error (failure counter bumped)."""
+        import uuid
+
+        from tempo_tpu import tempopb
+
+        canary_id = uuid.uuid4().hex
+        deadline_s = timeout_s if timeout_s is not None else self.timeout_s
+        self.probes += 1
+        # each probe reports its OWN failure cause — a stale error from
+        # the previous round must not masquerade as this timeout's
+        self.last_error = None
+        t0 = time.monotonic()
+        try:
+            self.push_fn(self.tenant, [self._make_batch(canary_id)])
+        except Exception as e:  # noqa: BLE001 — a refused push IS a signal
+            self.failures += 1
+            self.last_error = f"push: {type(e).__name__}: {e}"
+            obs.canary_failures.inc()
+            return None
+        req = tempopb.SearchRequest()
+        req.tags["canary.id"] = canary_id
+        req.limit = 1
+        while time.monotonic() - t0 < deadline_s:
+            try:
+                res = self.search_fn(self.tenant, req)
+                # TempoDB.search returns a SearchResults collector; the
+                # frontend returns the SearchResponse proto — accept both
+                if hasattr(res, "response"):
+                    res = res.response()
+            except Exception as e:  # noqa: BLE001 — keep polling; a
+                self.last_error = f"search: {type(e).__name__}: {e}"
+                res = None  # transient reader error is not a verdict
+            if res is not None and len(getattr(res, "traces", ())) > 0:
+                freshness = time.monotonic() - t0
+                self.last_freshness_s = round(freshness, 3)
+                self.last_error = None  # a transient mid-probe error healed
+                obs.canary_freshness.set(self.last_freshness_s)
+                return freshness
+            if self._stop.wait(self.poll_step_s):
+                break  # shutdown mid-probe: not a pipeline failure
+        else:
+            self.failures += 1
+            if self.last_error is None:
+                self.last_error = (
+                    f"not searchable after {deadline_s:.1f}s")
+            obs.canary_failures.inc()
+        return None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the prober never dies
+                log.exception("canary probe")
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="ingest-canary", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    def state(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "interval_s": self.interval_s,
+            "running": bool(self._thread and self._thread.is_alive()),
+            "probes": self.probes,
+            "failures": self.failures,
+            "last_freshness_s": self.last_freshness_s,
+            "last_error": self.last_error,
+        }

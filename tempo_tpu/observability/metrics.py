@@ -118,6 +118,14 @@ class Gauge(_Metric):
         with self._lock:
             self._series[self._key(labels)] = v
 
+    def remove(self, **labels) -> None:
+        """Drop one labeled series. A per-tenant gauge whose tenant
+        vanished must stop exporting its last value — a frozen
+        'freshness: 2.1s' for a tenant with no searchable data left is
+        worse than no series at all."""
+        with self._lock:
+            self._series.pop(self._key(labels), None)
+
     def value(self, **labels) -> float:
         with self._lock:
             return self._series.get(self._key(labels), 0)
@@ -378,6 +386,87 @@ slow_queries = Counter(
     "ONCE per query per process (in-process sub-requests of a slow "
     "request don't re-count); the log line is additionally rate-limited "
     "per tenant")
+
+# ---- write-path telemetry (observability/ingest_telemetry.py) ----
+ingest_stage_seconds = Histogram(
+    "tempo_ingest_stage_seconds",
+    "write-path stage latency: stage=push_ack (distributor accept+"
+    "replicate wall time) | live_cut (trace first-push -> cut into the "
+    "WAL head) | block_cut (head-block age when cut for completion) | "
+    "flush (block cut -> backend flush success, queue wait included) | "
+    "flush_write (the backend completion write itself) | poll_visible "
+    "(flush success -> first poll that lists the block) | "
+    "push_to_searchable (oldest trace push -> poll visibility, the "
+    "end-to-end freshness a reader actually experiences)",
+    buckets=(0.001, 0.005, 0.025, 0.1, 0.5, 1, 5, 15, 60, 300, 1800))
+search_freshness = Gauge(
+    "tempo_search_freshness_seconds",
+    "per-tenant search staleness: now - max end_time over the tenant's "
+    "newest SEARCHABLE (polled) block; refreshed every poll cycle")
+oldest_unflushed = Gauge(
+    "tempo_ingest_oldest_unflushed_seconds",
+    "per-tenant age of the oldest trace not yet flushed to the backend "
+    "— live (uncut), WAL head, or completing blocks; 0 when everything "
+    "is flushed")
+flush_duration_seconds = Histogram(
+    "tempo_ingester_flush_duration_seconds",
+    "successful block completion (WAL -> backend) wall time per flush",
+    buckets=(0.01, 0.05, 0.25, 1, 5, 30, 120, 600))
+flush_queue_length = Gauge(
+    "tempo_ingester_flush_queue_length",
+    "per-tenant blocks cut and waiting for (or in) backend completion")
+flush_retries = Counter(
+    "tempo_ingester_flush_retries_total",
+    "flush attempts that failed and were backed off, labeled by "
+    "attempt bucket (attempt=1|2|3|4+) — distinguishes a one-off "
+    "backend flake from a block stuck in exponential backoff")
+wal_replay_seconds = Gauge(
+    "tempo_ingester_wal_replay_seconds",
+    "duration of the WAL replay this process performed at startup")
+wal_replayed_blocks = Gauge(
+    "tempo_ingester_wal_replayed_blocks",
+    "WAL blocks replayed at startup")
+wal_replayed_bytes = Gauge(
+    "tempo_ingester_wal_replayed_bytes",
+    "WAL bytes re-scanned at startup")
+slow_flushes = Counter(
+    "tempo_ingester_slow_flushes_total",
+    "flushes slower than ingest_slow_flush_log_s per tenant (every one "
+    "counts; the JSON log line is additionally rate-limited per tenant)")
+blocklist_poll_seconds = Histogram(
+    "tempodb_blocklist_poll_duration_seconds",
+    "blocklist poll cycle wall time (backend list + meta reads + apply)",
+    buckets=(0.005, 0.025, 0.1, 0.5, 2, 10, 60, 300))
+blocklist_length = Gauge(
+    "tempodb_blocklist_length",
+    "per-tenant live blocks in this reader's blocklist after the last "
+    "poll")
+blocklist_index_age = Gauge(
+    "tempodb_blocklist_index_age_seconds",
+    "per-tenant age of the tenant index this poller last consumed "
+    "(now - builder created_at); a growing value means the elected "
+    "index builder stopped writing")
+compaction_duration_seconds = Histogram(
+    "tempodb_compaction_duration_seconds",
+    "one compaction run (k-way merge + search rebuild) wall time",
+    buckets=(0.05, 0.25, 1, 5, 30, 120, 600))
+compaction_outstanding_bytes = Gauge(
+    "tempodb_compaction_outstanding_bytes",
+    "per-tenant bytes sitting in compactable input groups (>= "
+    "min_inputs same-window blocks) — the compactor's input backlog")
+compaction_outstanding_blocks = Gauge(
+    "tempodb_compaction_outstanding_blocks",
+    "per-tenant block count behind "
+    "tempodb_compaction_outstanding_bytes — backlog in selector units "
+    "(one run consumes at most compaction_max_inputs of these)")
+canary_freshness = Gauge(
+    "tempo_ingest_canary_freshness_seconds",
+    "last MEASURED push->searchable latency of the synthetic ingest "
+    "canary (black-box: a real push polled through real search)")
+canary_failures = Counter(
+    "tempo_ingest_canary_failures_total",
+    "canary probes that never became searchable before their deadline "
+    "— the wedged-flush/poll alarm")
 
 # ---- self-tracing health (observability/tracing.py) ----
 selftrace_dropped_spans = Counter(

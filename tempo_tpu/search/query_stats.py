@@ -65,7 +65,7 @@ import time
 from collections import deque
 
 from tempo_tpu.observability import metrics as obs
-from tempo_tpu.observability.log import get_logger
+from tempo_tpu.observability.log import TenantTokenBucket, get_logger
 
 log = get_logger("tempo_tpu.querystats")
 slow_log = get_logger("tempo_tpu.slowquery")
@@ -297,50 +297,12 @@ def apportion(totals: dict, weights: list) -> list[dict]:
     return shares
 
 
-class _SlowLogLimiter:
-    """PER-TENANT token buckets (at most `rate` lines/s, burst `burst`,
-    each) under a process-wide ceiling: a pathological tenant must not
-    turn the log into the incident, AND must not starve every OTHER
-    tenant's lines — during tenant A's flood, tenant B's occasional
-    slow query is exactly the diagnostic this log exists for. Not
-    observability.log.RateLimitedLogger because the slow line must stay
-    pure JSON (that logger prefixes `tenant=...`) and needs the burst/
-    ceiling split; bucket state is bounded LRU."""
-
-    _MAX_TENANTS = 1024
-
-    def __init__(self, rate: float = 1.0, burst: int = 5,
-                 global_rate: float = 10.0, global_burst: int = 20):
-        self.rate = rate
-        self.burst = burst
-        self.global_rate = global_rate
-        self.global_burst = global_burst
-        self._buckets: dict[str, list] = {}   # tenant -> [tokens, t]
-        self._global = [float(global_burst), time.monotonic()]
-        self._lock = threading.Lock()
-
-    @staticmethod
-    def _take(bucket: list, rate: float, burst: float, now: float) -> bool:
-        bucket[0] = min(burst, bucket[0] + (now - bucket[1]) * rate)
-        bucket[1] = now
-        if bucket[0] >= 1.0:
-            bucket[0] -= 1.0
-            return True
-        return False
-
-    def allow(self, tenant: str) -> bool:
-        with self._lock:
-            now = time.monotonic()
-            b = self._buckets.get(tenant)
-            if b is None:
-                if len(self._buckets) >= self._MAX_TENANTS:
-                    self._buckets.pop(next(iter(self._buckets)))
-                b = self._buckets[tenant] = [float(self.burst), now]
-            # tenant bucket first: a per-tenant refusal must not burn a
-            # global token another tenant could have used
-            return (self._take(b, self.rate, self.burst, now)
-                    and self._take(self._global, self.global_rate,
-                                   self.global_burst, now))
+# per-tenant token buckets under a global ceiling — the slow line must
+# stay pure JSON (RateLimitedLogger prefixes `tenant=...`), so the raw
+# bucket class is used, not the logger wrapper. Promoted to
+# observability.log so the slow-FLUSH log (ingest_telemetry) shares the
+# exact limiter semantics instead of re-deriving them.
+_SlowLogLimiter = TenantTokenBucket
 
 
 class QueryStatsRegistry:

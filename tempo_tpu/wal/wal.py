@@ -236,6 +236,10 @@ class WAL:
     def __init__(self, wal_dir: str, encoding: str = "auto"):
         self.dir = wal_dir
         self.encoding = resolve_wal_encoding(encoding)
+        # stats of the most recent replay_all() on this WAL — a slow
+        # restart must be attributable (how many bytes re-scanned, how
+        # long), not a silent startup stall
+        self.last_replay: dict | None = None
         os.makedirs(wal_dir, exist_ok=True)
 
     def new_block(self, tenant: str, block_id: str | None = None,
@@ -250,6 +254,9 @@ class WAL:
         """Rescan the WAL dir. Returns (replayed blocks, removed files).
         Zero-length and unparseable files are removed, torn tails truncated
         (reference wal.go:119-143 corrupt-file removal)."""
+        import time
+
+        t0 = time.perf_counter()
         blocks: list[AppendBlock] = []
         removed: list[str] = []
         sidecars: list[str] = []
@@ -279,4 +286,11 @@ class WAL:
             if name[: -len(".search")] not in kept:
                 os.unlink(os.path.join(self.dir, name))
                 removed.append(name)
+        self.last_replay = {
+            "duration_s": time.perf_counter() - t0,
+            "blocks": len(blocks),
+            "bytes": sum(b.data_length for b in blocks),
+            "corrupt_records": sum(b.corrupt_records for b in blocks),
+            "removed_files": len(removed),
+        }
         return blocks, removed
