@@ -1544,6 +1544,199 @@ def phase_freshness():
     return result
 
 
+def phase_chaos():
+    """Robustness contract (docs/robustness.md, ISSUE 9 acceptance):
+
+      (a) noop: with the breaker OFF and no faultpoint armed, the
+          dispatch guard's protocol cost is < 2% of a dispatch
+          (deterministic measurement, the PR 5/7/8 pattern) and
+          responses are byte-identical to the breaker-ON healthy run
+          (canonicalized: device_seconds is measured wall time).
+      (b) chaos soak: a device hang injected MID-SOAK must keep p99
+          bounded by the watchdog (no hung thread), sustain throughput
+          through the byte-identical host fallback, trip the breaker
+          (device_wedged: true sourced from BREAKER STATE, not ad-hoc
+          probing), and recover through half-open after the fault
+          clears.
+    """
+    import json as _json
+    import tempfile
+
+    from tempo_tpu import robustness, tempopb
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.backend.types import (
+        BlockMeta, NAME_SEARCH, NAME_SEARCH_HEADER,
+    )
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.encoding.v2.compression import compress
+    from tempo_tpu.observability import metrics as obs
+    from tempo_tpu.observability.profile import device_status
+
+    n_blocks = int(os.environ.get("BENCH_CHAOS_BLOCKS", 16))
+    entries_per_block = int(os.environ.get("BENCH_CHAOS_ENTRIES", 16_384))
+    rounds = int(os.environ.get("BENCH_CHAOS_ROUNDS", 15))
+    watchdog_s = float(os.environ.get("BENCH_CHAOS_WATCHDOG_S", 0.5))
+    total = n_blocks * entries_per_block
+
+    def canon(resp):
+        r = tempopb.SearchResponse()
+        r.CopyFrom(resp)
+        # measured wall time / placement split move by design —
+        # identity is about the ANSWER (traces + deterministic metrics)
+        r.metrics.device_seconds = 0.0
+        r.metrics.inspected_bytes_device = 0
+        return r.SerializeToString()
+
+    with tempfile.TemporaryDirectory() as td:
+        be = LocalBackend(td + "/blocks")
+        db = TempoDB(be, td + "/wal", TempoDBConfig(
+            search_breaker_enabled=True,
+            search_breaker_fault_threshold=3,
+            search_breaker_cooldown_s=0.5,
+            search_device_dispatch_timeout_s=watchdog_s))
+        metas = []
+        for s in range(n_blocks):
+            pages = build_corpus(entries_per_block, seed=s)
+            m = BlockMeta(tenant_id="bench", encoding="none")
+            blob = compress(pages.to_bytes(), "none")
+            hdr = dict(pages.header)
+            hdr["encoding"] = "none"
+            hdr["compressed_size"] = len(blob)
+            be.write("bench", m.block_id, NAME_SEARCH, blob)
+            be.write("bench", m.block_id, NAME_SEARCH_HEADER,
+                     _json.dumps(hdr).encode())
+            metas.append(m)
+        db.blocklist.update("bench", add=metas)
+
+        req = tempopb.SearchRequest()
+        req.tags["service.name"] = "svc-007"
+        req.tags["http.status_code"] = "500"
+        req.limit = 20
+        robustness.BREAKER.reset()
+        r = db.search("bench", req)
+        assert r.metrics.inspected_traces == total
+        base = canon(db.search("bench", req).response())
+
+        def run_rounds(n):
+            lats = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                got = canon(db.search("bench", req).response())
+                lats.append(time.perf_counter() - t0)
+                assert got == base, "response diverged from baseline"
+            lats.sort()
+            return lats
+
+        # ---- healthy baseline (breaker ON, closed) ----
+        healthy = run_rounds(rounds)
+        healthy_p50 = healthy[len(healthy) // 2]
+        healthy_p99 = healthy[-1]
+
+        # ---- (a) noop contract: breaker OFF ----
+        robustness.BREAKER.enabled = False
+        assert not robustness.GUARD.active
+        off = canon(db.search("bench", req).response())
+        noop_identical = off == base
+        assert noop_identical, "breaker-off response diverged"
+        # deterministic guard protocol cost: the inactive guard is two
+        # attribute reads + a lambda call — time it against the bare
+        # call and take it as a fraction of a measured dispatch
+        N_PROTO = 50_000
+
+        def fn():
+            return None
+
+        def loop_guarded(n):
+            g = robustness.GUARD
+            t0 = time.perf_counter()
+            for _ in range(n):
+                g.run("bench_probe", fn)
+            return time.perf_counter() - t0
+
+        def loop_bare(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            return time.perf_counter() - t0
+
+        loop_guarded(1000), loop_bare(1000)  # warm
+        guard_us = min(loop_guarded(N_PROTO) for _ in range(3)) \
+            / N_PROTO * 1e6
+        bare_us = min(loop_bare(N_PROTO) for _ in range(3)) \
+            / N_PROTO * 1e6
+        dispatch_us = healthy_p50 * 1e6
+        overhead_pct = (guard_us - bare_us) / dispatch_us * 100
+        assert overhead_pct < 2.0, (
+            f"guard protocol cost {guard_us - bare_us:.2f}us is "
+            f"{overhead_pct:.3f}% of the {dispatch_us:.0f}us query — "
+            "exceeds the 2% noop budget")
+        robustness.BREAKER.enabled = True
+
+        # ---- (b) chaos soak: wedge mid-soak ----
+        robustness.BREAKER.reset()
+        fallback0 = obs.scan_dispatches.value(mode="host_fallback")
+        robustness.FAULTS.arm("device_dispatch_hang",
+                              delay_s=watchdog_s * 20, count=10_000)
+        t_wedge0 = time.perf_counter()
+        wedged = run_rounds(rounds)
+        wedge_wall = time.perf_counter() - t_wedge0
+        dstat = device_status()
+        device_wedged = bool(dstat.get("wedged"))
+        breaker_during = dstat.get("breaker", {})
+        robustness.FAULTS.disarm_all()
+        wedged_p99 = wedged[-1]
+        fallback_n = (obs.scan_dispatches.value(mode="host_fallback")
+                      - fallback0)
+        # bounded: worst round pays at most the watchdog (+ host scan);
+        # after the breaker trips rounds are pure host-fallback speed
+        bound = watchdog_s * 3 + max(1.0, 10 * healthy_p99)
+        assert wedged_p99 < bound, (
+            f"wedged p99 {wedged_p99:.2f}s exceeds bound {bound:.2f}s — "
+            "the hang leaked into the serving path")
+        assert device_wedged, (
+            "breaker never tripped during injection (device_wedged "
+            "should read true from breaker state)")
+        assert fallback_n >= 1, "no host-fallback dispatch recorded"
+
+        # ---- recovery after un-wedge ----
+        deadline = time.time() + 30
+        recovered = False
+        while time.time() < deadline:
+            got = canon(db.search("bench", req).response())
+            assert got == base
+            if robustness.BREAKER.state == "closed":
+                recovered = True
+                break
+            time.sleep(0.1)
+        snap = robustness.BREAKER.snapshot()
+        assert recovered, f"breaker never recovered: {snap}"
+        assert snap["transitions"].get("open->half_open", 0) >= 1
+        assert snap["transitions"].get("half_open->closed", 0) >= 1
+        robustness.BREAKER.reset()
+
+        return {
+            "blocks": n_blocks,
+            "rounds": rounds,
+            "watchdog_s": watchdog_s,
+            "healthy_p50_ms": round(healthy_p50 * 1e3, 2),
+            "healthy_p99_ms": round(healthy_p99 * 1e3, 2),
+            "wedged_p50_ms": round(wedged[len(wedged) // 2] * 1e3, 2),
+            "wedged_p99_ms": round(wedged_p99 * 1e3, 2),
+            "wedged_p99_bound_ms": round(bound * 1e3, 1),
+            "fallback_traces_per_sec": round(
+                total * rounds / wedge_wall),
+            "host_fallback_dispatches": int(fallback_n),
+            "device_wedged": device_wedged,
+            "breaker_during_injection": breaker_during,
+            "breaker_transitions": snap["transitions"],
+            "noop_identical": noop_identical,
+            "guard_cost_us": round(guard_us - bare_us, 3),
+            "noop_overhead_pct": round(overhead_pct, 4),
+            "within_2pct": overhead_pct < 2.0,
+            "recovered": recovered,
+        }
+
+
 def phase_scale_10k():
     n_blocks = int(os.environ.get("BENCH_SCALE_BLOCKS", 10_000))
     if not n_blocks:
@@ -1574,6 +1767,7 @@ PHASES = {
     "profile_overhead": phase_profile_overhead,
     "query_stats_overhead": phase_query_stats_overhead,
     "freshness": phase_freshness,
+    "chaos": phase_chaos,
     "scale_10k": phase_scale_10k,
     "scale_large_blocks": phase_scale_large_blocks,
 }
@@ -1592,6 +1786,7 @@ PHASE_TIMEOUTS = {
     "profile_overhead": 300.0,
     "query_stats_overhead": 300.0,
     "freshness": 420.0,
+    "chaos": 420.0,
     "scale_10k": 900.0,
     "scale_large_blocks": 1200.0,
 }
@@ -1653,6 +1848,21 @@ def _phase_main(name: str) -> int:
                     "jit_cache": snap["jit_cache"],
                     "bytes": snap["bytes"],
                 }
+        except Exception:  # noqa: BLE001 — telemetry must not fail a phase
+            pass
+    if isinstance(result, dict) and "_breaker" not in result:
+        # the device circuit breaker's verdict rides every phase result:
+        # a phase whose dispatches tripped the breaker mid-run is a
+        # wedge the HEADLINE must see (sourced from breaker state, not
+        # ad-hoc probing — the r04/r05 lesson). The chaos phase resets
+        # its deliberate trips before returning, so this only fires on
+        # a REAL wedge.
+        try:
+            from tempo_tpu.robustness import BREAKER
+
+            snap = BREAKER.snapshot()
+            if snap["transitions"] or snap["faults_in_window"]:
+                result["_breaker"] = snap
         except Exception:  # noqa: BLE001 — telemetry must not fail a phase
             pass
     doc = json.dumps(result)
@@ -1747,15 +1957,24 @@ def _assemble(results: dict) -> dict:
     same shape as every prior round so BENCH_r0N files stay comparable;
     wedged phases carry {"error": ...} instead of numbers."""
     def _strip(r):
-        """Phase result without its `_profile` rider (that lands once,
-        under detail.profile.stages, not duplicated per config)."""
-        if isinstance(r, dict) and "_profile" in r:
-            return {k: v for k, v in r.items() if k != "_profile"}
+        """Phase result without its `_profile`/`_breaker` riders (those
+        land once, under detail, not duplicated per config)."""
+        if isinstance(r, dict) and ("_profile" in r or "_breaker" in r):
+            return {k: v for k, v in r.items()
+                    if k not in ("_profile", "_breaker")}
         return r
 
     # per-phase dispatch-stage profiles, collected before the strip
     prof_stages = {k: v["_profile"] for k, v in results.items()
                    if isinstance(v, dict) and "_profile" in v}
+    # phases whose device circuit breaker was NOT closed at exit — a
+    # mid-phase wedge the headline must surface, sourced from breaker
+    # state rather than ad-hoc probing (the chaos phase's deliberate
+    # trips reset before return, so anything here is real)
+    breaker_wedged = {
+        k: v["_breaker"] for k, v in results.items()
+        if isinstance(v, dict)
+        and v.get("_breaker", {}).get("state") not in (None, "closed")}
     results = {k: _strip(v) if k != "degraded" else v
                for k, v in results.items()}
     single = results.get("single")
@@ -1857,6 +2076,22 @@ def _assemble(results: dict) -> dict:
             doc["partial"] = err
         else:
             doc["error"] = err
+    # robustness contract: the chaos phase's noop/fallback/recovery
+    # asserts, tracked round over round like the other noop contracts
+    ch = results.get("chaos")
+    if isinstance(ch, dict):
+        doc["detail"]["chaos"] = (
+            ch if not _failed(ch) else {"error": ch.get("error")})
+    if breaker_wedged:
+        # breaker-sourced wedge signal: some phase ended with its
+        # breaker open/half-open — a real mid-run device failure
+        doc["device_wedged"] = True
+        doc.setdefault(
+            "wedge_reason",
+            "circuit breaker open at phase exit: "
+            + ", ".join(f"{k}={v['state']}"
+                        for k, v in sorted(breaker_wedged.items())))
+        doc["detail"]["breaker"] = breaker_wedged
     degraded = results.get("degraded")
     if degraded:
         doc["degraded"] = degraded

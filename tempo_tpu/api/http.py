@@ -227,7 +227,8 @@ class HTTPApi:
         if path.startswith(PATH_TRACES + "/"):
             trace_id = _hex_trace_id(path[len(PATH_TRACES) + 1:])
             mode, bs, be = parse_trace_by_id_params(query)
-            resp = self.app.find_trace(tenant, trace_id)
+            with self._request_deadline(headers):
+                resp = self.app.find_trace(tenant, trace_id)
             if not resp.trace.batches:
                 return 404, {"error": "trace not found"}
             code = 206 if resp.metrics.failed_blocks else 200
@@ -244,10 +245,18 @@ class HTTPApi:
                     (headers.get("X-Tempo-Explain") or "").strip().lower() \
                     in ("1", "true", "yes"):
                 req.explain = True
-            resp = self.app.search(tenant, req)
-            # tolerated block failures = partial answer (reference
-            # frontend.go:144-146 semantics, extended to search)
-            code = 206 if resp.metrics.failed_blocks else 200
+            # request deadline: X-Tempo-Timeout-S header, else the
+            # search_request_timeout_s config default — propagates
+            # http → frontend → querier → TempoDB via the worker
+            # pool's contextvars copy (robustness/deadline.py), so
+            # sharded sub-queries stop queueing behind a dead device
+            with self._request_deadline(headers):
+                resp = self.app.search(tenant, req)
+            # tolerated block failures / deadline-clipped answers =
+            # partial (reference frontend.go:144-146 semantics,
+            # extended to search)
+            code = 206 if (resp.metrics.failed_blocks
+                           or resp.metrics.partial) else 200
             if want_proto:
                 return code, resp.SerializeToString()
             doc = json_format.MessageToDict(resp)
@@ -300,6 +309,27 @@ class HTTPApi:
             return 200, data
         return 404, {"error": f"no jaeger route {sub}"}
 
+    def _request_deadline(self, headers):
+        """The query routes' request deadline: the X-Tempo-Timeout-S
+        header wins (bad values ignored — a garbage header must not 400
+        a query that never asked for a deadline), else the
+        search_request_timeout_s config default; <= 0 / absent = no
+        deadline, the historical unbounded behavior."""
+        from tempo_tpu.robustness import deadline as rdeadline
+
+        timeout = None
+        raw = (headers.get("X-Tempo-Timeout-S")
+               if hasattr(headers, "get") else None)
+        if raw:
+            try:
+                timeout = float(raw)
+            except (TypeError, ValueError):
+                timeout = None
+        if timeout is None:
+            db_cfg = getattr(getattr(self.app, "cfg", None), "db", None)
+            timeout = getattr(db_cfg, "search_request_timeout_s", 0.0)
+        return rdeadline.start(timeout)
+
     # ---- /debug/* route handlers (registered in DEBUG_ROUTES) ----
 
     def _debug_threads_route(self, query):
@@ -339,6 +369,22 @@ class HTTPApi:
 
         return 200, REGISTRY.snapshot(
             recent=_int_param(query, "recent", 32))
+
+    def _debug_faults_route(self, query):
+        # robustness state: the fault-injection registry (catalog +
+        # live arming) and the device circuit breaker's state machine
+        # (tempo_tpu/robustness/)
+        from tempo_tpu.robustness import BREAKER, FAULTS, GUARD
+
+        return 200, {
+            "faults": FAULTS.snapshot(),
+            "breaker": BREAKER.snapshot(),
+            "dispatch_guard": {
+                "active": GUARD.active,
+                "timeout_s": GUARD.timeout_s,
+                "lock_timeout_s": GUARD.lock_timeout_s,
+            },
+        }
 
     def _debug_ingest_route(self, query):
         # write-path telemetry: per-tenant live/unflushed/backlog state,
@@ -466,6 +512,7 @@ DEBUG_ROUTES = {
     "/debug/planner": HTTPApi._debug_planner_route,
     "/debug/querystats": HTTPApi._debug_querystats_route,
     "/debug/ingest": HTTPApi._debug_ingest_route,
+    "/debug/faults": HTTPApi._debug_faults_route,
 }
 
 

@@ -81,6 +81,10 @@ class LocalBackend(RawBackend):
             pass
 
     def read(self, tenant, block_id, name) -> bytes:
+        from tempo_tpu.robustness import FAULTS
+
+        if FAULTS.active:
+            FAULTS.hit("backend_read_error")  # object-store flake
         try:
             with open(self._p(tenant, block_id, name), "rb") as f:
                 return f.read()

@@ -24,6 +24,10 @@ class MockBackend(RawBackend):
             self._objs[self._k(tenant, block_id, name)] = bytes(data)
 
     def read(self, tenant, block_id, name) -> bytes:
+        from tempo_tpu.robustness import FAULTS
+
+        if FAULTS.active:
+            FAULTS.hit("backend_read_error")  # object-store flake
         with self._lock:
             self.read_count += 1
             if self.fail_reads:

@@ -116,6 +116,24 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "search_offload_planner_ewma", 0.25),
         search_offload_planner_ring=storage.get(
             "search_offload_planner_ring", 256),
+        # robustness (docs/robustness.md): device dispatch watchdog,
+        # collective-lock bound, request deadlines, circuit breaker,
+        # fault-injection arming. Breaker off + faults disarmed is a
+        # true noop on the dispatch path.
+        search_device_dispatch_timeout_s=storage.get(
+            "search_device_dispatch_timeout_s", 30.0),
+        search_dispatch_lock_timeout_s=storage.get(
+            "search_dispatch_lock_timeout_s", 60.0),
+        search_request_timeout_s=storage.get(
+            "search_request_timeout_s", 0.0),
+        search_breaker_enabled=storage.get("search_breaker_enabled", True),
+        search_breaker_fault_threshold=storage.get(
+            "search_breaker_fault_threshold", 3),
+        search_breaker_window_s=storage.get(
+            "search_breaker_window_s", 30.0),
+        search_breaker_cooldown_s=storage.get(
+            "search_breaker_cooldown_s", 5.0),
+        robustness_faults=storage.get("robustness_faults", ""),
         # restartable host state (header snapshot + persistent XLA
         # compile cache); absent = auto (<wal_dir>/host-state), "" = off
         host_state_dir=storage.get("host_state_dir"),

@@ -135,6 +135,42 @@ class TempoDBConfig:
     search_slow_query_log_s: float = 10.0
     # recent-query ring rendered by /debug/querystats
     search_query_stats_ring: int = 256
+    # ---- robustness (tempo_tpu/robustness/, docs/robustness.md) ----
+    # watchdog deadline per DEVICE dispatch (single/batched/coalesced/
+    # mesh/dict-probe kernels, staging H2D puts, drain D2H syncs): a
+    # dispatch that exceeds it is abandoned, booked as a device fault,
+    # and answered through the byte-identical host path. <= 0 disables
+    # the watchdog (faults are still classified). Only consulted while
+    # the breaker is enabled or a faultpoint is armed — breaker off +
+    # faults disarmed is a true noop on the dispatch path.
+    search_device_dispatch_timeout_s: float = 30.0
+    # bounded wait on the process-wide collective dispatch lock
+    # (parallel.mesh.dispatch_lock): a timeout books a breaker fault
+    # instead of blocking the submitter forever (the PR 1
+    # rendezvous-deadlock class, detectable at runtime). <= 0 = wait
+    # forever (the historical behavior)
+    search_dispatch_lock_timeout_s: float = 60.0
+    # default request deadline for /api/search and /api/traces when the
+    # client sends no X-Tempo-Timeout-S header; propagates http →
+    # frontend → querier → TempoDB so sharded sub-queries stop queueing
+    # once the budget is spent (the answer goes out PARTIAL). 0 = no
+    # default deadline
+    search_request_timeout_s: float = 0.0
+    # device circuit breaker: search_breaker_fault_threshold faults
+    # within search_breaker_window_s trip it open; while open every
+    # scan/probe runs the byte-identical host path; after
+    # search_breaker_cooldown_s it half-opens and probes the device
+    # with real dispatches until one succeeds (closed) or fails (open
+    # again). False disables the whole robustness layer (the noop
+    # contract bench's chaos phase asserts).
+    search_breaker_enabled: bool = True
+    search_breaker_fault_threshold: int = 3
+    search_breaker_window_s: float = 30.0
+    search_breaker_cooldown_s: float = 5.0
+    # fault-injection arming spec ("name:p=1,count=2,delay=0.5;..." —
+    # see tempo_tpu/robustness/faults.py); the TEMPO_FAULTS env var arms
+    # in addition. Empty (default) = nothing armed, true noop.
+    robustness_faults: str = ""
     # shard batches over the device mesh when >1 device is visible
     auto_mesh: bool = True
     # restartable host state (VERDICT r4 #3): None = auto (persistent
@@ -221,6 +257,19 @@ class TempoDB:
             enabled=self.cfg.search_query_stats_enabled,
             slow_s=self.cfg.search_slow_query_log_s,
             ring_size=self.cfg.search_query_stats_ring)
+        # robustness layer: breaker + dispatch watchdog + fault
+        # registry, process-wide like the profiler (most recent
+        # TempoDB's config wins, the REGISTRY idiom)
+        from tempo_tpu import robustness as _robustness
+
+        _robustness.configure(
+            breaker_enabled=self.cfg.search_breaker_enabled,
+            fault_threshold=self.cfg.search_breaker_fault_threshold,
+            window_s=self.cfg.search_breaker_window_s,
+            cooldown_s=self.cfg.search_breaker_cooldown_s,
+            dispatch_timeout_s=self.cfg.search_device_dispatch_timeout_s,
+            lock_timeout_s=self.cfg.search_dispatch_lock_timeout_s,
+            faults_spec=self.cfg.robustness_faults)
         # offload planner: process-wide like the profiler it feeds from
         from tempo_tpu.search import planner as _planner
 
@@ -357,7 +406,10 @@ class TempoDB:
 
     def poll(self) -> None:
         from tempo_tpu.observability.ingest_telemetry import TELEMETRY
+        from tempo_tpu.robustness import FAULTS
 
+        if FAULTS.active:
+            FAULTS.hit("poll_error")  # a reader that stops seeing blocks
         t0 = time.perf_counter()
         with tracing.start_span("tempodb.Poll") as span:
             metas, compacted = self.poller.poll()

@@ -125,12 +125,18 @@ class QueryFrontend:
         return q
 
     def _retrying(self, fn, job):
+        from tempo_tpu.robustness import DeadlineExceeded, deadline
+
         last = None
         for _ in range(self.cfg.retries + 1):
             try:
                 return fn(job)
+            except DeadlineExceeded:
+                raise  # the budget is gone; a retry cannot help
             except Exception as e:  # noqa: BLE001 — retried, then surfaced
                 last = e
+                if deadline.expired():
+                    break  # don't burn retries against a dead deadline
         raise last
 
     # ---- trace by id (reference frontend.go:91-176) ----
@@ -144,12 +150,21 @@ class QueryFrontend:
             return resp
 
     def _find_trace_by_id(self, tenant: str, trace_id: bytes) -> tempopb.TraceByIDResponse:
+        from tempo_tpu.observability import metrics as obs
+        from tempo_tpu.robustness import DeadlineExceeded, deadline
+
         bounds = create_block_boundaries(self.cfg.query_shards - 1)
         jobs = [("ingesters", "", "")] + [
             ("blocks", bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)
         ]
 
         def run(job):
+            if deadline.expired():
+                # budget spent: fail the remaining shard lookups fast —
+                # they count failed below, so the 206/failed_blocks
+                # contract tells the client how much went unsearched
+                raise DeadlineExceeded("request deadline expired before "
+                                       "trace-by-id sub-query")
             mode, start, end = job
             return self._retrying(
                 lambda j: self._querier().find_trace_by_id(
@@ -159,7 +174,15 @@ class QueryFrontend:
             )
 
         responses, errors = self.pool.run_jobs(tenant, jobs, run)
-        failed = sum(r.metrics.failed_blocks for r in responses) + len(errors)
+        # deadline-expired shards are degraded-by-design, never a request
+        # failure: they count failed (the client sees a partial lookup)
+        # but must not trip the tolerance raise
+        dl_errors = [e for e in errors if isinstance(e, DeadlineExceeded)]
+        errors = [e for e in errors if not isinstance(e, DeadlineExceeded)]
+        if dl_errors:
+            obs.partial_results.inc(len(dl_errors), reason="deadline")
+        failed = (sum(r.metrics.failed_blocks for r in responses)
+                  + len(errors) + len(dl_errors))
         if errors and failed > self.cfg.tolerate_failed_blocks:
             raise errors[0]
 
@@ -327,7 +350,24 @@ class QueryFrontend:
                 return _run(job)
 
         def _run(job):
+            from tempo_tpu.robustness import DeadlineExceeded, deadline
+
             kind, payload = job
+            if deadline.expired():
+                # the request's budget is spent: fail the remaining
+                # sub-queries FAST instead of queueing them behind
+                # whatever already ate it (a dead device, a cold
+                # backend) — the merge goes out partial, and a never-
+                # started batch's blocks still count FAILED so
+                # metrics.failed_blocks tells the client how much of
+                # the corpus went unsearched
+                if kind != "recent":
+                    pl, _template = payload
+                    with merge_lock:
+                        failed_block_ids.update(m.block_id
+                                                for m, _, _ in pl)
+                raise DeadlineExceeded("request deadline expired before "
+                                       "sub-query dispatch")
             if kind == "recent":
                 try:
                     r = self._retrying(
@@ -356,8 +396,20 @@ class QueryFrontend:
             merge(r)
             return r
 
+        from tempo_tpu.observability import metrics as obs
+        from tempo_tpu.robustness import DeadlineExceeded
+
         _, errors = self.pool.run_jobs(tenant, jobs, run,
                                        stop_event=quit_event)
+        # deadline-expired sub-queries are PARTIAL by design, never a
+        # request failure: whatever merged before the budget ran out
+        # goes out marked partial (their blocks still count failed —
+        # 206, not silence)
+        dl_errors = [e for e in errors if isinstance(e, DeadlineExceeded)]
+        errors = [e for e in errors if not isinstance(e, DeadlineExceeded)]
+        if dl_errors:
+            merged.metrics.partial = True
+            obs.partial_results.inc(len(dl_errors), reason="deadline")
         # partial failures past the tolerance are an error, not a silently
         # smaller answer (reference tolerate_failed_blocks → HTTP 206/5xx)
         if not quit_event.is_set() and errors and (
@@ -368,8 +420,11 @@ class QueryFrontend:
         # tolerated failures stay FAILED in the metrics — folding them
         # into skipped_blocks would make "broken" indistinguishable from
         # "pruned" (reference frontend.go:144-146; HTTP layer maps
-        # failed_blocks > 0 to 206)
+        # failed_blocks > 0 to 206). They also mark the answer partial:
+        # a degraded response must never read as a complete one.
         merged.metrics.failed_blocks += len(failed_block_ids)
+        if failed_block_ids or recent_failed[0]:
+            merged.metrics.partial = True
         if qstats is not None:
             import json
 

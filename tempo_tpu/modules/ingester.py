@@ -246,6 +246,10 @@ class TenantInstance:
         with tracing.start_span("ingester.CompleteBlock",
                                 tenant=self.tenant) as span:
             try:
+                from tempo_tpu.robustness import FAULTS
+
+                if FAULTS.active:
+                    FAULTS.hit("flush_error")  # backend flake → backoff
                 meta = self.db.complete_block(c.blk, c.search.entries())
                 span.set_attributes(block_id=meta.block_id,
                                     objects=meta.total_objects)

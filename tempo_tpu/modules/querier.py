@@ -13,7 +13,9 @@ from tempo_tpu import tempopb
 from tempo_tpu.db import TempoDB
 from tempo_tpu.model.codec import codec_for, CURRENT_ENCODING
 from tempo_tpu.model.matches import trace_search_metadata
+from tempo_tpu.observability import metrics as obs
 from tempo_tpu.observability import tracing
+from tempo_tpu.robustness import FAULTS, deadline as rdeadline
 from tempo_tpu.search import SearchResults
 from tempo_tpu.utils.hashing import token_for
 from tempo_tpu.utils.ids import pad_trace_id
@@ -117,20 +119,33 @@ class Querier:
                 ing = self.ingesters.get(iid)
                 if ing is None:
                     failed += 1
+                    obs.partial_results.inc(reason="replica")
                     continue
                 futs.append(self._fanout_pool().submit(
                     ing.find_trace_by_id, tenant, tid))
-            for f in concurrent.futures.as_completed(futs):
-                try:
-                    partials.extend(f.result())
-                except Exception:  # noqa: BLE001 — replica failure → partial
-                    failed += 1
+            try:
+                # bounded by the request deadline, like search_recent:
+                # a replica wedged behind a dead backend must not hold
+                # the lookup hostage
+                for f in concurrent.futures.as_completed(
+                        futs, timeout=rdeadline.remaining()):
+                    try:
+                        partials.extend(f.result())
+                    except Exception:  # noqa: BLE001 — replica → partial
+                        failed += 1
+                        obs.partial_results.inc(reason="replica")
+            except concurrent.futures.TimeoutError:
+                undone = sum(1 for f in futs if not f.done())
+                failed += undone
+                obs.partial_results.inc(undone, reason="deadline")
 
         if mode in (QUERY_MODE_BLOCKS, QUERY_MODE_ALL):
             obj, block_failed = self.db.find_trace_by_id(
                 tenant, tid, block_start, block_end
             )
             failed += block_failed
+            if block_failed:
+                obs.partial_results.inc(block_failed, reason="backend")
             if obj is not None:
                 partials.append(obj)
 
@@ -159,20 +174,34 @@ class Querier:
             return results.response()
 
         def one(ing):
+            if FAULTS.active:
+                FAULTS.hit("replica_error")
             local = SearchResults.for_request(req)
             ing.search(tenant, req, local)
             return local.response()
 
         pool = self._fanout_pool()
         futs = [pool.submit(one, ing) for ing in ings]
-        for f in concurrent.futures.as_completed(futs):
-            try:
-                results.merge_response(f.result())
-            except Exception:  # noqa: BLE001 — replica failure → partial
-                results.metrics.failed_blocks += 1
-                continue
-            if results.complete:
-                break
+        try:
+            # bounded by the request deadline: a replica stuck behind a
+            # dead device must not hold the whole answer hostage —
+            # stragglers complete in the pool, their answers moot
+            for f in concurrent.futures.as_completed(
+                    futs, timeout=rdeadline.remaining()):
+                try:
+                    results.merge_response(f.result())
+                except Exception:  # noqa: BLE001 — replica failure → partial
+                    results.metrics.failed_blocks += 1
+                    results.metrics.partial = True
+                    obs.partial_results.inc(reason="replica")
+                    continue
+                if results.complete:
+                    break
+        except concurrent.futures.TimeoutError:
+            undone = sum(1 for f in futs if not f.done())
+            results.metrics.failed_blocks += undone
+            results.metrics.partial = True
+            obs.partial_results.inc(undone, reason="deadline")
         return results.response()
 
     def search_block(self, req: tempopb.SearchBlockRequest) -> tempopb.SearchResponse:
@@ -275,12 +304,14 @@ class Querier:
             try:
                 tags.update(ing.search_tags(tenant))
             except Exception:  # noqa: BLE001 — replica failure → partial tags
+                obs.partial_results.inc(reason="replica")
                 continue
         for m in self._tag_blocks(tenant):
             try:
                 sp = self.db._search_block_for(m).staged()  # noqa: SLF001
                 tags.update(sp.pages.key_dict)
             except Exception:  # noqa: BLE001 — blocks without search data
+                obs.partial_results.inc(reason="backend")
                 continue
         resp = tempopb.SearchTagsResponse()
         resp.tag_names.extend(sorted(tags))
@@ -295,6 +326,7 @@ class Querier:
                 vals.update(ing.search_tag_values(
                     tenant, tag, lim.max_bytes_per_tag_values))
             except Exception:  # noqa: BLE001 — replica failure → partial values
+                obs.partial_results.inc(reason="replica")
                 continue
         budget_hit = False
         for m in self._tag_blocks(tenant):
@@ -306,6 +338,7 @@ class Querier:
             try:
                 sp = self.db._search_block_for(m).staged()  # noqa: SLF001
             except Exception:  # noqa: BLE001
+                obs.partial_results.inc(reason="backend")
                 continue
             for s in sp.pages.values_for_key(tag):
                 if s not in vals:

@@ -226,6 +226,17 @@ class QueueWorkerPool:
                 except concurrent.futures.CancelledError:
                     continue
                 except Exception as e:  # noqa: BLE001 — partial results
+                    # every swallowed sub-request is a visibly degraded
+                    # answer, not a silent one (the caller decides
+                    # whether tolerance lets the response go out).
+                    # DeadlineExceeded is booked ONCE by the frontend
+                    # under reason=deadline — counting it here too
+                    # would double-bill the same event.
+                    from tempo_tpu.observability import metrics as obs
+                    from tempo_tpu.robustness import DeadlineExceeded
+
+                    if not isinstance(e, DeadlineExceeded):
+                        obs.partial_results.inc(reason="subrequest")
                     errors.append(e)
                     continue
                 if r is not None:

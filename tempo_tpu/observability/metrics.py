@@ -468,6 +468,40 @@ canary_failures = Counter(
     "canary probes that never became searchable before their deadline "
     "— the wedged-flush/poll alarm")
 
+# ---- robustness: breaker / watchdog / fault injection ----
+device_faults = Counter(
+    "tempo_search_device_faults_total",
+    "device dispatch faults booked into the circuit breaker "
+    "(kind=timeout|error|lock_timeout, mode = the profiler dispatch "
+    "mode giving the fault its stage context); counted even with the "
+    "breaker disabled")
+breaker_transitions = Counter(
+    "tempo_search_device_breaker_transitions_total",
+    "circuit-breaker state transitions (from/to = "
+    "closed|open|half_open); open means every scan/probe is routed "
+    "through the byte-identical host path")
+breaker_state = Gauge(
+    "tempo_search_device_breaker_state",
+    "current breaker state as a code: 0=closed 1=half_open 2=open")
+dispatch_lock_timeouts = Counter(
+    "tempo_search_dispatch_lock_timeouts_total",
+    "bounded waits on the process-wide collective dispatch lock that "
+    "timed out — some dispatch is wedged while holding it (each books "
+    "a breaker fault kind=lock_timeout)")
+partial_results = Counter(
+    "tempo_search_partial_results_total",
+    "sub-answers swallowed into a DEGRADED response, by why "
+    "(reason=replica|backend|subrequest|deadline), booked at the "
+    "swallow site — a failure past tolerate_failed_blocks still "
+    "counts here even though the request then errors. The "
+    "response-level twin is SearchMetrics.partial, which survives the "
+    "frontend merge so a degraded answer is never indistinguishable "
+    "from a complete one")
+faults_injected = Counter(
+    "tempo_robustness_faults_injected_total",
+    "fault-injection firings per faultpoint (chaos/test harness only; "
+    "always zero in production unless a faultpoint is armed)")
+
 # ---- self-tracing health (observability/tracing.py) ----
 selftrace_dropped_spans = Counter(
     "tempo_selftrace_dropped_spans_total",

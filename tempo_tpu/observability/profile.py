@@ -490,6 +490,16 @@ def device_status() -> dict:
     out["last_dispatch_age_s"] = (round(time.time() - t, 3)
                                   if t is not None else None)
     try:
+        # the circuit breaker's verdict IS the wedge signal now: /status
+        # and bench's device_wedged headline read this instead of
+        # ad-hoc probing (tempo_tpu/robustness/breaker.py)
+        from tempo_tpu.robustness import BREAKER
+
+        out["breaker"] = BREAKER.snapshot()
+        out["wedged"] = BREAKER.blocking()
+    except Exception:  # noqa: BLE001 — status must never 500
+        pass
+    try:
         from jax._src import xla_bridge as _xb
 
         initialized = bool(getattr(_xb, "_backends", None))

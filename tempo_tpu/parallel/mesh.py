@@ -34,14 +34,43 @@ def locked_collective(rec=None):
     time spent QUEUED behind other dispatches to the profiler record's
     `lock_wait` stage (rec = observability.profile dispatch record or
     None). Under concurrent mesh searches this wait is serialization the
-    operator can't otherwise see — it looks like kernel time."""
+    operator can't otherwise see — it looks like kernel time.
+
+    The wait is BOUNDED (`search_dispatch_lock_timeout_s`, via
+    robustness.GUARD.lock_timeout_s): a dispatch wedged while holding
+    this lock used to block every later submitter forever (the PR 1
+    rendezvous-deadlock class). A timed-out wait now books a device
+    fault into the circuit breaker and raises DispatchLockTimeout, so
+    the submitter falls back to the host path instead of stacking.
+    <= 0 restores the unbounded wait."""
     import time
 
+    from tempo_tpu.robustness import BREAKER, GUARD, FAULTS
+    from tempo_tpu.robustness.dispatch import DispatchLockTimeout
+    from tempo_tpu.observability import metrics as obs
+
+    timeout = GUARD.lock_timeout_s
     t0 = time.perf_counter()
-    with dispatch_lock:
+    if timeout and timeout > 0:
+        ok = dispatch_lock.acquire(timeout=timeout)
+    else:
+        ok = dispatch_lock.acquire()
+    if not ok:
+        obs.dispatch_lock_timeouts.inc()
+        BREAKER.record_fault("lock_timeout", mode="mesh")
+        raise DispatchLockTimeout(
+            f"collective dispatch lock not acquired within {timeout:.1f}s"
+            " — another dispatch is wedged while holding it")
+    try:
         if rec is not None:
             rec.add_stage("lock_wait", time.perf_counter() - t0)
+        if FAULTS.active:
+            # simulates a dispatch wedged INSIDE the collective section
+            # (holding the lock): later submitters hit the bounded wait
+            FAULTS.hit("dispatch_lock_hang")
         yield
+    finally:
+        dispatch_lock.release()
 
 
 def scan_mesh_axes() -> tuple[str, ...]:

@@ -1,0 +1,86 @@
+"""Request deadlines: a contextvar budget the whole search path honors.
+
+A query against a wedged device used to stack sub-request after
+sub-request behind the dead dispatch — the frontend's fan-out kept
+queueing work no one would ever drain. A :class:`Deadline` set at the
+HTTP layer (``X-Tempo-Timeout-S`` header, or the
+``search_request_timeout_s`` config default) rides the contextvar into
+every in-process layer for free: the frontend's QueueWorkerPool runs
+each sub-request under a copy of the caller's context
+(modules/queue.py), so frontend → querier → TempoDB → batcher all see
+the same budget without any parameter threading. Consumers:
+
+  - the batcher stops dispatching new groups once the deadline expires
+    (the response goes out PARTIAL instead of stacking),
+  - the dispatch guard clamps its per-dispatch watchdog to the
+    remaining budget,
+  - the frontend fails remaining sub-requests fast with
+    :class:`DeadlineExceeded` (counted as partial, never retried),
+  - the querier's replica fan-out stops waiting for stragglers.
+
+The coalescer's window/flush threads do NOT inherit a submitter's
+deadline (deliberate: one request's budget must not bound a fused
+dispatch serving seven others); the watchdog's own
+``search_device_dispatch_timeout_s`` bounds those.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline expired before this step could run."""
+
+
+class Deadline:
+    __slots__ = ("t_end", "timeout_s")
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = float(timeout_s)
+        self.t_end = time.monotonic() + self.timeout_s
+
+    def remaining(self) -> float:
+        return self.t_end - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self.t_end
+
+
+_ACTIVE: contextvars.ContextVar[Deadline | None] = contextvars.ContextVar(
+    "tempo_request_deadline", default=None)
+
+
+def current() -> Deadline | None:
+    return _ACTIVE.get()
+
+
+def remaining() -> float | None:
+    """Seconds left on the active deadline, or None when none is set."""
+    dl = _ACTIVE.get()
+    return None if dl is None else dl.remaining()
+
+
+def expired() -> bool:
+    """True only when a deadline is set AND it has passed — no deadline
+    means unbounded, exactly the pre-deadline behavior."""
+    dl = _ACTIVE.get()
+    return dl is not None and dl.expired
+
+
+@contextlib.contextmanager
+def start(timeout_s: float | None):
+    """Install a request deadline for the body; <= 0 / None is a no-op
+    (no deadline — the historical behavior)."""
+    if not timeout_s or timeout_s <= 0:
+        yield None
+        return
+    dl = Deadline(timeout_s)
+    token = _ACTIVE.set(dl)
+    try:
+        yield dl
+    finally:
+        _ACTIVE.reset(token)

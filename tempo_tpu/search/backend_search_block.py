@@ -34,6 +34,44 @@ def default_engine() -> ScanEngine:
     return _DEFAULT_ENGINE
 
 
+def host_scan_single(pages: ColumnarPages, cq, top_k: int):
+    """The single-block host fallback (breaker open, or the device
+    dispatch faulted): the SAME scan_kernel pinned to the CPU backend
+    over the host container — byte-identical to the device dispatch
+    (same padded shapes, host range tables; masked_topk's equal-start
+    tie caveat applies). The batched twin is search/batcher.host_scan."""
+    import time
+
+    import jax.numpy as jnp
+
+    from tempo_tpu.observability import profile
+
+    from .engine import (
+        _bucket,
+        cpu_pinned,
+        fetch_scan_out,
+        pad_page_axis,
+        scan_kernel,
+    )
+
+    t0 = time.perf_counter()
+    with cpu_pinned():
+        host = pad_page_axis(pages, _bucket(pages.n_pages))
+        dev = {k: jnp.asarray(v) for k, v in host.items()}
+        out = scan_kernel(
+            dev["kv_key"], dev["kv_val"], dev["entry_start"],
+            dev["entry_end"], dev["entry_dur"], dev["entry_valid"],
+            jnp.asarray(cq.term_keys), jnp.asarray(cq.val_ranges),
+            jnp.uint32(cq.dur_lo), jnp.uint32(min(cq.dur_hi, 0xFFFFFFFF)),
+            jnp.uint32(cq.win_start),
+            jnp.uint32(min(cq.win_end, 0xFFFFFFFF)),
+            None, n_terms=cq.n_terms, top_k=top_k)
+        res = fetch_scan_out(out)
+    profile.observe_stage("execute", "host_fallback",
+                          time.perf_counter() - t0)
+    return res
+
+
 def write_search_block(backend: RawBackend, meta: BlockMeta,
                        entries: list[SearchData],
                        geometry: PageGeometry = PageGeometry(),
@@ -129,6 +167,8 @@ class BackendSearchBlock:
     def search(self, req: tempopb.SearchRequest,
                results: SearchResults | None = None,
                engine: ScanEngine | None = None) -> SearchResults:
+        from tempo_tpu.robustness import BREAKER, GUARD, DeviceFault
+
         from . import query_stats
 
         engine = engine or default_engine()
@@ -143,39 +183,76 @@ class BackendSearchBlock:
                 qs.add_skip(reason)
             return results
 
-        sp = self.staged()
         from tempo_tpu.ops import native
         from tempo_tpu.search.pipeline import NATIVE_SCAN_THRESHOLD
 
-        packed = (sp.pages.packed_val_dict()
-                  if req.tags and native.available()
-                  and len(sp.pages.val_dict) >= NATIVE_SCAN_THRESHOLD else None)
-        # staged_dict present → the substring probe runs on device
-        # (staging already applied the size threshold); the host memmem
-        # path above stays the exact fallback for oversized needles
-        with query_stats.attributed_dispatch(qs, fallback_wall=False):
-            # attributed: compilation can fire the device dict probe
-            cq = compile_query(sp.pages.key_dict, sp.pages.val_dict, req,
-                               packed_vals=packed, cache_on=sp.pages,
-                               staged_dict=sp.staged_dict)
-        if cq is None:  # dictionary prefilter pruned the block
+        def _packed(pages):
+            return (pages.packed_val_dict()
+                    if req.tags and native.available()
+                    and len(pages.val_dict) >= NATIVE_SCAN_THRESHOLD
+                    else None)
+
+        out = render_pages = None
+        pruned = False
+        from tempo_tpu.observability import metrics as obs
+
+        # same contract as the batcher: breaker open/half-open without a
+        # probe token means the host route — no staging put, no device
+        # dispatch; a mid-flight DeviceFault falls through to host too
+        if BREAKER.allow_device():
+            try:
+                sp = GUARD.run("h2d", self.staged)
+                # staged_dict present → the substring probe runs on
+                # device (staging already applied the size threshold);
+                # the host memmem path stays the exact fallback for
+                # oversized needles
+                with query_stats.attributed_dispatch(qs,
+                                                     fallback_wall=False):
+                    # attributed: compilation can fire the device probe
+                    cq = compile_query(
+                        sp.pages.key_dict, sp.pages.val_dict, req,
+                        packed_vals=_packed(sp.pages), cache_on=sp.pages,
+                        staged_dict=sp.staged_dict)
+                if cq is None:  # dictionary prefilter pruned the block
+                    pruned = True
+                else:
+                    with query_stats.attributed_dispatch(qs):
+                        out = engine.scan_staged(sp, cq)
+                    obs.scan_dispatches.inc(mode="single")
+                    render_pages = sp.pages
+                    placement = "device"
+            except DeviceFault:
+                out = None  # fault booked; byte-identical host path below
+                pruned = False
+        if out is None and not pruned:
+            pages = self.pages()
+            cq = compile_query(pages.key_dict, pages.val_dict, req,
+                               packed_vals=_packed(pages), cache_on=pages,
+                               host_only=True)
+            if cq is None:
+                pruned = True
+            else:
+                out = host_scan_single(pages, cq,
+                                       engine._resolve_top_k(cq))
+                obs.scan_dispatches.inc(mode="host_fallback")
+                render_pages = pages
+                placement = "host"
+        if pruned:
             results.metrics.skipped_blocks += 1
             if qs is not None:
                 qs.add_skip("dict")
             return results
 
-        with query_stats.attributed_dispatch(qs):
-            count, inspected, scores, idx = engine.scan_staged(sp, cq)
-        from tempo_tpu.observability import metrics as obs
-
-        obs.scan_dispatches.inc(mode="single")
+        count, inspected, scores, idx = out
         results.metrics.inspected_traces += inspected
         nbytes = int(self.header().get("compressed_size", 0))
         results.metrics.inspected_bytes += nbytes
         if qs is not None:
-            qs.add_inspected(blocks=1, nbytes=nbytes, placement="device")
+            qs.add_inspected(blocks=1, nbytes=nbytes, placement=placement)
         results.metrics.truncated_entries += int(
             self.header().get("truncated_entries", 0) or 0)
-        for m in engine.results(sp, cq, scores, idx):
+        holder = StagedPages(device={}, n_pages=render_pages.n_pages,
+                             pages=render_pages)
+        for m in engine.results(holder, cq, scores, idx):
             results.add(m)
         return results

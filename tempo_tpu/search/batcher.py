@@ -34,11 +34,13 @@ Properties the grouping keeps:
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from tempo_tpu import robustness
 from tempo_tpu.observability import metrics as obs
 from tempo_tpu.observability import profile
 from tempo_tpu.observability import tracing
@@ -49,6 +51,51 @@ from .engine import DEFAULT_TOP_K, fetch_coalesced_out, resolve_top_k, \
 from .multiblock import MultiBlockEngine, compile_multi, stack_queries
 from .pipeline import block_header_skip_reason
 from .results import SearchResults
+
+
+def host_scan(host, mq, top_k: int):
+    """The breaker's host-fallback execution: run the SAME
+    multi_scan_kernel over the host-tier stacked arrays, pinned to the
+    CPU backend — no wedged-device array is ever touched. Because it is
+    the same kernel over the same padded shapes and the same compiled
+    predicate semantics (host range tables; the device hit-mask path
+    yields identical matches), the results are byte-identical to the
+    device dispatch, with the one documented caveat shared by
+    masked_topk's two-stage path: equal-start ties at the top-k
+    boundary may resolve to a different (equally valid) entry than the
+    MESH kernel's gather ordering would pick.
+
+    The CPU-staged arrays memoize on the HostBatch (`_cpu_staged`), so
+    a wedged-device soak re-stages each batch once, not per query; the
+    memo dies with the host-tier entry. Returns the drain-format host
+    tuple (count, inspected, scores, idx)."""
+    import jax.numpy as jnp
+
+    from .engine import cpu_pinned
+    from .multiblock import multi_scan_kernel
+
+    t0 = time.perf_counter()
+    with cpu_pinned():
+        dev = getattr(host, "_cpu_staged", None)
+        if dev is None:
+            dev = {k: jnp.asarray(v) for k, v in host.cat.items()}
+            host._cpu_staged = dev
+        tk = jnp.asarray(mq.term_keys)
+        vr = jnp.asarray(mq.val_ranges)
+        out = multi_scan_kernel(
+            dev["kv_key"], dev["kv_val"], dev["entry_start"],
+            dev["entry_end"], dev["entry_dur"], dev["entry_valid"],
+            dev["page_block"], tk, vr,
+            jnp.uint32(mq.dur_lo), jnp.uint32(min(mq.dur_hi, 0xFFFFFFFF)),
+            jnp.uint32(mq.win_start),
+            jnp.uint32(min(mq.win_end, 0xFFFFFFFF)),
+            None, None, n_terms=mq.n_terms, top_k=top_k)
+        count, inspected, scores, idx = out
+        res = (int(count), int(inspected), np.asarray(scores),
+               np.asarray(idx))
+    profile.observe_stage("execute", "host_fallback",
+                          time.perf_counter() - t0)
+    return res
 
 
 @dataclass
@@ -422,6 +469,10 @@ class BlockBatcher:
         # IO + decompress + restack (VERDICT r3 #2)
         self._host_cache: OrderedDict[tuple, object] = OrderedDict()
         self._host_total = 0
+        # host-fallback CPU-pinned array copies (host_scan's per-batch
+        # memo), charged to the host budget separately so eviction can
+        # release exactly what was charged
+        self._cpu_staged_bytes: dict[tuple, int] = {}
         self._staging: dict[tuple, threading.Event] = {}
         self._warmed_shapes: set = set()  # compile-warm dedupe
         self._prune_cache: OrderedDict = OrderedDict()
@@ -516,6 +567,17 @@ class BlockBatcher:
         obs.host_cache_bytes.set(self._host_total)
         obs.probe_dict_bytes.set(self._probe_dict_total)
 
+    def _evict_host_locked(self) -> None:
+        """LRU-evict host-tier batches until the budget holds — caller
+        holds self._lock. An entry's charge is its nbytes plus any
+        CPU-pinned fallback copies host_scan memoized on it."""
+        while (self._host_total > self.host_cache_bytes
+               and len(self._host_cache) > 1):
+            k, oldh = self._host_cache.popitem(last=False)
+            self._host_total -= oldh.nbytes
+            self._host_total -= self._cpu_staged_bytes.pop(k, 0)
+            obs.batch_cache_events.inc(result="host_evict")
+
     def _evict_hbm_locked(self) -> None:
         """LRU-evict staged batches until the HBM budget holds — caller
         holds self._lock. Pinned entries (actively scanned by some
@@ -552,36 +614,12 @@ class BlockBatcher:
             # transiently doubling HBM for the batch)
             ev.wait()
         try:
-            with self._lock:
-                host = self._host_cache.get(key)
-                if host is not None:
-                    self._host_cache.move_to_end(key)
-            if host is None:
-                # load host pages outside the lock (IO + decompress
-                # dominate)
-                import concurrent.futures
-
-                if len(group) > 1:
-                    with concurrent.futures.ThreadPoolExecutor(
-                        max_workers=min(self.io_workers, len(group))
-                    ) as ex:
-                        pages = list(ex.map(lambda j: j.pages_fn(), group))
-                else:
-                    pages = [group[0].pages_fn()]
-                host = self.engine.stage_host(pages)
-                with self._lock:
-                    self._host_cache[key] = host
-                    self._host_total += host.nbytes
-                    while (self._host_total > self.host_cache_bytes
-                           and len(self._host_cache) > 1):
-                        _, oldh = self._host_cache.popitem(last=False)
-                        self._host_total -= oldh.nbytes
-                        obs.batch_cache_events.inc(result="host_evict")
-                    self._publish_gauges_locked()
-                obs.batch_cache_events.inc(result="host_miss")
-            else:
-                obs.batch_cache_events.inc(result="host_hit")
-            batch = self.engine.place(host)  # H2D only on the hot path
+            host = self._load_host(key, group)
+            # H2D only on the hot path; watchdog-bounded — a staging put
+            # into a wedged tunnel raises DeviceFault (breaker fault
+            # booked) and the caller answers through the host route
+            batch = robustness.GUARD.run(
+                "h2d", lambda: self.engine.place(host))
             # batch.nbytes covers the stacked page arrays AND any staged
             # probe dictionaries — both live in HBM under this budget
             nbytes = int(batch.nbytes)
@@ -602,6 +640,64 @@ class BlockBatcher:
                 self._staging.pop(key, None)
             ev.set()
 
+    def _load_host(self, key: tuple, group: list[ScanJob]):
+        """Host-tier staging (IO + decompress + stack, NO device put):
+        the first half of _staged, and the WHOLE staging for the
+        breaker's host-fallback route."""
+        with self._lock:
+            host = self._host_cache.get(key)
+            if host is not None:
+                self._host_cache.move_to_end(key)
+        if host is None:
+            # load host pages outside the lock (IO + decompress
+            # dominate)
+            import concurrent.futures
+
+            if len(group) > 1:
+                with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(self.io_workers, len(group))
+                ) as ex:
+                    pages = list(ex.map(lambda j: j.pages_fn(), group))
+            else:
+                pages = [group[0].pages_fn()]
+            host = self.engine.stage_host(pages)
+            with self._lock:
+                self._host_cache[key] = host
+                self._host_total += host.nbytes
+                self._evict_host_locked()
+                self._publish_gauges_locked()
+            obs.batch_cache_events.inc(result="host_miss")
+        else:
+            obs.batch_cache_events.inc(result="host_hit")
+        return host
+
+    def _host_batch(self, group: list[ScanJob]):
+        """The host-fallback route's staging: host tier only, deduped
+        against concurrent fallers the same way _staged dedupes device
+        staging (a distinct event key — a host-route stage must not
+        block behind a device stage wedging on the same group)."""
+        key = tuple(j.key for j in group)
+        ev_key = ("host",) + key
+        while True:
+            with self._lock:
+                if key in self._host_cache:
+                    we_stage = False
+                    break
+                ev = self._staging.get(ev_key)
+                if ev is None:
+                    ev = self._staging[ev_key] = threading.Event()
+                    we_stage = True
+                    break
+            ev.wait()
+        if not we_stage:
+            return self._load_host(key, group)  # resident: hit counters
+        try:
+            return self._load_host(key, group)
+        finally:
+            with self._lock:
+                self._staging.pop(ev_key, None)
+            ev.set()
+
     def invalidate(self, live_block_ids: set[str]) -> None:
         """Drop cached batches containing blocks no longer in the
         blocklist (called from the poll loop) — both HBM and host tiers."""
@@ -616,6 +712,7 @@ class BlockBatcher:
                       if any(jk[0] not in live_block_ids for jk in k)]
             for k in dead_h:
                 self._host_total -= self._host_cache.pop(k).nbytes
+                self._host_total -= self._cpu_staged_bytes.pop(k, 0)
             self._publish_gauges_locked()
 
     def prewarm(self, groups: list[list[ScanJob]],
@@ -779,24 +876,54 @@ class BlockBatcher:
         # the D2H fetch/merge
         import time as _time
         stages = {"header_prune": 0.0, "staging": 0.0, "prepare": 0.0,
-                  "dispatch": 0.0, "drain": 0.0}
+                  "dispatch": 0.0, "drain": 0.0, "host_fallback": 0.0}
         t_search0 = _time.perf_counter()
 
         def drain_one():
             t0 = _time.perf_counter()
             gkey, cached, mq, pre, fut = inflight.popleft()
-            if hasattr(fut, "result"):  # coalescer Future vs direct tuple
-                # NOT timed as d2h: a coalescer Future's wait includes
-                # the coalescing window + the group's stacking/dispatch
-                fut = fut.result()
-            # the ACTUAL device→host sync: fused-slice demux happens at
-            # unpack, the direct path syncs at the scalar/array fetches —
-            # time exactly these so stage=d2h means transfer, not queue
-            t0d = _time.perf_counter()
-            count, inspected, scores, idx = fut
-            inspected = int(inspected)
-            scores = np.asarray(scores)
-            idx = np.asarray(idx)
+            try:
+                if hasattr(fut, "result"):  # coalescer Future vs tuple
+                    # NOT timed as d2h: a coalescer Future's wait
+                    # includes the coalescing window + the group's
+                    # stacking/dispatch
+                    fut = fut.result()
+                # the ACTUAL device→host sync: fused-slice demux happens
+                # at unpack, the direct path syncs at the scalar/array
+                # fetches — time exactly these so stage=d2h means
+                # transfer, not queue. Watchdog-bounded: a wedged
+                # device can hang the SYNC even when the enqueue
+                # returned, and that hang must become a fault too.
+                t0d = _time.perf_counter()
+
+                def _sync(fut=fut):
+                    count, inspected, scores, idx = fut
+                    return (int(count), int(inspected),
+                            np.asarray(scores), np.asarray(idx))
+
+                count, inspected, scores, idx = \
+                    robustness.GUARD.run("d2h", _sync)
+            except robustness.DeadlineExceeded:
+                # the request's budget ran out mid-drain: the answer
+                # goes out PARTIAL — this group's results are dropped,
+                # not waited for
+                results.metrics.partial = True
+                obs.partial_results.inc(reason="deadline")
+                stages["drain"] += _time.perf_counter() - t0
+                return
+            except robustness.DeviceFault:
+                # the dispatch (or its sync) died on the device — the
+                # breaker fault is already booked; resubmit THIS query's
+                # share of the group on the byte-identical host path.
+                # For a fused dispatch every member future fails and
+                # each member's drain resubmits its own query here.
+                # book_skips=False: the main loop already counted this
+                # group's skipped blocks/reasons at prepare time.
+                host_route(cached.jobs, gkey,
+                           hdr_reasons_for(cached.jobs),
+                           book_skips=False)
+                stages["drain"] += _time.perf_counter() - t0
+                return
             d2h_s = _time.perf_counter() - t0d
             profile.observe_stage(
                 "d2h", "batched", d2h_s,
@@ -855,14 +982,20 @@ class BlockBatcher:
                     out[key] = out.get(key, 0) + 1
             return out
 
-        def prepare(group, cached, skip, reasons) -> dict:
+        def prepare(group, holder, skip, reasons,
+                    host_only: bool = False) -> dict:
             """O(group) predicate work, memoized per (batch, predicate):
             per-block compile + metric sums. `skip` is the header-prune
             list (already computed for the pre-staging fast path);
             `reasons` its why-column, carried into the per-query stats'
-            skipped-blocks breakdown."""
-            mq = compile_multi([b for b in cached.batch.blocks], req,
-                               skip=skip, cache_on=cached.batch)
+            skipped-blocks breakdown. `holder` is the staged BlockBatch
+            on the device path or the HostBatch on the breaker's
+            host-fallback route (both carry .blocks and memoize the
+            dictionary grouping); `host_only` keeps the compile off the
+            device entirely (see compile_multi)."""
+            mq = compile_multi(list(holder.blocks), req,
+                               skip=skip, cache_on=holder,
+                               host_only=host_only)
             if mq is None:
                 return {"all_skip": True, "skipped": len(group),
                         "skip_reasons": _skip_reason_counts(
@@ -902,6 +1035,87 @@ class BlockBatcher:
 
         sig = _predicate_sig(req)
 
+        def host_route(group, gkey, hdr_reasons, book_skips=True):
+            """Scan one group ENTIRELY on the host path: breaker
+            open/half-open without a probe token, or this group's device
+            dispatch already faulted (drain resubmit). Host-tier staging
+            (no device put), host-only compile (range tables), the same
+            kernel pinned to the CPU backend — results byte-identical to
+            the device route (see host_scan). Accounting mirrors the
+            device drain, with bytes booked placement=host: the answer
+            is COMPLETE, not partial — only the placement moved.
+            `book_skips=False` on resubmit paths whose main-loop pass
+            already counted this group's skipped blocks/reasons —
+            re-booking would inflate skipped_blocks and break the
+            wedged-vs-healthy identity whenever a block dict-prunes."""
+            t0 = _time.perf_counter()
+            try:
+                host = self._host_batch(group)
+                skip = [r is not None for r in hdr_reasons]
+                hq = getattr(host, "_host_query_cache", None)
+                if hq is None:
+                    hq = host._host_query_cache = OrderedDict()
+                with self._lock:
+                    pre = hq.get(sig)
+                    if pre is not None:
+                        hq.move_to_end(sig)
+                if pre is None:
+                    pre = prepare(group, host, skip, hdr_reasons,
+                                  host_only=True)
+                    with self._lock:
+                        hq[sig] = pre
+                        while len(hq) > _QUERY_CACHE_MAX:
+                            hq.popitem(last=False)
+                if qs is not None:
+                    qs.add_cache("device_fallback")
+                    if book_skips:
+                        for r, n in pre.get("skip_reasons", {}).items():
+                            qs.add_skip(r, n)
+                if book_skips:
+                    results.metrics.skipped_blocks += pre.get("skipped", 0)
+                if pre["all_skip"]:
+                    return
+                from .multiblock import MultiQuery
+
+                mq = MultiQuery(
+                    term_keys=pre["term_keys"],
+                    val_ranges=pre["val_ranges"],
+                    dur_lo=pre["dur_lo"], dur_hi=pre["dur_hi"],
+                    win_start=pre["win_start"], win_end=pre["win_end"],
+                    limit=req.limit or 20, n_terms=pre["n_terms"])
+                had_cpu = getattr(host, "_cpu_staged", None) is not None
+                count, inspected, scores, idx = host_scan(
+                    host, mq, resolve_top_k(self.engine.top_k, mq.limit))
+                if not had_cpu \
+                        and getattr(host, "_cpu_staged", None) is not None:
+                    # the CPU-pinned copies host_scan memoized are real
+                    # RAM: charge them to the host-tier budget (evicting
+                    # the entry releases both — _load_host subtracts the
+                    # recorded cpu bytes alongside nbytes)
+                    cpu_b = sum(int(a.nbytes)
+                                for a in host._cpu_staged.values())
+                    with self._lock:
+                        if (self._host_cache.get(gkey) is host
+                                and gkey not in self._cpu_staged_bytes):
+                            self._cpu_staged_bytes[gkey] = cpu_b
+                            self._host_total += cpu_b
+                            self._evict_host_locked()
+                            self._publish_gauges_locked()
+                obs.scan_dispatches.inc(mode="host_fallback")
+                inspected -= pre["entries_skipped"]
+                results.metrics.inspected_blocks += pre["inspected_blocks"]
+                results.metrics.inspected_bytes += pre["inspected_bytes"]
+                results.metrics.truncated_entries += pre["truncated"]
+                results.metrics.inspected_traces += max(0, inspected)
+                if qs is not None:
+                    qs.add_inspected(blocks=pre["inspected_blocks"],
+                                     nbytes=pre["inspected_bytes"],
+                                     placement="host")
+                for m in self.engine.results(host, mq, scores, idx):
+                    results.add(m)
+            finally:
+                stages["host_fallback"] += _time.perf_counter() - t0
+
         def hdr_reasons_for(group):
             """Header-only prune BEFORE staging: a decidably-dead group
             (time window, tag rollup) costs no IO and no HBM. Returns
@@ -940,6 +1154,8 @@ class BlockBatcher:
             by the time the main loop reaches a prefetched group, the
             prefetch has inserted it into the caches and residency
             would misread this query's own cold stage as a hit."""
+            if robustness.BREAKER.blocking():
+                return  # no lookahead H2D at a blocked device
             for gi in range(from_idx, len(groups)):
                 g = groups[gi]
                 if all(hdr_reasons_for(g)):
@@ -975,6 +1191,13 @@ class BlockBatcher:
             for gi, group in enumerate(groups):
                 if results.complete:
                     break
+                if robustness.deadline.expired():
+                    # the request's budget is gone: stop queueing more
+                    # sub-scans behind whatever is slow (a dead device,
+                    # a cold cache) — the answer goes out PARTIAL now
+                    results.metrics.partial = True
+                    obs.partial_results.inc(reason="deadline")
+                    break
                 gkey = tuple(j.key for j in group)
                 hdr_reasons = hdr_reasons_for(group)
                 if all(hdr_reasons):
@@ -982,6 +1205,12 @@ class BlockBatcher:
                     if qs is not None:
                         for r in hdr_reasons:
                             qs.add_skip(r)
+                    continue
+                if not robustness.BREAKER.allow_device():
+                    # breaker open (or half-open with its probe tokens
+                    # spent): this group runs the byte-identical host
+                    # route — no staging put, no device dispatch
+                    host_route(group, gkey, hdr_reasons)
                     continue
                 # memo lookup needs the staged batch's identity; the memo
                 # itself lives on the cached batch so it dies with it
@@ -1003,8 +1232,17 @@ class BlockBatcher:
                                       else ("hbm_miss_host_hit"
                                             if gkey in self._host_cache
                                             else "hbm_miss_cold"))
-                cached = (fut_staged.result() if fut_staged is not None
-                          else self._staged(group))
+                try:
+                    cached = (fut_staged.result()
+                              if fut_staged is not None
+                              else self._staged(group))
+                except robustness.DeviceFault:
+                    # the staging H2D hit the wedged device (fault
+                    # booked): host tier already holds the stacked
+                    # arrays, answer from there
+                    stages["staging"] += _time.perf_counter() - t0
+                    host_route(group, gkey, hdr_reasons)
+                    continue
                 stages["staging"] += _time.perf_counter() - t0
                 if qs is not None:
                     qs.add_cache(_event)
@@ -1027,7 +1265,7 @@ class BlockBatcher:
                     # most of prepare() is host compile work)
                     with query_stats.attributed_dispatch(
                             qs, fallback_wall=False):
-                        pre = prepare(group, cached,
+                        pre = prepare(group, cached.batch,
                                       [r is not None for r in hdr_reasons],
                                       hdr_reasons)
                     stages["prepare"] += _time.perf_counter() - t0
@@ -1081,9 +1319,20 @@ class BlockBatcher:
                         resolve_top_k(self.engine.top_k, mq.limit),
                         peers=peers)
                 else:
-                    with query_stats.attributed_dispatch(qs):
-                        fut = self.engine.scan_async(cached.batch, mq)
-                    start_fetch(fut)  # D2H begins now, overlapping groups
+                    try:
+                        with query_stats.attributed_dispatch(qs):
+                            fut = self.engine.scan_async(cached.batch, mq)
+                        start_fetch(fut)  # D2H begins now, overlapping
+                    except robustness.DeviceFault:
+                        # direct-path dispatch died at submit (fault
+                        # booked): answer this group on host NOW — its
+                        # skips were already counted above, so the
+                        # resubmit must not re-book them. Interest for
+                        # this gkey is released by the outer finally.
+                        stages["dispatch"] += _time.perf_counter() - t0
+                        host_route(group, gkey, hdr_reasons,
+                                   book_skips=False)
+                        continue
                 stages["dispatch"] += _time.perf_counter() - t0
                 dispatches += 1
                 inflight.append((gkey, cached, mq, pre, fut))

@@ -70,6 +70,24 @@ def _bucket(n: int) -> int:
     return b
 
 
+def cpu_pinned():
+    """Context pinning kernel execution to the CPU backend — the
+    breaker's host-fallback execution context, shared by the batched
+    (batcher.host_scan) and single-block
+    (backend_search_block.host_scan_single) fallbacks so their
+    byte-identity-critical plumbing cannot diverge. Platforms without a
+    reachable cpu backend degrade to the default device (still correct;
+    the point of the pin is to avoid a wedged accelerator)."""
+    import contextlib
+
+    try:
+        cpu = jax.devices("cpu")[0]
+    except Exception:  # noqa: BLE001 — odd platform sets
+        cpu = None
+    return (jax.default_device(cpu) if cpu is not None
+            else contextlib.nullcontext())
+
+
 def pad_page_axis(pages: ColumnarPages, target: int) -> dict:
     """Numpy arrays with the page axis padded to `target` rows; padding is
     invalid entries / -1 kv slots."""
@@ -369,6 +387,15 @@ class ScanEngine:
         return out
 
     def scan_staged(self, sp: StagedPages, cq: CompiledQuery):
+        # watchdog-bounded (robustness.GUARD): a hang/backend error here
+        # books a breaker fault and raises DeviceFault instead of
+        # wedging the caller; a disabled breaker makes this a direct
+        # call (the noop contract)
+        from tempo_tpu.robustness import GUARD
+
+        return GUARD.run("single", lambda: self._scan_staged_sync(sp, cq))
+
+    def _scan_staged_sync(self, sp: StagedPages, cq: CompiledQuery):
         with profile.dispatch("single") as rec:
             out = self.scan_staged_async(sp, cq, _rec=rec)
             with rec.stage("d2h"):

@@ -186,7 +186,8 @@ def _tags_sig(req) -> tuple:
 def compile_query(key_dict: list, val_dict: list,
                   req: tempopb.SearchRequest,
                   packed_vals: tuple | None = None,
-                  cache_on=None, staged_dict=None) -> CompiledQuery | None:
+                  cache_on=None, staged_dict=None,
+                  host_only: bool = False) -> CompiledQuery | None:
     """Returns None when the block provably cannot match (key absent from
     the key dictionary, or no dictionary value satisfies a term). Under the
     exhaustive debug flag blocks are never pruned: an unsatisfiable term
@@ -209,7 +210,15 @@ def compile_query(key_dict: list, val_dict: list,
     repeated tag-sets skip all probe work on either path; a cached
     host-path product is served to a device-capable caller (and vice
     versa) — both are exact, only the kernel's membership test
-    differs."""
+    differs.
+
+    `host_only`: the breaker's host-fallback path — the probe must not
+    touch the device AT ALL: staged dictionaries are ignored, and a
+    CACHED product carrying a device hit mask is treated as a miss
+    (reading its arrays would hang on the very wedged device the
+    fallback is escaping); the fresh host product overwrites it."""
+    if host_only:
+        staged_dict = None
     sig = None
     fp = None
     if cache_on is not None:
@@ -225,6 +234,15 @@ def compile_query(key_dict: list, val_dict: list,
             hit = cache.get(sig)
             if hit is not None:
                 cache.move_to_end(sig)
+        if hit is not None and not isinstance(hit, str) \
+                and hit[3] is not None:
+            # the cached product is a DEVICE hit mask: unusable while
+            # the breaker blocks the device (or on the explicit host
+            # path) — recompile through host and overwrite it
+            from tempo_tpu.robustness import BREAKER
+
+            if host_only or BREAKER.blocking():
+                hit = None
         if hit is not None:
             # _PRUNED can only come from a non-exhaustive probe (the
             # exhaustive flag is part of the signature)
@@ -312,8 +330,16 @@ def _use_device_probe(staged_dict, terms, fp) -> bool:
     decision memoizes through the compile cache: one verdict per
     (dictionary, tag-set), shared by every block of the group and every
     member of a coalesced dispatch."""
+    from tempo_tpu.robustness import BREAKER
+
     from . import dict_probe, planner
 
+    if BREAKER.blocking():
+        # device circuit breaker open/half-open: the probe stays on the
+        # exact host path even though the packed bytes sit in HBM —
+        # results are identical, only the time moves (and the host walk
+        # finishes, which a wedged device dispatch would not)
+        return False
     p = planner.PLANNER
     if not p.enabled:
         return True
@@ -348,11 +374,20 @@ def _probe_tags(key_dict: list, val_dict: list, req,
                    if k != EXHAUSTIVE_SEARCH_TAG)
     if staged_dict is not None and terms \
             and _use_device_probe(staged_dict, terms, fp):
+        from tempo_tpu.robustness import GUARD, DeviceFault
+
         try:
-            return _device_probe_tags(terms, key_dict, staged_dict,
-                                      exhaustive)
+            # watchdog-bounded like every other device dispatch: a probe
+            # kernel that hangs or errors books a breaker fault and the
+            # EXACT host scan below answers instead (byte-identical)
+            return GUARD.run(
+                "dict_probe",
+                lambda: _device_probe_tags(terms, key_dict, staged_dict,
+                                           exhaustive))
         except ValueError:
             pass  # oversized needle: exact host path below
+        except DeviceFault:
+            pass  # wedged/erroring probe: fault booked, host path below
     if terms:
         # the host memmem walk is PR4's motivating cost (312ms at 10M
         # distinct values) — record it under its own mode so the stage

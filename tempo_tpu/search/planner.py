@@ -592,7 +592,14 @@ def stage_veto(block, fp, n_shards: int = 1) -> bool:
     engine.stage_block_dict and multiblock._pack_batch_dicts so the
     cost-model inputs cannot diverge between the single-block and
     batched paths. Always False when the planner is disabled (the
-    static-threshold behavior)."""
+    static-threshold behavior) — EXCEPT while the device circuit
+    breaker blocks the device: then every staging is vetoed regardless
+    of planner state, so a wedged tunnel is never handed a dictionary
+    upload (robustness.breaker; one attribute read when closed)."""
+    from tempo_tpu.robustness import BREAKER
+
+    if BREAKER.blocking():
+        return True
     if not PLANNER.enabled:
         return False
     S = max(1, int(n_shards))

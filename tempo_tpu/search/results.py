@@ -68,6 +68,11 @@ class SearchResults:
         # frontend/querier process boundary
         m.device_seconds += resp.metrics.device_seconds
         m.inspected_bytes_device += resp.metrics.inspected_bytes_device
+        # degraded-ness is sticky across the merge: ONE partial
+        # sub-response makes the whole answer partial — a degraded
+        # answer must never be indistinguishable from a complete one
+        if resp.metrics.partial:
+            m.partial = True
         if resp.metrics.query_stats_json:
             import json
 
