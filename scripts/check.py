@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Run the tempo_tpu static-analysis suite (tempo_tpu/analysis/).
+
+Usage:
+    python scripts/check.py                      # whole package, human
+    python scripts/check.py --json               # CI form
+    python scripts/check.py --checker lock-order # one checker
+    python scripts/check.py path/to/pkg          # another package root
+
+Exit codes (CI contract):
+    0   clean — no findings, no stale allowlist entries
+    1   findings (or stale allowlist entries) — the rendered/JSON
+        output lists each with path:line, checker id, fix hint and
+        allowlist fingerprint
+    2   usage or internal error (bad path, unknown checker, malformed
+        allowlist)
+
+The run is ONE in-process parse pass over the package (no subprocess
+per file) — the same entry tier-1 uses via tests/test_static_analysis.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    from tempo_tpu.analysis import (
+        default_checkers,
+        load_allowlist,
+        run_suite,
+    )
+    from tempo_tpu.analysis.allowlist import AllowlistError, default_path
+    from tempo_tpu.analysis.core import Package
+
+    ap = argparse.ArgumentParser(
+        prog="check.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", nargs="?",
+                    default=os.path.join(_REPO, "tempo_tpu"),
+                    help="package directory to analyze")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--checker", action="append", default=None,
+                    help="run only this checker id (repeatable)")
+    ap.add_argument("--allowlist", default=None,
+                    help="allowlist file (default: "
+                         "tempo_tpu/analysis/allowlist.toml when "
+                         "analyzing the default package, none for an "
+                         "alternate path; 'none' disables)")
+    ap.add_argument("--list-checkers", action="store_true",
+                    help="print checker ids and exit")
+    args = ap.parse_args(argv)
+
+    checkers = default_checkers()
+    if args.list_checkers:
+        for c in checkers:
+            print(c.id)
+        return 0
+    if args.checker:
+        ids = {c.id for c in checkers}
+        unknown = [c for c in args.checker if c not in ids]
+        if unknown:
+            print(f"unknown checker(s): {unknown}; have {sorted(ids)}",
+                  file=sys.stderr)
+            return 2
+        checkers = [c for c in checkers if c.id in args.checker]
+    if not os.path.isdir(args.path):
+        print(f"not a directory: {args.path}", file=sys.stderr)
+        return 2
+    try:
+        pkg = Package.load(args.path)
+        if args.allowlist == "none":
+            allowlist = None
+        elif args.allowlist is not None:
+            allowlist = load_allowlist(args.allowlist)
+        elif os.path.samefile(args.path,
+                              os.path.join(_REPO, "tempo_tpu")):
+            allowlist = load_allowlist(default_path())
+        else:
+            # an alternate package root: the repo allowlist's
+            # fingerprints can't match anything there — applying it
+            # would only manufacture spurious stale findings
+            allowlist = None
+        report = run_suite(pkg, checkers, allowlist)
+    except AllowlistError as e:
+        print(f"allowlist error: {e}", file=sys.stderr)
+        return 2
+    except SyntaxError as e:
+        print(f"parse error: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(report.as_dict(), indent=2, sort_keys=True))
+    else:
+        print(report.render())
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

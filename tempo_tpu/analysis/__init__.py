@@ -1,0 +1,61 @@
+"""Tier-1 static-analysis suite: the bug classes this codebase has hit
+at RUNTIME, caught at test time instead.
+
+PR 1 found a real mesh rendezvous deadlock (concurrent shard_map
+dispatch from two threads); PR 9 could only make the lock-cycle class
+detectable AFTER the fact with a bounded dispatch-lock wait; the noop
+contracts ("knob off = one attribute read, byte-identical output") were
+asserted only dynamically in bench. With 70+ lock uses across the
+package and every roadmap item adding more threads, locks, and jit'd
+kernels, these properties are enforced here as ANALYSIS over the code:
+
+  - one shared module-parse/symbol-resolution pass over the whole
+    package (:mod:`core`), pluggable :class:`core.Checker` classes;
+  - ``lock-order`` — lock-acquisition graph, inter-lock cycles, and
+    blocking calls while holding a lock (:mod:`locks`);
+  - ``noop-contract`` — gate knobs (profiling, query stats, telemetry,
+    breaker, faults, coalescer) mapped to their gate expressions; no
+    clock read, lock acquire, or metric write reachable before the
+    gate (:mod:`contracts`);
+  - ``jit-purity`` — no host round-trips, clock reads, or tracer
+    branching inside kernel functions reaching ``jax.jit`` /
+    ``shard_map_compat``; jit-cache-key hygiene (:mod:`jit_purity`);
+  - ``drift`` — declarative code-vs-docs catalogs (config knobs,
+    metrics, faultpoints); the three hand-rolled drift tests are thin
+    wrappers over these declarations now (:mod:`drift`).
+
+``scripts/check.py`` is the CLI; ``tests/test_static_analysis.py`` runs
+the suite in tier-1 and fails on any finding not justified in
+``analysis/allowlist.toml`` (stale entries are themselves findings).
+"""
+
+from __future__ import annotations
+
+from .allowlist import Allowlist, load_allowlist
+from .core import Checker, Finding, Package, Report, run_suite
+
+__all__ = [
+    "Allowlist",
+    "Checker",
+    "Finding",
+    "Package",
+    "Report",
+    "default_checkers",
+    "load_allowlist",
+    "run_suite",
+]
+
+
+def default_checkers() -> list:
+    """The tier-1 checker set, in priority order."""
+    from .contracts import NoopContractChecker
+    from .drift import DriftChecker
+    from .jit_purity import JitPurityChecker
+    from .locks import LockOrderChecker
+
+    return [
+        LockOrderChecker(),
+        NoopContractChecker(),
+        JitPurityChecker(),
+        DriftChecker(),
+    ]

@@ -1,0 +1,127 @@
+"""Reviewed-findings allowlist: ``analysis/allowlist.toml``.
+
+Each entry pairs a finding FINGERPRINT (stable under line drift — see
+:class:`core.Finding`) with a human-readable justification. The suite
+stays at zero by construction: an un-allowlisted finding fails, and an
+entry whose fingerprint no longer matches any finding fails too (stale
+— the defect it justified was fixed, so the entry must go).
+
+Format — the array-of-tables TOML subset below, parsed by a ~40-line
+reader because this container's Python (3.10) predates stdlib
+``tomllib`` and the repo installs nothing::
+
+    [[allow]]
+    fingerprint = "lock-order:tempo_tpu/foo.py:ab12cd34ef56"
+    justification = "why this construct is deliberate"
+
+Only ``[[allow]]`` tables with double-quoted single-line string values
+are supported; that is the whole grammar the file needs. When a real
+``tomllib`` is present it is used instead, so the file stays valid TOML.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class AllowEntry:
+    fingerprint: str
+    justification: str
+    line: int = 0
+
+
+class Allowlist:
+    def __init__(self, entries: list[AllowEntry], path: str = ""):
+        self.entries = entries
+        self.path = path
+        self._by_fp = {e.fingerprint: e for e in entries}
+
+    @property
+    def rel_path(self) -> str:
+        parts = self.path.replace(os.sep, "/").rsplit("tempo_tpu/", 1)
+        return "tempo_tpu/" + parts[1] if len(parts) == 2 else self.path
+
+    def get(self, fingerprint: str) -> AllowEntry | None:
+        return self._by_fp.get(fingerprint)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+class AllowlistError(ValueError):
+    """Malformed allowlist — fails the suite loudly, never silently."""
+
+
+def _parse_subset(text: str, path: str) -> list[AllowEntry]:
+    """The [[allow]] / key = "value" subset (module docstring)."""
+    entries: list[AllowEntry] = []
+    current: dict | None = None
+    current_line = 0
+
+    def close() -> None:
+        nonlocal current
+        if current is None:
+            return
+        if "fingerprint" not in current or "justification" not in current:
+            raise AllowlistError(
+                f"{path}:{current_line}: [[allow]] entry needs both "
+                "'fingerprint' and 'justification'")
+        if not current["justification"].strip():
+            raise AllowlistError(
+                f"{path}:{current_line}: empty justification — every "
+                "allowlisted finding carries a human-readable reason")
+        entries.append(AllowEntry(fingerprint=current["fingerprint"],
+                                  justification=current["justification"],
+                                  line=current_line))
+        current = None
+
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[allow]]":
+            close()
+            current = {}
+            current_line = lineno
+            continue
+        key, sep, value = line.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if (current is None or not sep or not value.startswith('"')
+                or not value.endswith('"') or len(value) < 2):
+            raise AllowlistError(
+                f"{path}:{lineno}: unsupported syntax {line!r} — only "
+                '[[allow]] tables with key = "value" lines are allowed')
+        current[key] = value[1:-1].replace('\\"', '"')
+    close()
+    return entries
+
+
+def load_allowlist(path: str) -> Allowlist:
+    """Read an allowlist file; a missing file is an empty allowlist (a
+    new checkout starts at zero entries, not at an error)."""
+    if not os.path.exists(path):
+        return Allowlist([], path=path)
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        import tomllib  # py>=3.11: honor full TOML
+    except ModuleNotFoundError:
+        return Allowlist(_parse_subset(text, path), path=path)
+    doc = tomllib.loads(text)
+    entries = []
+    for tbl in doc.get("allow", []):
+        if "fingerprint" not in tbl or not str(
+                tbl.get("justification", "")).strip():
+            raise AllowlistError(
+                f"{path}: every [[allow]] entry needs a fingerprint and "
+                "a non-empty justification")
+        entries.append(AllowEntry(fingerprint=str(tbl["fingerprint"]),
+                                  justification=str(tbl["justification"])))
+    return Allowlist(entries, path=path)
+
+
+def default_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "allowlist.toml")

@@ -1,0 +1,367 @@
+"""Noop-contract checker: "knob off = one attribute read, byte-identical
+output" — enforced statically.
+
+Every observability/robustness layer in this codebase carries the same
+contract: with its gate knob off, the hot path pays ONE attribute read
+and nothing else — no clock read, no lock acquire, no metric write, no
+allocation-heavy record protocol. Bench asserts the <2% overhead
+dynamically; this checker pins the SHAPE that makes it true:
+
+``gated-function`` rules
+    a function that IS the gate (``profile.dispatch``,
+    ``query_stats.begin``, ``breaker.allow_device`` ...) must test its
+    gate expression before any clock read, lock acquire, or metric
+    write. Work placed before the gate runs on the disabled path too —
+    exactly the drift the contract forbids.
+
+``guarded-call`` rules
+    a record-protocol call (``FAULTS.hit``, ``TELEMETRY.record_*``,
+    ``self.coalescer.submit``) must be dominated by its gate test —
+    either lexically inside an ``if`` mentioning the gate, or after an
+    early-return gate in an enclosing block. Call sites gate so the
+    disarmed steady state never even enters the registry.
+
+Both registries are data (:data:`GATED_FUNCTIONS`,
+:data:`GUARDED_CALLS`): a new knob is one declaration, and the fixture
+self-tests construct the checker with their own registries.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .core import Checker, Finding, Package
+
+_CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
+                "thread_time"}
+_METRIC_WRITE_ATTRS = {"inc", "observe", "set"}
+
+
+@dataclass(frozen=True)
+class GatedFunction:
+    """``qualname`` in ``module`` must test ``gate_attrs`` (any of them)
+    before clock/lock/metric work. ``knob`` names the config knob the
+    gate implements — it appears in the finding so the operator-facing
+    contract is traceable."""
+
+    module: str             # dotted, e.g. tempo_tpu.observability.profile
+    qualname: str           # e.g. DispatchProfiler.dispatch
+    gate_attrs: tuple       # attr names that constitute the gate test
+    knob: str
+
+
+@dataclass(frozen=True)
+class GuardedCall:
+    """Calls ``<receiver>.<method>`` (method exact or a listed prefix)
+    must be dominated by a test mentioning ``guard_attr`` (on any
+    receiver — the idiom is one singleton, but ``self.x is not None``
+    guards match through ``guard_name``)."""
+
+    receiver: str           # terminal name of the receiver, e.g. FAULTS
+    methods: tuple          # exact names
+    method_prefixes: tuple  # prefixes, e.g. ("record_",)
+    guard_attr: str         # e.g. "active", "enabled"
+    guard_name: str         # name whose mention in a test also guards
+    knob: str
+
+
+GATED_FUNCTIONS = (
+    GatedFunction("tempo_tpu.observability.profile",
+                  "DispatchProfiler.dispatch", ("enabled",),
+                  "search_profiling_enabled"),
+    GatedFunction("tempo_tpu.observability.profile",
+                  "DispatchProfiler.observe_stage", ("enabled",),
+                  "search_profiling_enabled"),
+    GatedFunction("tempo_tpu.search.query_stats", "begin", ("enabled",),
+                  "search_query_stats_enabled"),
+    GatedFunction("tempo_tpu.robustness.breaker",
+                  "CircuitBreaker.allow_device", ("enabled", "_state"),
+                  "search_breaker_enabled"),
+    GatedFunction("tempo_tpu.robustness.breaker",
+                  "CircuitBreaker.record_success", ("enabled", "_state"),
+                  "search_breaker_enabled"),
+    GatedFunction("tempo_tpu.robustness.dispatch", "DispatchGuard.run",
+                  ("enabled", "active"), "search_breaker_enabled"),
+)
+
+GUARDED_CALLS = (
+    GuardedCall("FAULTS", ("hit",), (), "active", "FAULTS",
+                "robustness_faults"),
+    GuardedCall("TELEMETRY", ("set_queue_state",), ("record_",),
+                "enabled", "TELEMETRY", "ingest_telemetry_enabled"),
+    GuardedCall("coalescer", ("submit",), (), "coalescer", "coalescer",
+                "search_coalesce_max_queries"),
+)
+
+
+def _mention_polarities(test: ast.AST, rule: GuardedCall) -> set:
+    """Which polarities the gate mention appears in: "positive" means
+    the test is truthy when the gate is ON (`if X.active:`,
+    `if x is not None:`), "negated" means truthy when it is OFF
+    (`if not X.active:`, `if x is None:`). An early-exit `if` guards
+    its remaining siblings only in the NEGATED polarity — `if
+    FAULTS.active: return` exits on the ARMED path and leaves the
+    disabled path running straight into the record call. Likewise the
+    `orelse` branch of a gate test is the OPPOSITE polarity of its
+    body."""
+
+    def is_mention(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and node.attr in (rule.guard_attr, rule.guard_name)) \
+            or (isinstance(node, ast.Name) and node.id == rule.guard_name)
+
+    out: set = set()
+
+    def walk(node: ast.AST, negated: bool) -> None:
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            walk(node.operand, not negated)
+            return
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.comparators[0], ast.Constant) \
+                and node.comparators[0].value is None:
+            # `x is None` flips polarity (truth = gate ABSENT);
+            # `x is not None` keeps it
+            if isinstance(node.ops[0], ast.Is):
+                walk(node.left, not negated)
+                return
+            if isinstance(node.ops[0], ast.IsNot):
+                walk(node.left, negated)
+                return
+        if is_mention(node):
+            out.add("negated" if negated else "positive")
+        for c in ast.iter_child_nodes(node):
+            walk(c, negated)
+
+    walk(test, False)
+    return out
+
+
+def _test_mentions_negated(test: ast.AST, rule: GuardedCall) -> bool:
+    return "negated" in _mention_polarities(test, rule)
+
+
+def _receiver_name(fn: ast.Attribute) -> str | None:
+    """Terminal name of the receiver: FAULTS.hit -> FAULTS,
+    self.coalescer.submit -> coalescer."""
+    base = fn.value
+    if isinstance(base, ast.Name):
+        return base.id
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    return None
+
+
+def _rule_matches(rule: GuardedCall, fn: ast.Attribute) -> bool:
+    if _receiver_name(fn) != rule.receiver:
+        return False
+    if fn.attr in rule.methods:
+        return True
+    return any(fn.attr.startswith(p) for p in rule.method_prefixes)
+
+
+class NoopContractChecker(Checker):
+    id = "noop-contract"
+
+    def __init__(self, gated=GATED_FUNCTIONS, guarded=GUARDED_CALLS):
+        self.gated = tuple(gated)
+        self.guarded = tuple(guarded)
+
+    def check(self, pkg: Package) -> list[Finding]:
+        findings: list[Finding] = []
+        by_key = {}
+        for mod, qual, node in pkg.functions():
+            by_key[(mod.dotted, qual)] = (mod, node)
+        for rule in self.gated:
+            hit = by_key.get((rule.module, rule.qualname))
+            if hit is None:
+                findings.append(Finding(
+                    checker=self.id, path=rule.module.replace(".", "/")
+                    + ".py", line=1,
+                    message=(f"gate registry names {rule.module}."
+                             f"{rule.qualname} but no such function "
+                             "exists — the registry drifted from the "
+                             "code"),
+                    hint="update GATED_FUNCTIONS in "
+                         "tempo_tpu/analysis/contracts.py",
+                    key=f"gate-missing:{rule.module}.{rule.qualname}"))
+                continue
+            mod, node = hit
+            findings.extend(self._check_gated(rule, mod, node))
+        # guarded-call domination is checked package-wide (the rules
+        # match by receiver shape, not by symbol table)
+        for mod, qual, fnode in pkg.functions():
+            findings.extend(self._check_guarded(mod, qual, fnode))
+        return findings
+
+    # ---- gated functions ----
+
+    def _check_gated(self, rule: GatedFunction, mod, func) -> list:
+        findings = []
+        gate_line = None
+        pre_gate: list = []
+
+        def is_gate_test(test: ast.AST) -> bool:
+            for node in ast.walk(test):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr in rule.gate_attrs:
+                    return True
+                if isinstance(node, ast.Name) \
+                        and node.id in rule.gate_attrs:
+                    return True
+            return False
+
+        # lexical scan over the TOP-LEVEL body: the gate idiom is an
+        # early `if not <gate>: return ...` (or a gated return); every
+        # registered function follows it, and anything before that
+        # statement runs on the disabled path
+        for stmt in func.body:
+            if isinstance(stmt, ast.If) and is_gate_test(stmt.test):
+                gate_line = stmt.lineno
+                break
+            if isinstance(stmt, ast.Return) and stmt.value is not None \
+                    and is_gate_test(stmt.value):
+                # `return X if gated else noop` boolean-gate forms
+                gate_line = stmt.lineno
+                break
+            pre_gate.append(stmt)
+        if gate_line is None:
+            findings.append(Finding(
+                checker=self.id, path=mod.rel, line=func.lineno,
+                message=(f"{rule.qualname}() implements the "
+                         f"{rule.knob} gate but no test of "
+                         f"{'/'.join(rule.gate_attrs)} was found in it"),
+                hint="gate first, or update the GATED_FUNCTIONS "
+                     "registry if the gate moved",
+                key=f"gate-absent:{rule.qualname}"))
+            return findings
+        for stmt in pre_gate:
+            for why, line in _contract_work(stmt):
+                findings.append(Finding(
+                    checker=self.id, path=mod.rel, line=line,
+                    message=(f"{rule.qualname}() does {why} BEFORE its "
+                             f"{rule.knob} gate (line {gate_line}) — the "
+                             "disabled path pays it on every call"),
+                    hint="move it after the gate test, or justify the "
+                         "exception in the allowlist",
+                    key=f"pre-gate:{rule.qualname}:{why}"))
+        return findings
+
+    # ---- guarded calls ----
+
+    def _check_guarded(self, mod, qual, func) -> list:
+        findings = []
+
+        def walk(stmts, guards: frozenset) -> None:
+            g = guards
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                # early-return gate: `if not <guard>: return/raise/...`
+                # guards the remaining siblings. Polarity matters:
+                # `if <guard>: return` exits on the ARMED path and the
+                # disabled path keeps going — that must NOT count.
+                if isinstance(stmt, ast.If) and _exits(stmt.body):
+                    for rule in self.guarded:
+                        if _test_mentions_negated(stmt.test, rule):
+                            g = g | {rule.knob}
+                if isinstance(stmt, ast.If):
+                    # polarity-aware: the body is guarded when the test
+                    # is truthy-with-gate-ON, the else branch when it is
+                    # truthy-with-gate-OFF — `if X.active: ... else:
+                    # X.hit()` runs the record protocol exactly on the
+                    # disabled path and must NOT get guard credit
+                    body_g, else_g = g, g
+                    for rule in self.guarded:
+                        pol = _mention_polarities(stmt.test, rule)
+                        if "positive" in pol:
+                            body_g = body_g | {rule.knob}
+                        if "negated" in pol:
+                            else_g = else_g | {rule.knob}
+                    walk(stmt.body, body_g)
+                    walk(stmt.orelse, else_g)
+                elif isinstance(stmt, (ast.For, ast.While, ast.With,
+                                       ast.AsyncFor, ast.AsyncWith)):
+                    walk(stmt.body, g)
+                    walk(getattr(stmt, "orelse", []), g)
+                elif isinstance(stmt, ast.Try):
+                    walk(stmt.body, g)
+                    for h in stmt.handlers:
+                        walk(h.body, g)
+                    walk(stmt.orelse, g)
+                    walk(stmt.finalbody, g)
+                self._scan_calls(stmt, g, mod, qual, findings)
+            return
+
+        walk(func.body, frozenset())
+        return findings
+
+    def _scan_calls(self, stmt, guards, mod, qual, findings) -> None:
+        if isinstance(stmt, (ast.If, ast.For, ast.While, ast.With,
+                             ast.Try, ast.AsyncFor, ast.AsyncWith)):
+            # compound statements: their test/iter/with-item expressions
+            # are at this guard level; bodies were walked with inner
+            # guards. With-items matter: `with TELEMETRY.record_x():`
+            # is a record-protocol call too
+            exprs = [getattr(stmt, "test", None),
+                     getattr(stmt, "iter", None)]
+            exprs += [item.context_expr
+                      for item in getattr(stmt, "items", [])]
+            nodes = [n for e in exprs if e is not None
+                     for n in ast.walk(e)]
+        else:
+            nodes = list(ast.walk(stmt))
+        for node in nodes:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            for rule in self.guarded:
+                if not _rule_matches(rule, node.func):
+                    continue
+                if rule.knob in guards:
+                    continue
+                # conditional-expression guard: X if <guard> else Y
+                findings.append(Finding(
+                    checker=self.id, path=mod.rel, line=node.lineno,
+                    message=(f"{qual}() calls {rule.receiver}."
+                             f"{node.func.attr}() without a dominating "
+                             f"{rule.guard_name}.{rule.guard_attr} "
+                             f"check — the {rule.knob}=off path enters "
+                             "the record protocol"),
+                    hint=f"wrap the call in `if {rule.guard_name}."
+                         f"{rule.guard_attr}:` (the one-attribute-read "
+                         "idiom every other site uses)",
+                    key=f"unguarded:{qual}:{rule.receiver}."
+                        f"{node.func.attr}"))
+
+
+def _exits(body: list) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _contract_work(stmt: ast.stmt):
+    """(description, line) for clock reads, lock acquires and metric
+    writes inside one pre-gate statement."""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            fn = node.func
+            if fn.attr in _CLOCK_ATTRS and isinstance(fn.value, ast.Name) \
+                    and fn.value.id in ("time", "_time"):
+                yield f"a clock read (time.{fn.attr}())", node.lineno
+            elif fn.attr == "acquire":
+                yield "a lock acquire", node.lineno
+            elif fn.attr in _METRIC_WRITE_ATTRS \
+                    and isinstance(fn.value, ast.Attribute) \
+                    and isinstance(fn.value.value, ast.Name) \
+                    and fn.value.value.id in ("obs", "metrics"):
+                yield (f"a metric write (obs.{fn.value.attr}."
+                       f"{fn.attr}())"), node.lineno
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Attribute) \
+                        and ctx.attr.endswith("lock"):
+                    yield "a lock acquire (with ...lock)", node.lineno

@@ -1,0 +1,232 @@
+"""Shared walk: parse every module in the package ONCE, hand the parsed
+package to each checker, collect findings, apply the allowlist.
+
+The suite's runtime contract is tier-1 shaped: a single in-process pass
+(no subprocess per file), a few hundred milliseconds for the whole
+package. Checkers therefore never re-read or re-parse source — they walk
+the :class:`Package`'s ASTs and use the symbol tables the shared pass
+already built.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One defect: ``checker`` id, ``path:line`` location, a one-line
+    ``message`` and a one-line fix ``hint``.
+
+    The ``fingerprint`` deliberately excludes the line number: an
+    allowlist entry must survive unrelated edits above the finding, and
+    go STALE the moment the flagged construct itself disappears. It
+    hashes (checker, path, key) where ``key`` is the checker-chosen
+    stable identity — usually the enclosing symbol plus the defect kind.
+    """
+
+    checker: str            # checker id, e.g. "lock-order"
+    path: str               # repo-relative, e.g. "tempo_tpu/search/batcher.py"
+    line: int
+    message: str
+    hint: str = ""
+    key: str = ""           # stable identity within (checker, path)
+
+    @property
+    def fingerprint(self) -> str:
+        ident = self.key or self.message
+        digest = hashlib.sha256(
+            f"{self.checker}|{self.path}|{ident}".encode()).hexdigest()[:12]
+        return f"{self.checker}:{self.path}:{digest}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"[{self.checker}] {loc}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        out += f"\n    fingerprint: {self.fingerprint}"
+        return out
+
+    def as_dict(self) -> dict:
+        return {
+            "checker": self.checker,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "hint": self.hint,
+            "fingerprint": self.fingerprint,
+        }
+
+
+@dataclass
+class Module:
+    """One parsed source file."""
+
+    path: str               # absolute
+    rel: str                # repo-relative with forward slashes
+    source: str
+    tree: ast.Module
+
+    @property
+    def dotted(self) -> str:
+        """Module path as a dotted name (tempo_tpu.search.batcher)."""
+        out = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        out = out.replace("/", ".")
+        if out.endswith(".__init__"):
+            out = out[: -len(".__init__")]
+        return out
+
+
+class Package:
+    """Every module of a package parsed once — the shared pass checkers
+    walk. ``root`` is the directory that CONTAINS the package dir (so
+    rel paths read ``tempo_tpu/...``), or the package dir itself for
+    fixture packages."""
+
+    def __init__(self, modules: list[Module]):
+        self.modules = modules
+        self.by_rel = {m.rel: m for m in modules}
+        self.by_dotted = {m.dotted: m for m in modules}
+        self._functions: list | None = None
+        self.root = ""          # rel_base dir (repo root), set by load()
+
+    @classmethod
+    def load(cls, pkg_dir: str, rel_base: str | None = None) -> "Package":
+        """Parse every ``.py`` under ``pkg_dir``. ``rel_base`` is the
+        directory rel paths are computed against (defaults to the parent
+        of ``pkg_dir``)."""
+        pkg_dir = os.path.abspath(pkg_dir)
+        base = os.path.abspath(rel_base) if rel_base else \
+            os.path.dirname(pkg_dir)
+        modules: list[Module] = []
+        for dirpath, dirnames, files in os.walk(pkg_dir):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__")
+            for fn in sorted(files):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                rel = os.path.relpath(path, base).replace(os.sep, "/")
+                modules.append(Module(path=path, rel=rel, source=source,
+                                      tree=ast.parse(source, filename=rel)))
+        pkg = cls(modules)
+        pkg.root = base
+        return pkg
+
+    # ---- shared symbol helpers ----
+
+    def functions(self) -> list:
+        """(module, qualname, node) for every function/method, with
+        qualname like ``ClassName.method`` or ``func`` (nested defs get
+        dotted parents). Computed once — every checker iterates this."""
+        if self._functions is None:
+            self._functions = [
+                t for mod in self.modules
+                for t in _walk_functions(mod, mod.tree, ())
+            ]
+        return self._functions
+
+
+def _walk_functions(mod: Module, node: ast.AST, parents: tuple):
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = ".".join(parents + (child.name,))
+            yield mod, qual, child
+            yield from _walk_functions(mod, child, parents + (child.name,))
+        elif isinstance(child, ast.ClassDef):
+            yield from _walk_functions(mod, child, parents + (child.name,))
+
+
+class Checker:
+    """One pluggable analysis. ``id`` tags findings; ``check`` walks the
+    shared parse and returns them."""
+
+    id = "checker"
+
+    def check(self, pkg: Package) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclass
+class Report:
+    """One suite run: raw findings split by the allowlist, plus the
+    stale allowlist entries (fingerprints matching nothing — themselves
+    findings, so a fixed defect can't leave a dead justification
+    behind)."""
+
+    findings: list              # un-allowlisted Finding, the failures
+    allowlisted: list = field(default_factory=list)   # (Finding, entry)
+    stale: list = field(default_factory=list)         # stale Finding
+
+    @property
+    def failures(self) -> list:
+        return self.findings + self.stale
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.failures else 0
+
+    def render(self) -> str:
+        lines = []
+        for f in self.findings:
+            lines.append(f.render())
+        for f in self.stale:
+            lines.append(f.render())
+        lines.append(
+            f"{len(self.findings)} finding(s), {len(self.stale)} stale "
+            f"allowlist entrie(s), {len(self.allowlisted)} allowlisted")
+        return "\n".join(lines)
+
+    def as_dict(self) -> dict:
+        return {
+            "findings": [f.as_dict() for f in self.findings],
+            "stale_allowlist": [f.as_dict() for f in self.stale],
+            "allowlisted": [
+                {**f.as_dict(), "justification": e.justification}
+                for f, e in self.allowlisted
+            ],
+            "ok": not self.failures,
+        }
+
+
+def run_suite(pkg: Package, checkers: list, allowlist=None) -> Report:
+    """Run every checker over the shared parse and split findings by the
+    allowlist. An allowlist entry matches by exact fingerprint; entries
+    matching no raw finding come back as ``allowlist-stale`` findings."""
+    raw: list[Finding] = []
+    for checker in checkers:
+        raw.extend(checker.check(pkg))
+    raw.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
+    if allowlist is None:
+        return Report(findings=raw)
+    open_findings: list[Finding] = []
+    allowlisted: list = []
+    matched: set[str] = set()
+    for f in raw:
+        entry = allowlist.get(f.fingerprint)
+        if entry is not None:
+            matched.add(f.fingerprint)
+            allowlisted.append((f, entry))
+        else:
+            open_findings.append(f)
+    stale = [
+        Finding(
+            checker="allowlist-stale",
+            path=allowlist.rel_path,
+            line=e.line,
+            message=(f"allowlist entry {e.fingerprint!r} matches no "
+                     "current finding — the defect it justified is gone"),
+            hint="delete the [[allow]] entry (justification: "
+                 f"{e.justification!r})",
+            key=e.fingerprint,
+        )
+        for e in allowlist.entries
+        if e.fingerprint not in matched
+    ]
+    return Report(findings=open_findings, allowlisted=allowlisted,
+                  stale=stale)

@@ -25,6 +25,15 @@ OPENMETRICS_CONTENT_TYPE = (
     "application/openmetrics-text; version=1.0.0; charset=utf-8")
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4"
 
+# label values arrive as strings or numbers (mode="mesh", le=0.5); the
+# series key is the sorted (name, value) tuple
+LabelValue = str | int | float
+SeriesKey = tuple[tuple[str, LabelValue], ...]
+# (metric_name, series_key, value) — the remote-write drain format
+Sample = tuple[str, SeriesKey, float]
+# (trace_id_hex, observed value, unix_ts) — one bucket exemplar
+Exemplar = tuple[str, float, float]
+
 
 def _exemplar_ref() -> str | None:
     """trace_id (hex) of the active sampled self-trace span, or None.
@@ -41,14 +50,15 @@ def _exemplar_ref() -> str | None:
 class _Metric:
     kind = "untyped"
 
-    def __init__(self, name: str, help_: str = "", registry=None):
+    def __init__(self, name: str, help_: str = "",
+                 registry: "Registry | None" = None):
         self.name = name
         self.help = help_
-        self._series: dict[tuple, float] = {}
+        self._series: dict[SeriesKey, float] = {}
         self._lock = threading.Lock()
         (registry or REGISTRY)._register(self)
 
-    def _key(self, labels: dict | None) -> tuple:
+    def _key(self, labels: dict[str, LabelValue] | None) -> SeriesKey:
         return tuple(sorted((labels or {}).items()))
 
     def _om_base(self) -> str:
@@ -70,7 +80,7 @@ class _Metric:
                              else f"{self.name} {val}")
         return "\n".join(lines)
 
-    def samples(self) -> list:
+    def samples(self) -> list[Sample]:
         """[(metric_name, ((label, value), ...), float)] — the
         remote-write drain format."""
         with self._lock:
@@ -81,18 +91,18 @@ class _Metric:
 class Counter(_Metric):
     kind = "counter"
 
-    def inc(self, n: float = 1, **labels) -> None:
+    def inc(self, n: float = 1, **labels: LabelValue) -> None:
         k = self._key(labels)
         with self._lock:
             self._series[k] = self._series.get(k, 0) + n
 
-    def labels(self, **labels) -> "_BoundCounter":
+    def labels(self, **labels: LabelValue) -> "_BoundCounter":
         """Precomputed-key handle for per-span hot paths: the sorted
         label-tuple build per inc() was measurable on the ingest ack
         path (profiled r5) — cache the handle, pay it once."""
         return _BoundCounter(self, self._key(labels))
 
-    def value(self, **labels) -> float:
+    def value(self, **labels: LabelValue) -> float:
         # locked like every writer: a bare dict read races resize-in-
         # progress under free-threading and misses published updates
         with self._lock:
@@ -102,7 +112,7 @@ class Counter(_Metric):
 class _BoundCounter:
     __slots__ = ("_m", "_k")
 
-    def __init__(self, m, k):
+    def __init__(self, m: Counter, k: SeriesKey):
         self._m, self._k = m, k
 
     def inc(self, n: float = 1) -> None:
@@ -114,11 +124,11 @@ class _BoundCounter:
 class Gauge(_Metric):
     kind = "gauge"
 
-    def set(self, v: float, **labels) -> None:
+    def set(self, v: float, **labels: LabelValue) -> None:
         with self._lock:
             self._series[self._key(labels)] = v
 
-    def remove(self, **labels) -> None:
+    def remove(self, **labels: LabelValue) -> None:
         """Drop one labeled series. A per-tenant gauge whose tenant
         vanished must stop exporting its last value — a frozen
         'freshness: 2.1s' for a tenant with no searchable data left is
@@ -126,7 +136,7 @@ class Gauge(_Metric):
         with self._lock:
             self._series.pop(self._key(labels), None)
 
-    def value(self, **labels) -> float:
+    def value(self, **labels: LabelValue) -> float:
         with self._lock:
             return self._series.get(self._key(labels), 0)
 
@@ -135,20 +145,23 @@ class Histogram(_Metric):
     kind = "histogram"
     DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
 
-    def __init__(self, name, help_="", buckets=None, registry=None):
+    def __init__(self, name: str, help_: str = "",
+                 buckets: tuple[float, ...] | None = None,
+                 registry: "Registry | None" = None):
         super().__init__(name, help_, registry)
-        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
-        self._counts: dict[tuple, list] = {}
-        self._sums: dict[tuple, float] = {}
+        self.buckets: tuple[float, ...] = tuple(
+            buckets or self.DEFAULT_BUCKETS)
+        self._counts: dict[SeriesKey, list[int]] = {}
+        self._sums: dict[SeriesKey, float] = {}
         # series key -> {bin index: (trace_id_hex, value, unix_ts)}:
         # the newest sampled-span observation per bucket — OpenMetrics
         # exemplars linking latency buckets to self-traces
-        self._exemplars: dict[tuple, dict] = {}
+        self._exemplars: dict[SeriesKey, dict[int, Exemplar]] = {}
 
-    def observe(self, v: float, **labels) -> None:
+    def observe(self, v: float, **labels: LabelValue) -> None:
         self._observe_key(self._key(labels), v)
 
-    def _observe_key(self, k: tuple, v: float) -> None:
+    def _observe_key(self, k: SeriesKey, v: float) -> None:
         # counts holds per-BIN tallies (bin i = first bucket >= v, last =
         # +Inf only); expose()/samples() cumsum into the prometheus
         # cumulative-le form. One bisect + one increment beats the old
@@ -164,14 +177,14 @@ class Histogram(_Metric):
             if ex is not None:
                 self._exemplars.setdefault(k, {})[i] = (ex, v, time.time())
 
-    def labels(self, **labels) -> "_BoundHistogram":
+    def labels(self, **labels: LabelValue) -> "_BoundHistogram":
         return _BoundHistogram(self, self._key(labels))
 
-    def time(self, **labels):
+    def time(self, **labels: LabelValue) -> "_Timer":
         return _Timer(self, labels)
 
     @staticmethod
-    def _exemplar_suffix(ex) -> str:
+    def _exemplar_suffix(ex: Exemplar | None) -> str:
         """OpenMetrics exemplar: ` # {labels} value timestamp`."""
         if ex is None:
             return ""
@@ -206,8 +219,8 @@ class Histogram(_Metric):
                 lines.append(f"{self.name}_count{suffix} {total}")
         return "\n".join(lines)
 
-    def samples(self) -> list:
-        out = []
+    def samples(self) -> list[Sample]:
+        out: list[Sample] = []
         with self._lock:
             for key, counts in sorted(self._counts.items()):
                 base = dict(key)
@@ -229,7 +242,7 @@ class Histogram(_Metric):
 class _BoundHistogram:
     __slots__ = ("_m", "_k")
 
-    def __init__(self, m, k):
+    def __init__(self, m: Histogram, k: SeriesKey):
         self._m, self._k = m, k
 
     def observe(self, v: float) -> None:
@@ -237,20 +250,21 @@ class _BoundHistogram:
 
 
 class _Timer:
-    def __init__(self, hist, labels):
+    def __init__(self, hist: Histogram, labels: dict[str, LabelValue]):
         self.hist = hist
         self.labels = labels
+        self.t0 = 0.0
 
-    def __enter__(self):
+    def __enter__(self) -> "_Timer":
         self.t0 = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> None:
         self.hist.observe(time.perf_counter() - self.t0, **self.labels)
 
 
 class Registry:
-    def __init__(self):
+    def __init__(self) -> None:
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
 
@@ -271,10 +285,10 @@ class Registry:
             body += "# EOF\n"
         return body
 
-    def samples(self) -> list:
+    def samples(self) -> list[Sample]:
         with self._lock:
             metrics = list(self._metrics.values())
-        out = []
+        out: list[Sample] = []
         for m in metrics:
             out.extend(m.samples())
         return out

@@ -176,8 +176,9 @@ class DistributedScanEngine:
             # shared with the multiblock engine and the dictionary probe,
             # so no two threads can interleave per-device shard_map
             # queues; time queued behind others lands in lock_wait
+            stage = "compile" if miss else "execute"
             with locked_collective(rec):
-                with rec.stage("compile" if miss else "execute"):
+                with rec.stage(stage):
                     out = self._dist_kernel(
                         d["kv_key"], d["kv_val"],
                         d["entry_start"], d["entry_end"], d["entry_dur"],
@@ -185,7 +186,12 @@ class DistributedScanEngine:
                         tk, vr, dlo, dhi, ws, we, vh,
                         n_terms=cq.n_terms, top_k=k,
                     )
-                    rec.fence(out)
+            # fence after releasing the collective lock: a fenced wait
+            # under dispatch_lock would stall every other mesh dispatch
+            # behind this kernel (lock-order suite); the stage timer
+            # accumulates so kernel time still books to compile/execute
+            with rec.stage(stage):
+                rec.fence(out)
             from tempo_tpu.search.engine import fetch_scan_out
 
             with rec.stage("d2h"):

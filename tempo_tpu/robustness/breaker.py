@@ -57,12 +57,13 @@ class CircuitBreaker:
         self.cooldown_s = cooldown_s
         self.half_open_probes = half_open_probes
         self._state = CLOSED
-        self._faults: deque = deque()   # (monotonic t, kind, mode)
+        self._faults: deque[float] = deque()   # monotonic fault times
         self._opened_t: float | None = None
         self._probe_tokens = 0
         self._probe_granted_t = 0.0
         self._transitions: dict[str, int] = {}
-        self._last_fault: dict | None = None
+        self._last_fault: dict[str, object] | None = None
+        self._last_fault_t: float | None = None
         self._lock = threading.Lock()
 
     # ---- hot-path reads ----
@@ -129,8 +130,8 @@ class CircuitBreaker:
             return
         now = time.monotonic()
         with self._lock:
-            self._last_fault = {"kind": kind, "mode": mode,
-                                "age_s": 0.0, "t": now}
+            self._last_fault = {"kind": kind, "mode": mode}
+            self._last_fault_t = now
             if self._state == HALF_OPEN:
                 # the recovery probe failed: straight back to open,
                 # cooldown restarts
@@ -168,6 +169,7 @@ class CircuitBreaker:
             self._probe_tokens = 0
             self._probe_granted_t = 0.0
             self._last_fault = None
+            self._last_fault_t = None
 
     # ---- internals ----
 
@@ -190,16 +192,16 @@ class CircuitBreaker:
 
     # ---- operator surface ----
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> dict[str, object]:
         """The /status device-block + /debug/faults breaker view, and
         what bench's ``device_wedged`` headline reads."""
         with self._lock:
             now = time.monotonic()
-            last = None
-            if self._last_fault is not None:
-                last = {k: v for k, v in self._last_fault.items()
-                        if k != "t"}
-                last["age_s"] = round(now - self._last_fault["t"], 3)
+            last: dict[str, object] | None = None
+            if self._last_fault is not None \
+                    and self._last_fault_t is not None:
+                last = dict(self._last_fault)
+                last["age_s"] = round(now - self._last_fault_t, 3)
             return {
                 "enabled": self.enabled,
                 "state": self._state,

@@ -29,6 +29,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import time
+from typing import Iterator
 
 
 class DeadlineExceeded(Exception):
@@ -72,7 +73,7 @@ def expired() -> bool:
 
 
 @contextlib.contextmanager
-def start(timeout_s: float | None):
+def start(timeout_s: float | None) -> Iterator[Deadline | None]:
     """Install a request deadline for the body; <= 0 / None is a no-op
     (no deadline — the historical behavior)."""
     if not timeout_s or timeout_s <= 0:

@@ -34,6 +34,7 @@ from __future__ import annotations
 import concurrent.futures
 import contextvars
 import threading
+from typing import Callable, TypeVar
 
 from . import deadline as _deadline
 from .breaker import BREAKER
@@ -56,6 +57,9 @@ class DispatchLockTimeout(DeviceFault):
     """The collective dispatch-lock wait exceeded its bound — some other
     dispatch is wedged while holding it (the PR 1 rendezvous-deadlock
     class, detectable at runtime instead of merely avoided)."""
+
+
+T = TypeVar("T")
 
 
 def _is_device_error(e: BaseException) -> bool:
@@ -82,7 +86,7 @@ class DispatchGuard:
     # opens after `threshold` faults, so steady-state leakage is zero
     _MAX_WORKERS = 32
 
-    def __init__(self):
+    def __init__(self) -> None:
         self.timeout_s = 30.0       # search_device_dispatch_timeout_s
         self.lock_timeout_s = 60.0  # search_dispatch_lock_timeout_s
         self._pool: concurrent.futures.ThreadPoolExecutor | None = None
@@ -108,7 +112,7 @@ class DispatchGuard:
                             thread_name_prefix="device-dispatch")
         return pool
 
-    def run(self, mode: str, fn):
+    def run(self, mode: str, fn: Callable[[], T]) -> T:
         """Execute one device dispatch body under the watchdog. Returns
         fn()'s result; raises DeviceFault (timeout / classified backend
         error, breaker fault booked) or DeadlineExceeded (the request's
@@ -151,7 +155,7 @@ class DispatchGuard:
         stack = getattr(profile._collect_local, "stack", None)
         ctx = contextvars.copy_context()
 
-        def worker():
+        def worker() -> T:
             if stack is not None:
                 profile._collect_local.stack = stack
             try:

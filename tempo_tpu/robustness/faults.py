@@ -33,6 +33,7 @@ import contextlib
 import random
 import threading
 import time
+from typing import Any, Iterator
 
 
 class InjectedFault(Exception):
@@ -104,7 +105,7 @@ class _Faultpoint:
                        else bool(raises))
         self.fired = 0
 
-    def as_dict(self) -> dict:
+    def as_dict(self) -> dict[str, object]:
         return {
             "probability": self.probability,
             "count": self.count,
@@ -120,7 +121,7 @@ class FaultRegistry:
     faultpoint is armed, so the disarmed steady state never takes the
     lock or even calls ``hit``."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self.active = False
         self._armed: dict[str, _Faultpoint] = {}
         self._fired_total: dict[str, int] = {}
@@ -149,7 +150,7 @@ class FaultRegistry:
             if not part:
                 continue
             name, _, args = part.partition(":")
-            kw: dict = {}
+            kw: dict[str, Any] = {}
             for kv in args.split(","):
                 kv = kv.strip()
                 if not kv:
@@ -185,7 +186,7 @@ class FaultRegistry:
             self._rng = random.Random(seed)
 
     @contextlib.contextmanager
-    def armed(self, name: str, **kw):
+    def armed(self, name: str, **kw: Any) -> Iterator["FaultRegistry"]:
         """Test-fixture arming: disarms on exit even on failure."""
         self.arm(name, **kw)
         try:
@@ -221,7 +222,7 @@ class FaultRegistry:
 
     # ---- operator surface ----
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> dict[str, object]:
         """/debug/faults payload: catalog + live arming state."""
         with self._lock:
             armed = {n: fp.as_dict() for n, fp in self._armed.items()}

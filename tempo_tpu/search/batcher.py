@@ -167,21 +167,51 @@ class _FusedOut:
     D2H sync runs once, on the FIRST waiter's drain thread — never on
     the submitter whose submit() happened to trigger a size flush (that
     thread has its own dispatch loop to run; syncing there would
-    serialize its next group behind this group's fetch)."""
+    serialize its next group behind this group's fetch).
 
-    __slots__ = ("_out", "_host", "_lock")
+    The sync runs OUTSIDE the lock (lock-order suite: a d2h sync under
+    a lock turns a wedged device into a pile-up of threads parked on
+    the lock, each burning its own watchdog): the first waiter CLAIMS
+    the fetch under the lock, fetches unlocked, publishes via the done
+    event; later waiters park on the event, not the lock. A faulted
+    fetch publishes its exception to every waiter — one watchdog burn
+    for the group instead of one per member (each member's drain then
+    resubmits its own query on the host path, as before)."""
+
+    __slots__ = ("_out", "_host", "_exc", "_claimed", "_done")
 
     def __init__(self, out):
         self._out = out
         self._host = None
-        self._lock = threading.Lock()
+        self._exc = None
+        self._claimed = threading.Lock()
+        self._done = threading.Event()
 
     def host(self):
-        with self._lock:
-            if self._host is None:
+        if not self._done.is_set() and self._claimed.acquire(blocking=False):
+            # first waiter: the one real d2h sync, not under any lock
+            try:
                 self._host = fetch_coalesced_out(self._out)
                 self._out = None
-            return self._host
+            except Exception as e:  # noqa: BLE001 — published to waiters
+                self._exc = e
+            finally:
+                # set even when a BaseException (KeyboardInterrupt)
+                # aborts the claimer: waiters must never park forever.
+                # The interrupt itself propagates on the claimer's
+                # thread only — republishing it to every member would
+                # turn one operator Ctrl-C into N failed queries
+                self._done.set()
+        else:
+            self._done.wait()
+        if self._exc is not None:
+            raise self._exc
+        if self._host is None:
+            # claimer died without publishing (interpreter-control
+            # exception mid-fetch): RuntimeError is device-fault-shaped,
+            # so each member's drain resubmits on the host path
+            raise RuntimeError("fused d2h fetch aborted before publishing")
+        return self._host
 
 
 class _FusedSlice:

@@ -378,7 +378,11 @@ def probe_value_hits(ddev: DeviceDict, needles: list[bytes]):
                         ddev.mesh, d["buf"], d["pos"], d["off"],
                         d["n_real"], jnp.asarray(arr), jnp.asarray(lens),
                         jnp.asarray(empties), n_needle_max=Lp)
-                    rec.fence(out)
+            # fence after releasing the collective lock (lock-order
+            # suite: no blocking wait under dispatch_lock); the stage
+            # timer accumulates so kernel time books to the same stage
+            with rec.stage(stage):
+                rec.fence(out)
             return out
         with rec.stage(stage):
             out = probe_kernel(d["buf"], d["pos"], d["off"], d["n_real"],

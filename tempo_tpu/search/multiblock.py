@@ -858,7 +858,14 @@ class MultiBlockEngine:
                     with rec.stage(stage):
                         out = dist_multi_scan_kernel(
                             self.mesh, *args, n_terms=mq.n_terms, top_k=k)
-                        rec.fence(out)
+                # fence AFTER releasing the collective lock: a fenced
+                # wait under dispatch_lock would serialize every other
+                # mesh dispatch behind this kernel's completion (the
+                # blocking-under-lock class the analysis suite flags).
+                # Stage timers accumulate, so the fenced wait still
+                # books into the same compile/execute stage.
+                with rec.stage(stage):
+                    rec.fence(out)
                 return out
             with rec.stage(stage):
                 out = multi_scan_kernel(*args, n_terms=mq.n_terms, top_k=k)
@@ -920,7 +927,10 @@ class MultiBlockEngine:
                         out = dist_coalesced_scan_kernel(
                             self.mesh, *args, n_terms=cq.n_terms,
                             top_k=top_k)
-                        rec.fence(out)
+                # fence outside the collective lock (see
+                # _scan_async_impl — same lock-order stance)
+                with rec.stage(stage):
+                    rec.fence(out)
                 return out
             with rec.stage(stage):
                 out = coalesced_scan_kernel(*args, n_terms=cq.n_terms,

@@ -25,11 +25,16 @@ import socket
 import struct
 import threading
 import time
+from typing import Any
 
 TYPE_A = 1
 TYPE_AAAA = 28
 TYPE_SRV = 33
 CLASS_IN = 1
+
+# one parsed resource record: (name, type, ttl, rdata) where rdata is
+# "ip" for A, (prio, weight, port, target) for SRV, raw bytes otherwise
+Record = tuple[str, int, int, Any]
 
 
 # ---------------------------------------------------------------------------
@@ -51,7 +56,7 @@ def _read_name(msg: bytes, pos: int, depth: int = 0) -> tuple[str, int]:
     (name, position after the name in the original stream)."""
     if depth > 16:
         raise ValueError("dns: compression pointer loop")
-    labels = []
+    labels: list[str] = []
     while True:
         if pos >= len(msg):
             raise ValueError("dns: truncated name")
@@ -69,7 +74,8 @@ def _read_name(msg: bytes, pos: int, depth: int = 0) -> tuple[str, int]:
         pos += n
 
 
-def parse_response(msg: bytes, txid: int):
+def parse_response(msg: bytes,
+                   txid: int) -> tuple[list[Record], list[Record]]:
     """→ (answers, additionals); each record is
     (name, type, ttl, rdata-parsed). A → "ip", SRV → (prio, weight,
     port, target), others → raw bytes. All malformed-packet failures
@@ -81,7 +87,8 @@ def parse_response(msg: bytes, txid: int):
         raise ValueError(f"dns: malformed response: {e}") from e
 
 
-def _parse_response(msg: bytes, txid: int):
+def _parse_response(msg: bytes,
+                    txid: int) -> tuple[list[Record], list[Record]]:
     if len(msg) < 12:
         raise ValueError("dns: short response")
     rid, flags, qd, an, ns, ar = struct.unpack_from(">HHHHHH", msg, 0)
@@ -95,9 +102,9 @@ def _parse_response(msg: bytes, txid: int):
         _, pos = _read_name(msg, pos)
         pos += 4
 
-    def read_records(count):
+    def read_records(count: int) -> list[Record]:
         nonlocal pos
-        recs = []
+        recs: list[Record] = []
         for _ in range(count):
             name, pos2 = _read_name(msg, pos)
             pos = pos2
@@ -106,6 +113,7 @@ def _parse_response(msg: bytes, txid: int):
             rdata = msg[pos : pos + rdlen]
             rd_start = pos
             pos += rdlen
+            parsed: Any
             if rtype == TYPE_A and rdlen == 4:
                 parsed = socket.inet_ntoa(rdata)
             elif rtype == TYPE_SRV:
@@ -181,13 +189,14 @@ class Resolver:
         self.neg_ttl_s = neg_ttl_s
         self._lock = threading.Lock()
         # (qname, qtype) → (expiry_monotonic, records)
-        self._cache: dict[tuple[str, int], tuple[float, list]] = {}
+        self._cache: dict[tuple[str, int],
+                          tuple[float, list[Record]]] = {}
         # negative cache: failed lookups fast-fail until this deadline so
         # a dead DNS server costs one timeout per neg_ttl, not per call
         # (the gossip loop calls resolve every tick)
         self._neg: dict[tuple[str, int], float] = {}
 
-    def query(self, qname: str, qtype: int) -> list:
+    def query(self, qname: str, qtype: int) -> list[Record]:
         """Answer records of the requested type (cache-aware)."""
         key = (qname.lower().rstrip("."), qtype)
         now = time.monotonic()
@@ -218,7 +227,7 @@ class Resolver:
         expiry = now + min(max(ttl, 1), self.max_ttl_s)
         # glue: additional-section A records answer the SRV targets'
         # follow-up queries without another round-trip
-        glue: dict[str, list] = {}
+        glue: dict[str, list[Record]] = {}
         for rec in additionals:
             if rec[1] == TYPE_A:
                 glue.setdefault(rec[0], []).append(rec)
@@ -230,7 +239,8 @@ class Resolver:
                 self._cache[(gname, TYPE_A)] = (gexp, recs)
         return records
 
-    def _query_wire(self, qname: str, qtype: int):
+    def _query_wire(self, qname: str,
+                    qtype: int) -> tuple[list[Record], list[Record]]:
         last: Exception | None = None
         for _ in range(self.retries + 1):
             txid = random.randrange(1, 0xFFFF)
@@ -266,7 +276,8 @@ class Resolver:
             buf += chunk
         return buf
 
-    def _query_tcp(self, pkt: bytes, txid: int):
+    def _query_tcp(self, pkt: bytes,
+                   txid: int) -> tuple[list[Record], list[Record]]:
         """RFC 7766 fallback for truncated UDP answers: same query over
         TCP with 2-byte length framing."""
         with socket.create_connection(self.nameserver,
@@ -283,7 +294,7 @@ class Resolver:
         """One address spec → list of host:port strings (see module doc)."""
         if spec.startswith("dnssrv+"):
             name = spec[len("dnssrv+"):]
-            out = []
+            out: list[str] = []
             for _name, _t, _ttl, (_prio, _weight, port, target) in self.query(
                 name, TYPE_SRV
             ):

@@ -11,25 +11,27 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
+from typing import Any
 
 
 class BoundedCache:
     """Thread-safe LRU: `get` refreshes recency, `put` evicts the least
-    recently used entry past `cap`."""
+    recently used entry past `cap`. Keys and values are opaque
+    (hashable keys; callers own the value types)."""
 
     def __init__(self, cap: int):
         self.cap = cap
-        self._d: OrderedDict = OrderedDict()
+        self._d: OrderedDict[Any, Any] = OrderedDict()
         self._lock = threading.Lock()
 
-    def get(self, key, default=None):
+    def get(self, key: Any, default: Any = None) -> Any:
         with self._lock:
             v = self._d.get(key, default)
             if key in self._d:
                 self._d.move_to_end(key)
             return v
 
-    def put(self, key, value) -> None:
+    def put(self, key: Any, value: Any) -> None:
         with self._lock:
             self._d[key] = value
             self._d.move_to_end(key)
@@ -40,6 +42,6 @@ class BoundedCache:
         with self._lock:
             return len(self._d)
 
-    def values(self):
+    def values(self) -> list[Any]:
         with self._lock:
             return list(self._d.values())

@@ -1,0 +1,10 @@
+"""Known-bad fixture package for the static-analysis suite's
+self-tests (tests/test_static_analysis.py).
+
+Each module reproduces a bug class the suite exists to catch — the PR 1
+rendezvous-deadlock lock cycle, a noop-contract gate violation, a
+tracer leak in a jit body. These files are PARSED by the checkers,
+never imported or executed; they also carry clean twins of each
+construct so the self-tests pin the checkers' precision (no
+false positives) alongside their recall.
+"""

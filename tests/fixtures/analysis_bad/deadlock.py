@@ -1,0 +1,98 @@
+"""The PR 1 rendezvous-deadlock shape, as a lock cycle.
+
+Two "device queue" locks; ``dispatch_ab`` takes A then B, ``dispatch_ba``
+takes B then A — two threads entering from different ends deadlock,
+exactly how concurrent shard_map dispatch from two threads interleaved
+the per-device program queues. Plus the blocking-under-lock shape (a
+Future.result() while holding a dispatch lock) and a self-reacquire.
+``clean_dispatch`` is the good twin: same locks, one global order,
+blocking call made after release.
+"""
+
+import threading
+
+queue_lock_a = threading.Lock()
+queue_lock_b = threading.Lock()
+
+
+def dispatch_ab(program):
+    with queue_lock_a:
+        with queue_lock_b:
+            program.enqueue()
+
+
+def dispatch_ba(program):
+    # opposite order: the A->B / B->A cycle the analyzer must flag
+    with queue_lock_b:
+        with queue_lock_a:
+            program.enqueue()
+
+
+def wait_under_lock(fut):
+    # blocking-under-lock: result() parks this thread while every other
+    # dispatcher queues behind queue_lock_a
+    with queue_lock_a:
+        return fut.result()
+
+
+def reacquire(program):
+    with queue_lock_a:
+        return helper_locked(program)
+
+
+def helper_locked(program):
+    # called with queue_lock_a held: non-reentrant self-deadlock
+    with queue_lock_a:
+        return program.enqueue()
+
+
+def clean_dispatch(program, fut):
+    # good twin: consistent order, sync outside the locked region
+    with queue_lock_a:
+        with queue_lock_b:
+            out = program.enqueue()
+    return out, fut.result(timeout=5.0)
+
+
+# -- cycle THROUGH a context-manager helper (the locked_collective
+# shape): the helper's acquisition must reach callers' summaries, or
+# this AB/BA pair is invisible
+
+import contextlib
+
+enqueue_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def hold_enqueue():
+    enqueue_lock.acquire()
+    try:
+        yield
+    finally:
+        enqueue_lock.release()
+
+
+def submit_through_helper(program):
+    with queue_lock_b:
+        with hold_enqueue():       # B -> enqueue_lock
+            program.enqueue()
+
+
+def submit_reversed(program):
+    with hold_enqueue():
+        with queue_lock_b:         # enqueue_lock -> B
+            program.enqueue()
+
+
+def wait_none_under_lock(fut):
+    # result(None) is EXPLICITLY unbounded — it must not pass for a
+    # bounded wait just because an argument is present
+    with queue_lock_a:
+        return fut.result(None)
+
+
+def clean_try_acquire(other_lock):
+    # good twin: acquire(blocking=False) returns immediately — holding
+    # a lock across it is fine
+    with queue_lock_a:
+        return other_lock.acquire(blocking=False)

@@ -1,0 +1,41 @@
+"""Jit-purity violations: tracer leaks inside a kernel body.
+``clean_kernel`` is the good twin (shape reads, None tests, static
+branching are all allowed)."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def leaky_kernel(scores, mask, *, top_k):
+    t0 = time.time()               # VIOLATION: clock read in jit body
+    best = scores.max()
+    if best > 0:                   # VIOLATION: Python branch on tracer
+        scores = scores + 1
+    peak = best.item()             # VIOLATION: .item() host sync
+    host = np.asarray(scores)      # VIOLATION: host materialization
+    n = int(scores[0])             # VIOLATION: int() on a tracer
+    return scores, mask, t0, peak, host, n
+
+
+@functools.partial(jax.jit, static_argnames=())
+def retraced_kernel(scores, *, top_k):
+    # VIOLATION (cache-key hygiene): keyword-only shape knob not in
+    # static_argnames — every distinct top_k silently retraces
+    return jax.lax.top_k(scores, 4)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def clean_kernel(scores, mask, extra=None, *, top_k):
+    n = scores.shape[0]            # shape reads are static: fine
+    k = min(top_k, n)
+    if extra is not None:          # None-ness is static: fine
+        scores = scores + extra
+    if k > 16:                     # branches on statics: fine
+        scores = scores * 2
+    masked = jnp.where(mask, scores, -1)
+    return jax.lax.top_k(masked, k)

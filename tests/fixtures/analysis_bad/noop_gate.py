@@ -1,0 +1,68 @@
+"""Noop-contract violations: work before the gate, unguarded record
+calls. ``record_clean`` / ``hit_guarded`` are the good twins."""
+
+import time
+
+from . import fakes as obs
+
+FAULTS = object()        # stand-in singleton; never executed
+TELEMETRY = object()
+
+
+class Telemetry:
+    def __init__(self):
+        self.enabled = True
+
+    def record_thing(self, seconds):
+        # VIOLATION: metric write + clock read before the gate test —
+        # the disabled path pays both on every call
+        obs.things_recorded.inc()
+        t = time.time()
+        if not self.enabled:
+            return
+        obs.thing_seconds.observe(t - seconds)
+
+    def record_clean(self, seconds):
+        if not self.enabled:
+            return
+        obs.things_recorded.inc()
+        obs.thing_seconds.observe(time.time() - seconds)
+
+
+def hit_unguarded():
+    # VIOLATION: record-protocol call with no dominating .active check
+    FAULTS.hit("some_faultpoint")
+
+
+def hit_guarded():
+    if FAULTS.active:
+        FAULTS.hit("some_faultpoint")
+
+
+def record_unguarded(age):
+    # VIOLATION: telemetry record with no dominating .enabled check
+    TELEMETRY.record_age(age)
+
+
+def hit_inverted_gate():
+    # VIOLATION: the polarity trap — this exits on the ARMED path and
+    # runs the record protocol on the disabled one
+    if FAULTS.active:
+        return
+    FAULTS.hit("some_faultpoint")
+
+
+def record_with_item(span):
+    # VIOLATION: a record-protocol call used as a context manager is
+    # still a record-protocol call
+    with TELEMETRY.record_span(span):
+        pass
+
+
+def hit_in_else():
+    # VIOLATION: the else branch of a gate test is the gate-OFF path —
+    # it must not inherit the guard credit
+    if FAULTS.active:
+        pass
+    else:
+        FAULTS.hit("some_faultpoint")

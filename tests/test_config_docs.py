@@ -3,54 +3,36 @@ test_observability.py's metrics-catalog test).
 
 PRs 1-6 each added TempoDBConfig knobs, and nothing enforced that
 docs/configuration.md kept up — knob/doc skew was only caught by
-review. Two invariants:
+review. Two invariants (unchanged since this test's hand-rolled
+original; the walk now lives in the analysis drift engine and these
+are thin wrappers over its declarations — tempo_tpu/analysis/drift.py
+CATALOGS):
 
   1. every `TempoDBConfig` dataclass field name appears in
      docs/configuration.md (as the YAML knob, or in the documented
-     constructor-only / renamed-knob lists);
+     constructor-only / renamed-knob lists) — catalog "config-fields";
   2. every YAML key the config loader actually reads
      (`*.get("<key>"...)` in cli/config.py) appears in
-     docs/configuration.md.
+     docs/configuration.md — catalog "yaml-knobs".
 """
 
-import dataclasses
-import os
-import re
-
-from tempo_tpu.db import TempoDBConfig
-
-_ROOT = os.path.join(os.path.dirname(__file__), "..")
+from tempo_tpu.analysis.drift import catalog_findings
 
 
-def _doc() -> str:
-    with open(os.path.join(_ROOT, "docs", "configuration.md"),
-              encoding="utf-8") as f:
-        return f.read()
+def _render(findings) -> str:
+    return "\n".join(f"{f.path}:{f.line}: {f.message}" for f in findings)
 
 
 def test_every_tempodb_config_field_documented():
-    doc = _doc()
-    missing = sorted(
-        f.name for f in dataclasses.fields(TempoDBConfig)
-        if f.name not in doc
-    )
-    assert not missing, (
+    findings = catalog_findings("config-fields")
+    assert not findings, (
         "TempoDBConfig fields missing from docs/configuration.md "
-        f"(document the knob, or list it under 'fields without their "
-        f"own YAML knob'): {missing}")
-
-
-_GET_RE = re.compile(r"""\.get\(\s*["']([a-z0-9_]+)["']""")
+        "(document the knob, or list it under 'fields without their "
+        "own YAML knob'):\n" + _render(findings))
 
 
 def test_every_yaml_knob_documented():
-    with open(os.path.join(_ROOT, "tempo_tpu", "cli", "config.py"),
-              encoding="utf-8") as f:
-        src = f.read()
-    keys = set(_GET_RE.findall(src))
-    assert len(keys) >= 30, f"config-loader grep looks broken: {sorted(keys)}"
-    doc = _doc()
-    missing = sorted(k for k in keys if k not in doc)
-    assert not missing, (
+    findings = catalog_findings("yaml-knobs")
+    assert not findings, (
         "YAML knobs read by cli/config.py but absent from "
-        f"docs/configuration.md: {missing}")
+        "docs/configuration.md:\n" + _render(findings))

@@ -628,41 +628,28 @@ def test_debug_faults_route_json(tmp_path):
 # ----------------------------------------------------------- docs drift
 
 
-def _robustness_doc() -> str:
-    with open(os.path.join(_ROOT, "docs", "robustness.md"),
-              encoding="utf-8") as f:
-        return f.read()
-
-
 def test_faultpoint_catalog_documented():
     """Every registered faultpoint must appear in docs/robustness.md —
-    the faultpoint twin of test_config_docs.py."""
-    doc = _robustness_doc()
-    missing = sorted(n for n in CATALOG if f"`{n}`" not in doc)
-    assert not missing, (
-        "faultpoints missing from docs/robustness.md catalog: "
-        f"{missing}")
+    the faultpoint twin of test_config_docs.py. Thin wrapper over the
+    analysis drift engine's "faultpoints" catalog (same invariant the
+    hand-rolled pre-PR-10 version enforced)."""
+    from tempo_tpu.analysis.drift import catalog_findings
+
+    findings = catalog_findings("faultpoints")
+    assert not findings, (
+        "faultpoints missing from docs/robustness.md catalog:\n"
+        + "\n".join(f"{f.path}:{f.line}: {f.message}" for f in findings))
 
 
 def test_robustness_knobs_documented():
     """Every robustness TempoDBConfig knob (search_breaker_*,
     search_*_timeout_s, robustness_*) must appear in both
-    docs/robustness.md and docs/configuration.md."""
-    import dataclasses
+    docs/robustness.md and docs/configuration.md — drift-engine
+    catalog "robustness-knobs"."""
+    from tempo_tpu.analysis.drift import catalog_findings
 
-    knobs = [
-        f.name for f in dataclasses.fields(TempoDBConfig)
-        if f.name.startswith(("search_breaker_", "robustness_"))
-        or f.name in ("search_device_dispatch_timeout_s",
-                      "search_dispatch_lock_timeout_s",
-                      "search_request_timeout_s")
-    ]
-    assert len(knobs) >= 8, knobs
-    rdoc = _robustness_doc()
-    with open(os.path.join(_ROOT, "docs", "configuration.md"),
-              encoding="utf-8") as f:
-        cdoc = f.read()
-    missing = sorted(k for k in knobs if k not in rdoc or k not in cdoc)
-    assert not missing, (
+    findings = catalog_findings("robustness-knobs")
+    assert not findings, (
         "robustness knobs missing from docs/robustness.md or "
-        f"docs/configuration.md: {missing}")
+        "docs/configuration.md:\n"
+        + "\n".join(f"{f.path}:{f.line}: {f.message}" for f in findings))
