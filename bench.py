@@ -1737,6 +1737,146 @@ def phase_chaos():
         }
 
 
+def phase_ownership():
+    """Owner-routed HBM contract (docs/search-hbm-ownership.md,
+    ISSUE 11 acceptance): simulated two-owner serving over ONE shared
+    hot blocklist whose staged footprint exceeds a single host's HBM
+    budget.
+
+      - independent caches (ownership OFF): both hosts serve the full
+        stream over the full blocklist under the same budget — the LRU
+        thrashes the shared hot set and every round re-stages;
+      - owner-routed (ON): each host stages only its owned placement
+        groups (which fit the budget) and serves the rest through the
+        byte-identical host route — strictly fewer re-stage bytes and a
+        higher HBM hit ratio, with responses byte-identical to OFF.
+    """
+    import json as _json
+    import tempfile
+
+    from tempo_tpu import tempopb
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.backend.types import (
+        BlockMeta, NAME_SEARCH, NAME_SEARCH_HEADER,
+    )
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.encoding.v2.compression import compress
+    from tempo_tpu.observability import metrics as obs
+    from tempo_tpu.search import ownership
+
+    n_blocks = int(os.environ.get("BENCH_OWNERSHIP_BLOCKS", 24))
+    entries_per_block = int(os.environ.get("BENCH_OWNERSHIP_ENTRIES", 8192))
+    rounds = int(os.environ.get("BENCH_OWNERSHIP_ROUNDS", 6))
+    budget_frac = float(os.environ.get("BENCH_OWNERSHIP_BUDGET_FRAC", 0.55))
+
+    def canon(resp):
+        r = tempopb.SearchResponse()
+        r.CopyFrom(resp)
+        r.metrics.device_seconds = 0.0
+        r.metrics.inspected_bytes_device = 0
+        return r.SerializeToString()
+
+    with tempfile.TemporaryDirectory() as td:
+        be = LocalBackend(td + "/blocks")
+        metas = []
+        for s in range(n_blocks):
+            pages = build_corpus(entries_per_block, E=256, seed=s)
+            # unique trace ids: the identity assert compares MERGED
+            # results, and build_corpus's all-zero ids would collapse
+            # every entry into one trace whose merge winner depends on
+            # group completion order, not on routing
+            rng = np.random.default_rng(10_000 + s)
+            pages.trace_ids = rng.integers(
+                0, 255, size=pages.trace_ids.shape, dtype=np.uint8)
+            m = BlockMeta(tenant_id="bench", encoding="none")
+            blob = compress(pages.to_bytes(), "none")
+            hdr = dict(pages.header)
+            hdr["encoding"] = "none"
+            hdr["compressed_size"] = len(blob)
+            be.write("bench", m.block_id, NAME_SEARCH, blob)
+            be.write("bench", m.block_id, NAME_SEARCH_HEADER,
+                     _json.dumps(hdr).encode())
+            metas.append(m)
+
+        req = tempopb.SearchRequest()
+        req.tags["service.name"] = "svc-007"
+        req.limit = 10_000  # never early-quits: every group is served
+
+        def mkdb(tag, budget):
+            # small groups (few blocks each) so ownership has real
+            # granularity to split; coalescing off — serial stream
+            db = TempoDB(be, f"{td}/wal-{tag}", TempoDBConfig(
+                auto_mesh=False,
+                search_max_batch_pages=64,
+                search_batch_cache_bytes=budget,
+                search_coalesce_max_queries=0))
+            db.blocklist.update("bench", add=metas)
+            return db
+
+        # sizing pass: the full blocklist's staged footprint
+        sizer = mkdb("size", 64 << 30)
+        sizer.search("bench", req)
+        hot_set_bytes = sizer.batcher._cache_total
+        budget = max(1, int(hot_set_bytes * budget_frac))
+
+        def serve(tag, enable):
+            """Two fresh hosts serve `rounds` passes of the stream; in
+            ownership mode each request is answered AS its host (the
+            process-wide self_id flips — serial, so race-free)."""
+            dbs = [mkdb(f"{tag}-h0", budget), mkdb(f"{tag}-h1", budget)]
+            if enable:
+                ownership.configure(enabled=True, members="h0,h1",
+                                    self_id="h0", groups=32)
+            else:
+                ownership.OWNERSHIP.reset()
+            h2d0 = obs.h2d_bytes.value()
+            hit0 = obs.batch_cache_events.value(result="hit")
+            miss0 = obs.batch_cache_events.value(result="miss")
+            outs = []
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                for i, db in enumerate(dbs):
+                    if enable:
+                        ownership.OWNERSHIP.self_id = f"h{i}"
+                    outs.append(canon(db.search("bench", req).response()))
+            wall = time.perf_counter() - t0
+            hits = obs.batch_cache_events.value(result="hit") - hit0
+            misses = obs.batch_cache_events.value(result="miss") - miss0
+            stats = {
+                "restage_bytes": int(obs.h2d_bytes.value() - h2d0),
+                "hbm_hits": int(hits),
+                "hbm_misses": int(misses),
+                "hbm_hit_ratio": round(hits / max(1, hits + misses), 4),
+                "wall_s": round(wall, 3),
+            }
+            ownership.OWNERSHIP.reset()
+            return outs, stats
+
+        off_outs, off = serve("off", enable=False)
+        on_outs, on = serve("on", enable=True)
+        identical = on_outs == off_outs
+        assert identical, "ownership on/off responses diverged"
+        assert on["restage_bytes"] < off["restage_bytes"], (
+            f"owner routing re-staged {on['restage_bytes']} bytes, "
+            f"independent caches {off['restage_bytes']} — the placement "
+            "split saved nothing")
+        assert on["hbm_hit_ratio"] >= off["hbm_hit_ratio"]
+        return {
+            "blocks": n_blocks,
+            "rounds": rounds,
+            "hosts": 2,
+            "hot_set_bytes": int(hot_set_bytes),
+            "hbm_budget_bytes": int(budget),
+            "byte_identical": identical,
+            "ownership_off": off,
+            "ownership_on": on,
+            "restage_bytes_saved": off["restage_bytes"] - on["restage_bytes"],
+            "owner_routed": int(obs.hbm_owner_routed.value(route="owner")),
+            "non_owner_host_routed": int(
+                obs.hbm_owner_routed.value(route="non_owner_host")),
+        }
+
+
 def phase_scale_10k():
     n_blocks = int(os.environ.get("BENCH_SCALE_BLOCKS", 10_000))
     if not n_blocks:
@@ -1768,6 +1908,7 @@ PHASES = {
     "query_stats_overhead": phase_query_stats_overhead,
     "freshness": phase_freshness,
     "chaos": phase_chaos,
+    "ownership": phase_ownership,
     "scale_10k": phase_scale_10k,
     "scale_large_blocks": phase_scale_large_blocks,
 }
@@ -1787,6 +1928,7 @@ PHASE_TIMEOUTS = {
     "query_stats_overhead": 300.0,
     "freshness": 420.0,
     "chaos": 420.0,
+    "ownership": 420.0,
     "scale_10k": 900.0,
     "scale_large_blocks": 1200.0,
 }

@@ -83,6 +83,17 @@ GATED_FUNCTIONS = (
                   "search_breaker_enabled"),
     GatedFunction("tempo_tpu.robustness.dispatch", "DispatchGuard.run",
                   ("enabled", "active"), "search_breaker_enabled"),
+    # owner-routed HBM: every placement lookup is internally gated, so
+    # ownership disabled costs one attribute read wherever it is
+    # consulted (the batcher additionally guards its call sites — see
+    # the OWNERSHIP guarded-call rule below)
+    GatedFunction("tempo_tpu.search.ownership", "OwnershipMap.owns_group",
+                  ("enabled",), "search_hbm_ownership_enabled"),
+    GatedFunction("tempo_tpu.search.ownership", "OwnershipMap.owns_block",
+                  ("enabled",), "search_hbm_ownership_enabled"),
+    GatedFunction("tempo_tpu.search.ownership",
+                  "OwnershipMap.owner_index", ("enabled",),
+                  "search_hbm_ownership_enabled"),
 )
 
 GUARDED_CALLS = (
@@ -92,6 +103,10 @@ GUARDED_CALLS = (
                 "enabled", "TELEMETRY", "ingest_telemetry_enabled"),
     GuardedCall("coalescer", ("submit",), (), "coalescer", "coalescer",
                 "search_coalesce_max_queries"),
+    # hot-path ownership lookups must be dominated by the one-attribute
+    # gate read — the disabled serving path never enters the map
+    GuardedCall("OWNERSHIP", ("owns_group",), (), "enabled", "OWNERSHIP",
+                "search_hbm_ownership_enabled"),
 )
 
 

@@ -386,6 +386,18 @@ class HTTPApi:
             },
         }
 
+    def _debug_ownership_route(self, query):
+        # owner-routed HBM: the placement map (group -> owner),
+        # membership generation, and this process's per-group residency
+        # (search/ownership.py + the batcher's staged-cache view)
+        from tempo_tpu.search.ownership import OWNERSHIP
+
+        snap = OWNERSHIP.snapshot()
+        db = getattr(self.app, "reader_db", None)
+        if db is not None:
+            snap["residency"] = db.batcher.ownership_residency()
+        return 200, snap
+
     def _debug_ingest_route(self, query):
         # write-path telemetry: per-tenant live/unflushed/backlog state,
         # last flush/poll ages, WAL replay, slow-flush ring, canary
@@ -513,6 +525,7 @@ DEBUG_ROUTES = {
     "/debug/querystats": HTTPApi._debug_querystats_route,
     "/debug/ingest": HTTPApi._debug_ingest_route,
     "/debug/faults": HTTPApi._debug_faults_route,
+    "/debug/ownership": HTTPApi._debug_ownership_route,
 }
 
 

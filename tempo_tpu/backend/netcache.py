@@ -19,26 +19,15 @@ import socket
 import threading
 
 from tempo_tpu.observability import Counter
-from tempo_tpu.utils.hashing import fnv1a_64
+# jump_hash re-exported for compatibility: the implementation moved to
+# utils.hashing so the HBM ownership map (search/ownership.py) and this
+# server selector share ONE consistent-hash helper
+from tempo_tpu.utils.hashing import fnv1a_64, jump_hash  # noqa: F401
 
 _cache_errors = Counter("tempo_cache_errors_total",
                         "network cache operation failures (degraded to miss)")
 _cache_dropped = Counter("tempo_cache_background_dropped_total",
                          "write-behind stores dropped on queue overflow")
-
-
-def jump_hash(key: int, num_buckets: int) -> int:
-    """Lamping-Veach jump consistent hash — the reference's memcached
-    client selector (pkg/cache jump-hash selector): minimal key movement
-    when the server list grows/shrinks."""
-    if num_buckets <= 1:
-        return 0
-    b, j = -1, 0
-    while j < num_buckets:
-        b = j
-        key = (key * 2862933555777941757 + 1) & 0xFFFFFFFFFFFFFFFF
-        j = int(float(b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
-    return b
 
 
 class _ConnPool:

@@ -116,6 +116,18 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "search_offload_planner_ewma", 0.25),
         search_offload_planner_ring=storage.get(
             "search_offload_planner_ring", 256),
+        # owner-routed HBM (docs/search-hbm-ownership.md): consistent-
+        # hash block-group ownership across the fleet; false (default)
+        # is a true noop, members/self auto-derive from the multihost
+        # env contract when left empty
+        search_hbm_ownership_enabled=storage.get(
+            "search_hbm_ownership_enabled", False),
+        search_hbm_ownership_members=storage.get(
+            "search_hbm_ownership_members", ""),
+        search_hbm_ownership_self=storage.get(
+            "search_hbm_ownership_self", ""),
+        search_hbm_ownership_groups=storage.get(
+            "search_hbm_ownership_groups", 64),
         # robustness (docs/robustness.md): device dispatch watchdog,
         # collective-lock bound, request deadlines, circuit breaker,
         # fault-injection arming. Breaker off + faults disarmed is a

@@ -101,6 +101,28 @@ class TempoDBConfig:
     # faster, noisier) and the decision ring rendered by /debug/planner
     search_offload_planner_ewma: float = 0.25
     search_offload_planner_ring: int = 256
+    # owner-routed HBM (search/ownership.py,
+    # docs/search-hbm-ownership.md): block placement groups get
+    # consistent-hash ownership across the fleet — the frontend routes a
+    # group's sub-queries to its owner (the one process holding it
+    # device-resident, where cross-request coalescing fuses tenants'
+    # dashboards), a non-owner serves the byte-identical host route
+    # instead of staging a duplicate HBM copy, and a membership change
+    # moves only the affected groups (eviction becomes a placement
+    # change). False (default) is a true noop: one attribute read per
+    # site, byte-identical routing.
+    search_hbm_ownership_enabled: bool = False
+    # comma-separated fleet member ids ("host-0,host-1"); empty = auto
+    # from the multihost env contract (TEMPO_NUM_PROCESSES /
+    # TEMPO_PROCESS_ID), a single-member "self" fleet otherwise
+    search_hbm_ownership_members: str = ""
+    # this process's member id; empty = auto (matches the member
+    # auto-derivation above)
+    search_hbm_ownership_self: str = ""
+    # placement-group count block ids hash onto (the ownership and
+    # rebalance unit): more groups = finer rebalance granularity at a
+    # larger /debug/ownership map
+    search_hbm_ownership_groups: int = 64
     # stage + compile-warm hot batches in the background after each poll
     # so the first query pays neither (off by default: polls in tests and
     # write-only processes must not spin up device work)
@@ -276,6 +298,15 @@ class TempoDB:
         _planner.configure(enabled=self.cfg.search_offload_planner_enabled,
                            alpha=self.cfg.search_offload_planner_ewma,
                            ring_size=self.cfg.search_offload_planner_ring)
+        # owner-routed HBM placement: process-wide like the layers above
+        # (docs/search-hbm-ownership.md)
+        from tempo_tpu.search import ownership as _ownership
+
+        _ownership.configure(
+            enabled=self.cfg.search_hbm_ownership_enabled,
+            members=self.cfg.search_hbm_ownership_members or None,
+            self_id=self.cfg.search_hbm_ownership_self or None,
+            groups=self.cfg.search_hbm_ownership_groups)
         if (self.cfg.search_offload_planner_enabled
                 and not self.cfg.search_profiling_enabled):
             # the planner's device-side feed (device-probe rate, compile/
@@ -513,6 +544,43 @@ class TempoDB:
         t = self._prewarm_thread
         if t is not None and t.is_alive():
             t.join(timeout=timeout_s)
+
+    def rebalance_ownership(self, members, self_id: str | None = None,
+                            prestage: bool = True) -> dict:
+        """Apply a fleet membership change to the HBM ownership map and
+        treat the resulting evictions as a PLACEMENT change
+        (docs/search-hbm-ownership.md): the generation bumps and only
+        the moved groups change owner; groups this member no longer owns
+        drop their HBM residency now (or at unpin, while a search holds
+        them pinned); groups it newly owns pre-stage in the background
+        from the cached job plans so the first owner-routed query after
+        the rebalance pays no staging. Returns the rebalance summary
+        (generation, moved groups, drops/deferrals)."""
+        from tempo_tpu.search.ownership import OWNERSHIP
+
+        moved = OWNERSHIP.set_members(members, self_id=self_id)
+        out = {"generation": OWNERSHIP.generation, "moved_groups": moved}
+        out.update(self.batcher.rebalance_ownership())
+        if prestage and OWNERSHIP.enabled:
+
+            def _prestage() -> None:
+                if not OWNERSHIP.enabled:
+                    return
+                gen = OWNERSHIP.generation
+                with self._search_lock:
+                    cached = list(self._jobs_cache.values())
+                for hit in cached:
+                    if OWNERSHIP.generation != gen:
+                        return  # a newer rebalance superseded this one
+                    groups = self.batcher.plan(list(hit[1]))
+                    # prewarm() itself skips non-owned groups; no
+                    # compile warm — the new owner wants residency, the
+                    # jit cache is already hot for these shapes
+                    self.batcher.prewarm(groups, warm_compile=False)
+
+            threading.Thread(target=_prestage, name="ownership-prestage",
+                             daemon=True).start()
+        return out
 
     @staticmethod
     def _include_block(m: BlockMeta, block_start: str, block_end: str,

@@ -26,6 +26,7 @@ from tempo_tpu.model.codec import codec_for, CURRENT_ENCODING
 from tempo_tpu.model.combine import combine_trace_protos
 from tempo_tpu.observability import tracing
 from tempo_tpu.search import SearchResults
+from tempo_tpu.search.ownership import OWNERSHIP
 
 from .queue import QueueWorkerPool
 
@@ -123,6 +124,18 @@ class QueryFrontend:
         q = self.queriers[self._rr % len(self.queriers)]
         self._rr += 1
         return q
+
+    def _owner_querier(self, owner: int | None, attempt: int):
+        """Owner-routed dispatch (docs/search-hbm-ownership.md): the
+        FIRST attempt of a block batch goes to its placement group's
+        owner — the one process holding the group HBM-resident, where
+        concurrent tenants' dashboards coalesce into fused dispatches.
+        Retries (owner death, a wedged owner timing out) fall back to
+        the round-robin pool, where any non-owner answers through the
+        byte-identical host route instead of failing the query."""
+        if owner is None or attempt > 0 or not self.queriers:
+            return self._querier()
+        return self.queriers[owner % len(self.queriers)]
 
     def _retrying(self, fn, job):
         from tempo_tpu.robustness import DeadlineExceeded, deadline
@@ -242,13 +255,16 @@ class QueryFrontend:
     def _search_batches(self, tenant: str) -> list[tuple]:
         """Page-range jobs grouped into batched requests — each querier
         stacks its share into few kernel dispatches; batches break at
-        geometry boundaries so every batch is geometry-pure. Returns
-        [(payload, breq_template)] where payload is the [(meta, start,
-        n_pages)] job list (failure accounting) and breq_template a
-        read-only SearchBlocksRequest with the jobs pre-built. Memoized
-        per (tenant, blocklist epoch): re-sorting a 10K-block meta list
-        and rebuilding its job list is O(blocks) host work per query
-        otherwise (VERDICT r3 #1).
+        geometry (and, under ownership, owner) boundaries so every
+        batch is geometry-pure and owner-pure. Returns
+        [(payload, breq_template, owner)] where payload is the [(meta,
+        start, n_pages)] job list (failure accounting), breq_template a
+        read-only SearchBlocksRequest with the jobs pre-built, and
+        owner the batch's member index for owner routing (None = no
+        preference). Memoized per (tenant, blocklist epoch, ownership
+        generation): re-sorting a 10K-block meta list and rebuilding
+        its job list is O(blocks) host work per query otherwise
+        (VERDICT r3 #1).
 
         Deliberately NOT filtered by the request's time window (the
         reference sharder excludes out-of-range metas,
@@ -265,12 +281,32 @@ class QueryFrontend:
         width = (self.queriers.stable_len()
                  if hasattr(self.queriers, "stable_len")
                  else len(self.queriers))
-        key = (tenant, db.blocklist.epoch(), width)
+        # the ownership generation keys the memo when owner routing is
+        # on: a rebalance regroups the batches, and serving a stale
+        # template would route groups to their PREVIOUS owner
+        own_gen = OWNERSHIP.generation if OWNERSHIP.enabled else -1
+        key = (tenant, db.blocklist.epoch(), width, own_gen)
         hit = self._batches_cache.get(key)
         if hit is not None:
             return hit
         metas = list(db.blocklist.metas(tenant))
         block_jobs = self._block_jobs(metas)
+        owner_of: dict = {}
+        if OWNERSHIP.enabled:
+            # owner-routed sharding (docs/search-hbm-ownership.md):
+            # jobs regroup by placement-group owner so every batched
+            # request lands WHOLE on one owner — the process already
+            # holding those blocks device-resident. The stable sort
+            # keeps the geometry order within each owner, so batches
+            # stay geometry-pure exactly as before.
+            for j in block_jobs:
+                bid = j[0].block_id
+                if bid not in owner_of:
+                    owner_of[bid] = OWNERSHIP.owner_index(bid)
+            block_jobs = sorted(
+                block_jobs,
+                key=lambda j: (-1 if owner_of[j[0].block_id] is None
+                               else owner_of[j[0].block_id]))
         # auto: spread the whole job list over the querier pool — each
         # querier's share scans in ~one batched dispatch
         B = self.cfg.batch_jobs_per_request or max(
@@ -278,9 +314,13 @@ class QueryFrontend:
         batches = []
         run_start = 0
         for i in range(1, len(block_jobs) + 1):
-            geo = lambda j: (j[0].search_entries_per_page,   # noqa: E731
+            # batches break at geometry AND owner boundaries: a mixed
+            # batch would fragment into several dispatches (geometry) or
+            # split one request across owners (routing)
+            sig = lambda j: (owner_of.get(j[0].block_id),   # noqa: E731
+                             j[0].search_entries_per_page,
                              j[0].search_kv_per_entry)
-            if i == len(block_jobs) or geo(block_jobs[i]) != geo(block_jobs[run_start]):
+            if i == len(block_jobs) or sig(block_jobs[i]) != sig(block_jobs[run_start]):
                 run = block_jobs[run_start:i]
                 batches.extend(run[k:k + B] for k in range(0, len(run), B))
                 run_start = i
@@ -304,7 +344,9 @@ class QueryFrontend:
                 # window-prune container-less blocks pre-proto-scan
                 j.start_time = m.start_time or 0
                 j.end_time = m.end_time or 0
-            out.append((b, t))
+            # the batch's routing preference: its (single, by the run
+            # break above) owner's member index; None = round-robin
+            out.append((b, t, owner_of.get(b[0][0].block_id)))
         self._batches_cache.put(key, out)
         return out
 
@@ -362,7 +404,7 @@ class QueryFrontend:
                 # metrics.failed_blocks tells the client how much of
                 # the corpus went unsearched
                 if kind != "recent":
-                    pl, _template = payload
+                    pl = payload[0]
                     with merge_lock:
                         failed_block_ids.update(m.block_id
                                                 for m, _, _ in pl)
@@ -378,15 +420,23 @@ class QueryFrontend:
                     recent_failed[0] = True  # ingester leg is not a block
                     raise
             else:
-                payload, template = payload
+                payload, template, owner = payload
                 breq = tempopb.SearchBlocksRequest()
                 breq.CopyFrom(template)  # C-level copy of the job list
                 breq.search_req.CopyFrom(req)
                 breq.tenant_id = tenant
+                # attempt 0 targets the group's owner (owner-routed
+                # HBM); retries round-robin — owner death degrades to
+                # any non-owner's byte-identical host route
+                attempts = [0]
+
+                def _send(_j):
+                    q = self._owner_querier(owner, attempts[0])
+                    attempts[0] += 1
+                    return q.search_blocks(breq)
+
                 try:
-                    r = self._retrying(
-                        lambda _: self._querier().search_blocks(breq), job
-                    )
+                    r = self._retrying(_send, job)
                 except Exception:
                     # one failed batch = every distinct block it carried
                     with merge_lock:

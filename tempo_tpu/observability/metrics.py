@@ -361,6 +361,28 @@ coalesce_pending = Gauge("tempo_search_coalesce_pending_queries",
                          "queries parked in coalescing windows right now "
                          "(the coalescer queue depth)")
 
+# ---- owner-routed HBM (search/ownership.py) ----
+hbm_owner_generation = Gauge(
+    "tempo_search_hbm_owner_generation",
+    "ownership-map membership generation this process placed against; "
+    "fleet members disagreeing here are mid-rebalance")
+hbm_owner_groups = Gauge(
+    "tempo_search_hbm_owner_groups",
+    "placement groups this member owns under the current generation")
+hbm_owner_rebalance_moves = Counter(
+    "tempo_search_hbm_owner_rebalance_moves_total",
+    "placement groups whose owner changed at a membership generation "
+    "bump — the rebalance is a placement diff, never a cache flush")
+hbm_owner_routed = Counter(
+    "tempo_search_hbm_owner_routed_total",
+    "batcher group routing decisions while ownership is enabled "
+    "(route=owner|non_owner_host: device-resident serve vs the "
+    "byte-identical host route on a non-owner)")
+hbm_owner_rebalance_evictions = Counter(
+    "tempo_search_hbm_owner_rebalance_evictions_total",
+    "HBM batches released because a rebalance moved their group away "
+    "(result=dropped|deferred; deferred batches drop at unpin)")
+
 # ---- offload planner (search/planner.py) ----
 offload_decisions = Counter(
     "tempo_search_offload_decisions_total",

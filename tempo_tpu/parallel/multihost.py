@@ -85,6 +85,21 @@ def init_distributed(coordinator: str | None = None,
     return True
 
 
+def ownership_members() -> tuple[list[str], str]:
+    """(fleet member ids, this process's id) for the HBM ownership map
+    (search/ownership.py), derived from the distributed env contract
+    WITHOUT importing jax — a write-only process must not initialize a
+    device backend just to learn the fleet shape. Single-host (no
+    TEMPO_NUM_PROCESSES) is a one-member fleet that owns everything.
+    Every process derives the identical ordered list, so the placement
+    tables agree fleet-wide with zero coordination."""
+    n = int(os.environ.get("TEMPO_NUM_PROCESSES", "0") or 0)
+    pid = int(os.environ.get("TEMPO_PROCESS_ID", "0") or 0)
+    if n > 1:
+        return [f"host-{i}" for i in range(n)], f"host-{pid}"
+    return ["self"], "self"
+
+
 def is_multiprocess() -> bool:
     import jax
 

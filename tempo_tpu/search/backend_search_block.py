@@ -195,11 +195,20 @@ class BackendSearchBlock:
         out = render_pages = None
         pruned = False
         from tempo_tpu.observability import metrics as obs
+        from tempo_tpu.search.ownership import OWNERSHIP
 
         # same contract as the batcher: breaker open/half-open without a
         # probe token means the host route — no staging put, no device
-        # dispatch; a mid-flight DeviceFault falls through to host too
-        if BREAKER.allow_device():
+        # dispatch; a mid-flight DeviceFault falls through to host too.
+        # Owner routing applies here exactly like the batched path: a
+        # non-owner answers this block from the byte-identical host scan
+        # instead of staging a duplicate device copy.
+        allow_device = BREAKER.allow_device()
+        if allow_device and OWNERSHIP.enabled:
+            if not OWNERSHIP.owns_block(self.meta.block_id):
+                allow_device = False
+                obs.hbm_owner_routed.inc(route="non_owner_host")
+        if allow_device:
             try:
                 sp = GUARD.run("h2d", self.staged)
                 # staged_dict present → the substring probe runs on

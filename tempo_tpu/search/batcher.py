@@ -48,15 +48,18 @@ from tempo_tpu.observability import tracing
 from . import query_stats
 from .engine import DEFAULT_TOP_K, fetch_coalesced_out, resolve_top_k, \
     start_fetch
+from .ownership import OWNERSHIP
 from .multiblock import MultiBlockEngine, compile_multi, stack_queries
 from .pipeline import block_header_skip_reason
 from .results import SearchResults
 
 
 def host_scan(host, mq, top_k: int):
-    """The breaker's host-fallback execution: run the SAME
-    multi_scan_kernel over the host-tier stacked arrays, pinned to the
-    CPU backend — no wedged-device array is ever touched. Because it is
+    """The host route's execution (breaker fallback AND the ownership
+    layer's non-owner serve): run the SAME multi_scan_kernel over the
+    host-tier stacked arrays, pinned to the CPU backend — no
+    wedged-device array is ever touched, no duplicate HBM copy is ever
+    staged on a non-owner. Because it is
     the same kernel over the same padded shapes and the same compiled
     predicate semantics (host range tables; the device hit-mask path
     yields identical matches), the results are byte-identical to the
@@ -504,6 +507,12 @@ class BlockBatcher:
         # release exactly what was charged
         self._cpu_staged_bytes: dict[tuple, int] = {}
         self._staging: dict[tuple, threading.Event] = {}
+        # ownership rebalance evictions deferred while a search pins the
+        # batch: gkey -> the exact entry to drop at unpin. Keyed by entry
+        # IDENTITY at eviction time so a marker gone stale (the LRU got
+        # there first, or a re-stage replaced the object) is discarded
+        # instead of double-subtracting the budget
+        self._evict_deferred: dict[tuple, _CachedBatch] = {}
         self._warmed_shapes: set = set()  # compile-warm dedupe
         self._prune_cache: OrderedDict = OrderedDict()
         self._plan_cache: OrderedDict = OrderedDict()
@@ -608,6 +617,19 @@ class BlockBatcher:
             self._host_total -= self._cpu_staged_bytes.pop(k, 0)
             obs.batch_cache_events.inc(result="host_evict")
 
+    def _drop_hbm_locked(self, gkey: tuple) -> None:
+        """Remove one staged batch and release its budget charge —
+        caller holds self._lock. The single eviction primitive shared by
+        the LRU, the ownership rebalance, and the deferred-at-unpin
+        sweep, so the accounting subtraction happens in exactly one
+        place."""
+        old = self._cache.pop(gkey, None)
+        if old is None:
+            return
+        self._cache_total -= old.nbytes
+        self._probe_dict_total -= self._dict_bytes(old.batch)
+        obs.batch_cache_events.inc(result="evict")
+
     def _evict_hbm_locked(self) -> None:
         """LRU-evict staged batches until the HBM budget holds — caller
         holds self._lock. Pinned entries (actively scanned by some
@@ -619,11 +641,85 @@ class BlockBatcher:
                            if v.pins <= 0), None)
             if victim is None:
                 break  # everything pinned: over budget until a drain
-            old = self._cache.pop(victim)
-            self._cache_total -= old.nbytes
-            self._probe_dict_total -= self._dict_bytes(old.batch)
-            obs.batch_cache_events.inc(result="evict")
+            self._drop_hbm_locked(victim)
         self._publish_gauges_locked()
+
+    def _run_deferred_evictions_locked(self) -> None:
+        """Ownership-rebalance evictions deferred while pinned run NOW
+        (at unpin) — exactly once: a marker whose cache entry is gone or
+        replaced (an LRU eviction or a re-stage beat us here) is
+        discarded without touching the budget, so a rebalance and an LRU
+        eviction targeting the same batch can never double-subtract its
+        bytes. Caller holds self._lock."""
+        if not self._evict_deferred:
+            return
+        for gkey, entry in list(self._evict_deferred.items()):
+            if self._cache.get(gkey) is not entry:
+                del self._evict_deferred[gkey]  # stale: already gone
+                continue
+            if entry.pins > 0:
+                continue  # another search still holds it
+            self._drop_hbm_locked(gkey)
+            del self._evict_deferred[gkey]
+            obs.hbm_owner_rebalance_evictions.inc(result="dropped")
+
+    def rebalance_ownership(self) -> dict:
+        """Treat an ownership rebalance as a PLACEMENT change for the
+        HBM cache: every resident batch whose group this member no
+        longer owns is dropped now, or — while a search pins it —
+        deferred to the unpin sweep. Host-tier entries stay: the
+        non-owner route serves from exactly that tier, so dropping them
+        would re-pay IO+decompress on the next routed-away query."""
+        if not OWNERSHIP.enabled:
+            return {"hbm_dropped": 0, "hbm_deferred": 0}
+        dropped = deferred = 0
+        with self._lock:
+            for gkey in list(self._cache):
+                if OWNERSHIP.owns_group(gkey):
+                    self._evict_deferred.pop(gkey, None)  # owned again:
+                    # a pending deferral from an older generation is void
+                    continue
+                entry = self._cache[gkey]
+                if entry.pins > 0:
+                    # count a deferral once per BATCH, not once per
+                    # rebalance: a batch pinned across several
+                    # membership flips re-arrives here each time
+                    if self._evict_deferred.get(gkey) is not entry:
+                        deferred += 1
+                    self._evict_deferred[gkey] = entry
+                else:
+                    self._evict_deferred.pop(gkey, None)
+                    self._drop_hbm_locked(gkey)
+                    dropped += 1
+            self._publish_gauges_locked()
+        if dropped:
+            obs.hbm_owner_rebalance_evictions.inc(dropped, result="dropped")
+        if deferred:
+            obs.hbm_owner_rebalance_evictions.inc(deferred,
+                                                  result="deferred")
+        return {"hbm_dropped": dropped, "hbm_deferred": deferred}
+
+    def ownership_residency(self) -> list:
+        """Per-resident-batch ownership view for /debug/ownership: which
+        placement group each staged batch anchors to, who owns it, and
+        whether a deferred rebalance eviction is pending on it."""
+        with self._lock:
+            rows = [(k, v.nbytes, v.pins, k in self._evict_deferred)
+                    for k, v in self._cache.items()]
+        out = []
+        for gkey, nbytes, pins, pending in rows:
+            anchor = str(gkey[0][0])
+            out.append({
+                "anchor_block": anchor,
+                "placement_group": OWNERSHIP.group_of(anchor),
+                "owner": OWNERSHIP.owner_of(anchor),
+                "owned": OWNERSHIP.owns_block(anchor),
+                "jobs": len(gkey),
+                "bytes": int(nbytes),
+                "pins": int(pins),
+                "deferred_evict": pending,
+            })
+        return out
 
     def _staged(self, group: list[ScanJob]) -> _CachedBatch:
         key = tuple(j.key for j in group)
@@ -738,6 +834,10 @@ class BlockBatcher:
                 old = self._cache.pop(k)
                 self._cache_total -= old.nbytes
                 self._probe_dict_total -= self._dict_bytes(old.batch)
+                # a pending rebalance deferral for a dead block's batch
+                # is satisfied by this removal — keeping the marker
+                # would double-evict whatever re-stages under the key
+                self._evict_deferred.pop(k, None)
             dead_h = [k for k in self._host_cache
                       if any(jk[0] not in live_block_ids for jk in k)]
             for k in dead_h:
@@ -761,6 +861,12 @@ class BlockBatcher:
             if budget <= 0:
                 break
             gkey = tuple(j.key for j in group)
+            if OWNERSHIP.enabled:
+                if not OWNERSHIP.owns_group(gkey):
+                    # non-owned groups serve through the host route —
+                    # prewarming them would stage exactly the duplicate
+                    # HBM copy ownership exists to avoid
+                    continue
             with self._lock:
                 resident = gkey in self._cache
             try:
@@ -854,7 +960,10 @@ class BlockBatcher:
                     self._unplanned -= 1
                 for c in pinned:
                     c.pins -= 1
-                # evictions deferred by pins run now that they dropped
+                # evictions deferred by pins run now that they dropped:
+                # first the ownership-rebalance deferrals (exactly-once,
+                # identity-checked), then ordinary LRU pressure
+                self._run_deferred_evictions_locked()
                 self._evict_hbm_locked()
 
     def _search_impl(self, jobs: list[ScanJob], req,
@@ -1066,7 +1175,8 @@ class BlockBatcher:
         sig = _predicate_sig(req)
 
         def host_route(group, gkey, hdr_reasons, book_skips=True):
-            """Scan one group ENTIRELY on the host path: breaker
+            """Scan one group ENTIRELY on the host path: this member is
+            not the group's owner (owner-routed HBM), the breaker is
             open/half-open without a probe token, or this group's device
             dispatch already faulted (drain resubmit). Host-tier staging
             (no device put), host-only compile (range tables), the same
@@ -1191,6 +1301,9 @@ class BlockBatcher:
                 if all(hdr_reasons_for(g)):
                     continue
                 k = tuple(j.key for j in g)
+                if OWNERSHIP.enabled:
+                    if not OWNERSHIP.owns_group(k):
+                        continue  # non-owned: host route, never staged
                 with self._lock:
                     resident = k in self._cache
                     host_res = k in self._host_cache
@@ -1236,12 +1349,30 @@ class BlockBatcher:
                         for r in hdr_reasons:
                             qs.add_skip(r)
                     continue
+                if OWNERSHIP.enabled:
+                    # owner-routed HBM: a group this member doesn't own
+                    # serves from the byte-identical host route — a
+                    # non-owner never stages a duplicate device copy
+                    # (docs/search-hbm-ownership.md); the owner's serve
+                    # proceeds below, device-resident
+                    if not OWNERSHIP.owns_group(gkey):
+                        obs.hbm_owner_routed.inc(route="non_owner_host")
+                        if qs is not None:
+                            qs.add_cache("non_owner_route")
+                        host_route(group, gkey, hdr_reasons)
+                        continue
                 if not robustness.BREAKER.allow_device():
                     # breaker open (or half-open with its probe tokens
                     # spent): this group runs the byte-identical host
                     # route — no staging put, no device dispatch
                     host_route(group, gkey, hdr_reasons)
                     continue
+                if OWNERSHIP.enabled:
+                    # counted AFTER the breaker gate: route=owner means
+                    # a device-resident serve, and during a wedged-owner
+                    # incident the owned groups above fell into the
+                    # breaker's host route instead
+                    obs.hbm_owner_routed.inc(route="owner")
                 # memo lookup needs the staged batch's identity; the memo
                 # itself lives on the cached batch so it dies with it
                 t0 = _time.perf_counter()

@@ -71,13 +71,16 @@ def _bucket(n: int) -> int:
 
 
 def cpu_pinned():
-    """Context pinning kernel execution to the CPU backend — the
-    breaker's host-fallback execution context, shared by the batched
-    (batcher.host_scan) and single-block
-    (backend_search_block.host_scan_single) fallbacks so their
-    byte-identity-critical plumbing cannot diverge. Platforms without a
-    reachable cpu backend degrade to the default device (still correct;
-    the point of the pin is to avoid a wedged accelerator)."""
+    """Context pinning kernel execution to the CPU backend — the host
+    route's execution context, shared by the batched (batcher.host_scan)
+    and single-block (backend_search_block.host_scan_single) paths so
+    their byte-identity-critical plumbing cannot diverge. Two consumers
+    ride it: the breaker's fallback when the device is wedged, and the
+    owner-routing layer's non-owner serve (search/ownership.py — a
+    process that doesn't own a block group answers from here instead of
+    staging a duplicate HBM copy). Platforms without a reachable cpu
+    backend degrade to the default device (still correct; the point of
+    the pin is to avoid a wedged accelerator)."""
     import contextlib
 
     try:

@@ -75,7 +75,10 @@ class HostBatch:
     """The host-RAM half of a staged batch: stacked (padded) numpy arrays
     ready for a device put. This is the overflow tier between the object
     store and HBM — an HBM-evicted batch re-stages from here with ONE
-    H2D copy, skipping IO + decompress + restack (VERDICT r3 #2)."""
+    H2D copy, skipping IO + decompress + restack (VERDICT r3 #2). Under
+    owner-routed HBM it is also the NON-owner serving tier (host_scan
+    runs over these arrays), which is why an ownership rebalance drops
+    only the HBM half: the host copy keeps serving routed-away queries."""
     cat: dict                       # stacked host arrays incl. page_block
     page_block: np.ndarray
     blocks: list                    # list[ColumnarPages]

@@ -1,0 +1,281 @@
+"""Owner-routed HBM placement: one distributed block cache across the fleet.
+
+Every process used to stage and evict its own BlockBatch HBM cache
+independently, so at production blocklist sizes the whole fleet thrashed
+the same hot set — an eviction cost a ~720 MB re-stage instead of a
+route change. This module gives block PLACEMENT GROUPS consistent-hash
+ownership across the fleet: a block id hashes onto one of a fixed set of
+placement groups (the shared Lamping-Veach jump hash in
+``utils.hashing`` — the same helper ``backend/netcache.py`` selects
+memcached servers with), and each group's owner is resolved on the
+existing ``modules/ring.py`` consistent-hash ring — one ring
+implementation for write placement, compactor job ownership AND HBM
+ownership, deliberately not a third hash scheme.
+
+Placement is PRECOMPUTED per membership generation: :meth:`set_members`
+builds the full group -> owner table once, so the hot-path lookup is two
+hashes plus a tuple index, placement can never drift with ring heartbeat
+aging, and a membership change reports exactly which groups moved — the
+rebalance is a placement DIFF, not a cache flush.
+
+Routing contract (docs/search-hbm-ownership.md):
+
+  - the frontend sends a block group's sub-queries to the owner first
+    (retries fall back to the round-robin querier pool);
+  - the owner serves the group device-resident (HBM staged + pinned),
+    and cross-request coalescing fuses N tenants' dashboards over a hot
+    group on that one host;
+  - a NON-owner receiving the query serves it through the byte-identical
+    host route (the breaker's fallback path) instead of staging a
+    duplicate HBM copy;
+  - owner death / a wedged owner degrade through the retry + breaker +
+    host route (chaos-tested in tests/test_faults.py), never hang;
+  - eviction-by-rebalance is a placement change: the old owner drops
+    (or, while a search pins the batch, defers) residency and the new
+    owner pre-stages (``TempoDB.rebalance_ownership``).
+
+Noop contract: ``search_hbm_ownership_enabled: false`` (the default)
+costs ONE attribute read (``OWNERSHIP.enabled``) at every call site and
+routing is byte-identical — the same contract the planner and
+query-stats knobs carry, pinned by the static noop-contract checker
+(analysis/contracts.py registers both the gate and the guarded calls).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+from tempo_tpu.observability import metrics as obs
+from tempo_tpu.utils.hashing import fnv1a_64, jump_hash, mix64
+
+DEFAULT_PLACEMENT_GROUPS = 64
+# tokens per member on the ownership ring: enough for an even split at
+# small fleets without making the table rebuild (n_groups ring walks)
+# noticeable on a membership change
+_RING_TOKENS = 64
+
+
+def _group_token(group: int) -> int:
+    """Ring token (32-bit, the ring's token space) for a placement
+    group id — mix64-finalized so consecutive group ids spread across
+    the whole token space instead of clustering in one ring segment."""
+    return mix64(fnv1a_64(b"hbm-group-%d" % group)) & 0xFFFFFFFF
+
+
+class OwnershipMap:
+    """Process-wide block-group -> owner placement map.
+
+    Lookup methods read two immutable tuples swapped atomically under
+    ``_lock`` by :meth:`set_members` — the hot path takes no lock. All
+    lookups answer "this member owns it" while the layer is DISABLED or
+    no membership is installed: single-process deployments behave
+    exactly as before the layer existed.
+    """
+
+    def __init__(self, n_groups: int = DEFAULT_PLACEMENT_GROUPS) -> None:
+        self.enabled = False
+        self.self_id = "self"
+        self.generation = 0
+        self.n_groups = int(n_groups)
+        self._lock = threading.Lock()
+        self._members: tuple[str, ...] = ()
+        self._owners: tuple[str, ...] = ()      # group id -> member id
+        self._owner_idx: tuple[int, ...] = ()   # group id -> member index
+        # the hot-path snapshot: (n_groups, owners, owner_idx) swapped
+        # as ONE tuple so a lookup never pairs a fresh group count with
+        # a stale table (configure() can resize n_groups while another
+        # thread is mid-lookup — indexing a 64-entry table with a
+        # 128-group hash would IndexError a live query)
+        self._table: tuple[int, tuple[str, ...], tuple[int, ...]] = \
+            (self.n_groups, (), ())
+
+    # ---- membership (the rebalance surface) ----
+
+    @property
+    def members(self) -> tuple[str, ...]:
+        return self._members
+
+    def set_members(self, members: Iterable[str],
+                    self_id: str | None = None) -> int:
+        """Install a fleet membership and precompute the placement table;
+        returns how many placement groups MOVED owner (0 on the first
+        install — nothing was placed before). Idempotent for an unchanged
+        member set (no generation bump), so repeated ``configure()``
+        calls from TempoDB construction never churn placement."""
+        new = tuple(dict.fromkeys(m for m in members if m))
+        if not new:
+            raise ValueError("ownership members must be non-empty")
+        with self._lock:
+            if self_id is not None:
+                self.self_id = self_id
+            if new == self._members:
+                self._publish_locked()
+                return 0
+            # lazy: modules.ring (via the modules package) must not load
+            # at search-package import time
+            from tempo_tpu.modules.ring import Ring
+
+            ring = Ring(replication_factor=1)
+            for m in new:
+                # Ring.register seeds its token RNG from the member id,
+                # so every process derives the IDENTICAL table from the
+                # same member list — no coordination needed
+                ring.register(m, n_tokens=_RING_TOKENS)
+            idx = {m: i for i, m in enumerate(new)}
+            owners: list[str] = []
+            for g in range(self.n_groups):
+                got = ring.get(_group_token(g), rf=1)
+                owners.append(got[0])
+            moved = sum(1 for old, cur in zip(self._owners, owners)
+                        if old != cur)
+            self._members = new
+            self._owners = tuple(owners)
+            self._owner_idx = tuple(idx[o] for o in owners)
+            self._table = (self.n_groups, self._owners, self._owner_idx)
+            self.generation += 1
+            if moved:
+                obs.hbm_owner_rebalance_moves.inc(moved)
+            self._publish_locked()
+            return moved
+
+    def _publish_locked(self) -> None:
+        obs.hbm_owner_generation.set(float(self.generation))
+        obs.hbm_owner_groups.set(float(
+            sum(1 for o in self._owners if o == self.self_id)))
+
+    # ---- placement lookups (hot path: no lock, no clock) ----
+
+    def group_of(self, block_id: str) -> int:
+        """Placement group of a block id: shared jump hash over the
+        shared fnv1a — deterministic on every member."""
+        return jump_hash(fnv1a_64(block_id.encode()), self.n_groups)
+
+    def owner_of(self, block_id: str) -> str | None:
+        """Owning member id, or None while no membership is installed."""
+        n, owners, _ = self._table
+        if not owners:
+            return None
+        return owners[jump_hash(fnv1a_64(block_id.encode()), n)]
+
+    def owner_index(self, block_id: str) -> int | None:
+        """Owner's index in the member list — the frontend's
+        member -> querier mapping (index mod pool size). None = no
+        routing preference (layer off or no membership)."""
+        if not self.enabled:
+            return None
+        n, _, idx = self._table
+        if not idx:
+            return None
+        return idx[jump_hash(fnv1a_64(block_id.encode()), n)]
+
+    def owns_block(self, block_id: str) -> bool:
+        if not self.enabled:
+            return True
+        n, owners, _ = self._table
+        if not owners:
+            return True
+        return owners[jump_hash(fnv1a_64(block_id.encode()), n)] \
+            == self.self_id
+
+    def owns_group(self, gkey: tuple) -> bool:
+        """Does this member own staged batch group ``gkey`` (a tuple of
+        batcher job keys ``(block_id, start_page, n_pages)``)? The
+        group's ANCHOR block (first job) decides: under frontend
+        owner-routing every block in a received group is owned anyway,
+        and any deterministic representative keeps routing
+        byte-identical — a non-owner's host route returns the same
+        answer either way."""
+        if not self.enabled:
+            return True
+        n, owners, _ = self._table
+        if not owners:
+            return True
+        anchor = str(gkey[0][0])
+        return owners[jump_hash(fnv1a_64(anchor.encode()), n)] \
+            == self.self_id
+
+    # ---- operator surface ----
+
+    def snapshot(self) -> dict[str, object]:
+        """/debug/ownership payload: the map, generation, member split."""
+        with self._lock:
+            owners = self._owners
+            members = self._members
+            gen = self.generation
+            self_id = self.self_id
+        counts: dict[str, int] = {}
+        for o in owners:
+            counts[o] = counts.get(o, 0) + 1
+        return {
+            "enabled": self.enabled,
+            "generation": gen,
+            "self": self_id,
+            "members": list(members),
+            "n_groups": self.n_groups,
+            "owners": {str(g): o for g, o in enumerate(owners)},
+            "groups_per_member": counts,
+        }
+
+    def reset(self) -> None:
+        """Back to the factory state (tests)."""
+        with self._lock:
+            self.enabled = False
+            self.self_id = "self"
+            self.generation = 0
+            self.n_groups = DEFAULT_PLACEMENT_GROUPS
+            self._members = ()
+            self._owners = ()
+            self._owner_idx = ()
+            self._table = (self.n_groups, (), ())
+            self._publish_locked()
+
+
+OWNERSHIP = OwnershipMap()
+
+
+def configure(enabled: bool | None = None,
+              members: str | Iterable[str] | None = None,
+              self_id: str | None = None,
+              groups: int | None = None) -> OwnershipMap:
+    """Apply config (TempoDBConfig.search_hbm_ownership_*) to the
+    process-wide map — the most recent TempoDB wins, the REGISTRY idiom.
+    ``members`` accepts the comma-separated config string or an
+    iterable; empty/None with the layer enabled auto-derives the fleet
+    from the multihost env contract
+    (parallel.multihost.ownership_members) so a mesh fleet needs zero
+    extra config."""
+    if groups is not None and int(groups) > 0 \
+            and int(groups) != OWNERSHIP.n_groups:
+        with OWNERSHIP._lock:
+            OWNERSHIP.n_groups = int(groups)
+            # the placement table is per group count: drop it so the
+            # member install below (or the next one) re-derives. The
+            # hot-path snapshot swaps as one tuple, so a concurrent
+            # lookup keeps pairing the OLD count with the OLD table
+            OWNERSHIP._members = ()
+            OWNERSHIP._owners = ()
+            OWNERSHIP._owner_idx = ()
+            OWNERSHIP._table = (int(groups), (), ())
+    mlist: list[str] | None
+    if isinstance(members, str):
+        parsed = [m.strip() for m in members.split(",") if m.strip()]
+        mlist = parsed or None
+    elif members is not None:
+        mlist = [str(m) for m in members]
+    else:
+        mlist = None
+    if enabled is not None:
+        OWNERSHIP.enabled = bool(enabled)
+    if mlist is None and OWNERSHIP.enabled and not OWNERSHIP.members:
+        from tempo_tpu.parallel.multihost import ownership_members
+
+        auto_members, auto_self = ownership_members()
+        mlist = auto_members
+        if self_id is None:
+            self_id = auto_self
+    if mlist is not None:
+        OWNERSHIP.set_members(mlist, self_id=self_id)
+    elif self_id:
+        OWNERSHIP.self_id = self_id
+    return OWNERSHIP

@@ -88,9 +88,17 @@ class SearchResults:
 
     def response(self) -> tempopb.SearchResponse:
         resp = tempopb.SearchResponse()
+        # tie-break equal start times by trace id: insertion order here
+        # depends on sub-result COMPLETION order (frontend shard
+        # threads, host-routed groups answering inline while device
+        # groups drain), and the reference sorts by start time only —
+        # a deterministic secondary key makes the response (including
+        # the limit cutoff) independent of where each group was served,
+        # which is what lets owner-routed/breaker fallback paths assert
+        # byte-identity
         metas = sorted(
             self._by_id.values(),
-            key=lambda m: m.start_time_unix_nano, reverse=True,
+            key=lambda m: (-m.start_time_unix_nano, m.trace_id),
         )[: self.limit]
         resp.traces.extend(metas)
         resp.metrics.CopyFrom(self.metrics)

@@ -35,6 +35,35 @@ def fnv1a_64(data: bytes, seed: int = _FNV1A_64_OFFSET) -> int:
     return h
 
 
+def mix64(x: int) -> int:
+    """splitmix64 finalizer: full-avalanche mix of a 64-bit value.
+    fnv1a alone is a poor ring-token source for short keys that differ
+    only in a trailing character — the last byte is mixed by a single
+    multiply, so the low 32 bits of similar keys cluster (spacing =
+    prime mod 2^32). Finalize with this before truncating to a token."""
+    x = (x + 0x9E3779B97F4A7C15) & _MASK64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return (x ^ (x >> 31)) & _MASK64
+
+
+def jump_hash(key: int, num_buckets: int) -> int:
+    """Lamping-Veach jump consistent hash: minimal key movement when the
+    bucket count grows/shrinks. The SHARED consistent-hash helper — the
+    network-cache server selector (backend/netcache.py, the reference's
+    pkg/cache jump-hash selector) and the HBM ownership map's
+    block -> placement-group step (search/ownership.py) both consume
+    this one implementation; do not grow another."""
+    if num_buckets <= 1:
+        return 0
+    b, j = -1, 0
+    while j < num_buckets:
+        b = j
+        key = (key * 2862933555777941757 + 1) & _MASK64
+        j = int(float(b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
+    return b
+
+
 def token_for(tenant: str, trace_id: bytes) -> int:
     """Ring token for a (tenant, trace id) pair — 32-bit fnv1a over the
     tenant bytes then the trace id bytes, matching the placement role of

@@ -625,6 +625,156 @@ def test_debug_faults_route_json(tmp_path):
     assert doc["breaker"]["state"] in (CLOSED, OPEN, HALF_OPEN)
 
 
+# ------------------------------------------------- owner-routed HBM chaos
+
+
+@pytest.fixture()
+def _clean_ownership():
+    from tempo_tpu.search.ownership import OWNERSHIP
+
+    OWNERSHIP.reset()
+    yield OWNERSHIP
+    OWNERSHIP.reset()
+
+
+def test_chaos_owner_death_mid_query(tmp_path, _clean_ownership):
+    """Owner death mid-query: the owner's querier dies between batches
+    of one request (replica_error armed on the recent leg too); retries
+    land on the surviving non-owner, which answers through the host
+    route — byte-identical to the ownership-disabled path, PARTIAL only
+    for the injected replica legs, never a hang."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.modules.ring import Ring
+    from tempo_tpu.search import ownership
+
+    db = _mkdb(tmp_path, n_blocks=6, search_max_batch_pages=8)
+    q = Querier(db, Ring(), {})
+
+    class _Dying:
+        def __init__(self, inner, die=False):
+            self.inner = inner
+            self.db = inner.db
+            self.die = die
+            self.calls = 0
+
+        def search_recent(self, tenant, req):
+            return self.inner.search_recent(tenant, req)
+
+        def search_blocks(self, breq):
+            self.calls += 1
+            if self.die:
+                raise RuntimeError("owner died mid-query")
+            return self.inner.search_blocks(breq)
+
+    owner = _Dying(q)
+    peer = _Dying(q)
+    fe = QueryFrontend([owner, peer], FrontendConfig(retries=3))
+    req = _req(limit=10_000)
+    # baseline: ownership disabled, everyone healthy, replica fault
+    # armed identically (count high enough to cover both runs' legs)
+    with robustness.FAULTS.armed("replica_error", count=1000):
+        base = _canon(fe.search("t", req))
+        ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                            groups=32)
+        owner.die = True  # member 0's process is gone
+        t0 = time.perf_counter()
+        got = _canon(fe.search("t", req))
+        wall = time.perf_counter() - t0
+    assert got == base
+    assert owner.calls >= 1  # the owner WAS tried first
+    assert wall < 30.0
+
+
+def test_chaos_wedged_owner_breaker_to_host_route(tmp_path,
+                                                  _clean_ownership):
+    """A wedged owner: its device dispatches hang, the watchdog faults
+    them, the breaker opens, and every owned group degrades to the host
+    route — byte-identical to the ownership-disabled uninjected run and
+    bounded by the watchdog, with device_dispatch_hang armed."""
+    from tempo_tpu.search import ownership
+
+    db = _mkdb(tmp_path, n_blocks=6, search_max_batch_pages=8)
+    req = _req(limit=10_000)
+    base = _canon(db.search("t", req).response())
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=32)
+    robustness.BREAKER.reset()
+    robustness.GUARD.timeout_s = 0.3
+    with robustness.FAULTS.armed("device_dispatch_hang", delay_s=5.0,
+                                 count=1000):
+        t0 = time.perf_counter()
+        got = _canon(db.search("t", req).response())
+        wall = time.perf_counter() - t0
+    assert got == base
+    assert wall < 10.0  # watchdog-bounded, never the 5s hang per group
+    # the wedge tripped the breaker; the non-owner share host-routed
+    assert robustness.BREAKER.snapshot()["faults_in_window"] >= 1
+    # and with the breaker now open: still byte-identical, zero device
+    for _ in range(3):
+        robustness.BREAKER.record_fault("timeout")
+    assert robustness.BREAKER.state == OPEN
+    assert _canon(db.search("t", req).response()) == base
+
+
+def test_chaos_rebalance_under_load_4way(tmp_path, _clean_ownership):
+    """Rebalance under load: 4 concurrent searchers while membership
+    flips repeatedly — every answer byte-identical to the
+    ownership-disabled path, deferred evictions keep the HBM accounting
+    non-negative, and nothing hangs."""
+    import threading
+
+    from tempo_tpu.search import ownership
+
+    db = _mkdb(tmp_path, n_blocks=6, search_max_batch_pages=8)
+    reqs = []
+    for i in range(4):
+        r = tempopb.SearchRequest()
+        r.tags["service.name"] = f"svc-{i:02d}"
+        r.limit = 10_000
+        reqs.append(r)
+    serial = [_canon(db.search("t", r).response()) for r in reqs]
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=32)
+    stop = threading.Event()
+    errors: list = []
+
+    def searcher(i):
+        while not stop.is_set():
+            try:
+                got = _canon(db.search("t", reqs[i]).response())
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+                return
+            if got != serial[i]:
+                errors.append(AssertionError(
+                    f"query {i} diverged mid-rebalance"))
+                return
+
+    ts = [threading.Thread(target=searcher, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    memberships = (["m0"], ["m0", "m1"], ["m0", "m1", "m2"],
+                   ["m1", "m0"], ["m0", "m1"])
+    for round_ in range(3):
+        for ms in memberships:
+            db.rebalance_ownership(list(ms), self_id="m0",
+                                   prestage=False)
+            time.sleep(0.02)
+    stop.set()
+    for t in ts:
+        t.join(timeout=60)
+        assert not t.is_alive(), "searcher hung across rebalances"
+    assert not errors, errors[:1]
+    # accounting survived the churn: totals never went negative and a
+    # final unpinned sweep leaves a consistent cache
+    b = db.batcher
+    with b._lock:
+        b._run_deferred_evictions_locked()
+        assert b._cache_total >= 0
+        assert b._cache_total == sum(e.nbytes for e in b._cache.values())
+
+
 # ----------------------------------------------------------- docs drift
 
 

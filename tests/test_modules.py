@@ -545,7 +545,14 @@ def test_flush_backoff_and_sibling_isolation(tmp_path):
     c = inst.completing[0]
     assert c.backoff_s == inst.FLUSH_BACKOFF_S and c.retry_at > 0
 
-    # within the backoff window the block is skipped, not hot-looped
+    # within the backoff window the block is skipped, not hot-looped.
+    # Pin the window open first: the real 0.05s window can elapse
+    # between the sweep above and this call on a loaded host, making
+    # complete_one RETRY (and raise) instead of skip — observed flaky
+    # under the full suite.
+    import time as _time
+
+    c.retry_at = _time.monotonic() + 60.0
     assert inst.complete_one() is None
 
     # repeated failures double the backoff up to the cap

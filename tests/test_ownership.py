@@ -1,0 +1,456 @@
+"""Owner-routed HBM (ISSUE 11): the ownership map's placement contract,
+the batcher's non-owner host route + rebalance eviction semantics, the
+frontend's owner routing, and the disabled-path noop.
+
+Placement cross-checks (the dedup-consistent-hashing satellite): the
+shared jump hash and the ring-derived owner table must both be STABLE
+under member add/remove — adding a member moves only the groups it
+takes, removing it restores the previous placement exactly.
+
+Byte-identity canon mirrors tests/test_faults.py: device_seconds is
+measured wall time and the device/host byte split moves with placement
+BY DESIGN, so identity is asserted on the canonical response."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tempo_tpu import robustness, tempopb
+from tempo_tpu.observability import metrics as obs
+from tempo_tpu.search import ownership
+from tempo_tpu.search.ownership import OWNERSHIP, OwnershipMap
+
+from test_faults import _canon, _mkdb, _req
+
+
+@pytest.fixture(autouse=True)
+def _clean_ownership():
+    """Every test starts (and leaves) with the layer factory-reset —
+    the map is process-wide like the breaker/profiler."""
+    OWNERSHIP.reset()
+    yield
+    OWNERSHIP.reset()
+
+
+# ------------------------------------------------------------ placement
+
+
+def test_shared_jump_hash_one_implementation():
+    """The netcache server selector and the ownership map consume ONE
+    jump-hash helper (utils.hashing) — the dedup satellite's contract."""
+    from tempo_tpu.backend import netcache
+    from tempo_tpu.utils import hashing
+
+    assert netcache.jump_hash is hashing.jump_hash
+
+
+def test_placement_spreads_and_is_deterministic():
+    a = OwnershipMap(n_groups=64)
+    a.set_members(["h0", "h1", "h2"])
+    b = OwnershipMap(n_groups=64)
+    b.set_members(["h0", "h1", "h2"])
+    # identical tables from the same member list on two "processes"
+    assert a._owners == b._owners
+    counts: dict = {}
+    for o in a._owners:
+        counts[o] = counts.get(o, 0) + 1
+    assert set(counts) == {"h0", "h1", "h2"}
+    # roughly even: nobody owns more than 60% of the groups
+    assert max(counts.values()) <= 64 * 0.6
+
+
+def test_placement_stable_under_member_add_remove():
+    """Adding a member moves ONLY the groups it takes; removing it
+    restores the previous placement exactly — the consistent-hash
+    stability cross-check for the ring-derived owner table."""
+    m = OwnershipMap(n_groups=64)
+    m.set_members(["h0", "h1", "h2"])
+    before = m._owners
+    gen1 = m.generation
+    moved = m.set_members(["h0", "h1", "h2", "h3"])
+    assert m.generation == gen1 + 1
+    after = m._owners
+    changed = [g for g in range(64) if before[g] != after[g]]
+    assert moved == len(changed)
+    assert 0 < moved < 64  # some movement, never a full reshuffle
+    # every moved group went TO the new member, none between old members
+    assert all(after[g] == "h3" for g in changed)
+    moved_back = m.set_members(["h0", "h1", "h2"])
+    assert moved_back == moved
+    assert m._owners == before
+
+
+def test_set_members_idempotent_no_generation_churn():
+    m = OwnershipMap()
+    m.set_members(["a", "b"], self_id="a")
+    gen = m.generation
+    assert m.set_members(["a", "b"]) == 0
+    assert m.generation == gen  # repeated configure() must not churn
+
+
+def test_jump_hash_minimal_movement_groups():
+    """The block -> placement-group step inherits jump-hash movement:
+    growing the group count only moves blocks INTO new groups."""
+    from tempo_tpu.utils.hashing import fnv1a_64, jump_hash
+
+    keys = [fnv1a_64(f"block-{i}".encode()) for i in range(2000)]
+    before = {k: jump_hash(k, 32) for k in keys}
+    after = {k: jump_hash(k, 48) for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    assert all(after[k] >= 32 for k in moved)
+    assert len(moved) < len(keys) * 0.5
+
+
+def test_disabled_is_permissive_and_cheap():
+    assert OWNERSHIP.enabled is False
+    assert OWNERSHIP.owns_group((("blk", 0, 4),)) is True
+    assert OWNERSHIP.owns_block("blk") is True
+    assert OWNERSHIP.owner_index("blk") is None
+
+
+def test_configure_auto_members_from_multihost_env(monkeypatch):
+    monkeypatch.setenv("TEMPO_NUM_PROCESSES", "4")
+    monkeypatch.setenv("TEMPO_PROCESS_ID", "2")
+    ownership.configure(enabled=True)
+    assert OWNERSHIP.members == tuple(f"host-{i}" for i in range(4))
+    assert OWNERSHIP.self_id == "host-2"
+
+
+def test_configure_groups_rebuilds_table():
+    ownership.configure(enabled=True, members="a,b", groups=16)
+    assert OWNERSHIP.n_groups == 16
+    assert len(OWNERSHIP._owners) == 16
+
+
+# ------------------------------------------------- serving-path routing
+
+
+def test_byte_identity_on_off_all_engine_paths(tmp_path):
+    """Ownership on vs off is byte-identical on the single-block,
+    batched, and coalesced paths — whether this member owns everything,
+    half, or nothing (a pure non-owner serves 100% host-routed)."""
+    db = _mkdb(tmp_path, n_blocks=6, search_max_batch_pages=8,
+               search_coalesce_window_s=0.02, search_coalesce_max_queries=4)
+    req = _req(limit=10_000)
+    base = _canon(db.search("t", req).response())
+
+    for self_id in ("m0", "m1", "spectator"):  # spectator owns nothing
+        ownership.configure(enabled=True, members="m0,m1",
+                            self_id=self_id, groups=32)
+        assert _canon(db.search("t", req).response()) == base, self_id
+        OWNERSHIP.reset()
+
+    # single-block path (BackendSearchBlock.search)
+    meta = db.blocklist.metas("t")[0]
+    bsb = db._search_block_for(meta)
+    sreq = _req(limit=10_000)
+    single_base = bsb.search(sreq).response().SerializeToString()
+    ownership.configure(enabled=True, members="m0,m1",
+                        self_id="spectator", groups=32)
+    before = obs.scan_dispatches.value(mode="host_fallback")
+    assert bsb.search(sreq).response().SerializeToString() == single_base
+    assert obs.scan_dispatches.value(mode="host_fallback") > before
+
+    # coalesced: concurrent same-tenant searches under ownership fuse /
+    # host-route per group and still match serial
+    reqs = []
+    for i in range(4):
+        r = tempopb.SearchRequest()
+        r.tags["service.name"] = f"svc-{i:02d}"
+        r.limit = 10_000
+        reqs.append(r)
+    OWNERSHIP.reset()
+    serial = [_canon(db.search("t", r).response()) for r in reqs]
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=32)
+    got = [None] * 4
+    barrier = threading.Barrier(4)
+
+    def worker(i):
+        barrier.wait()
+        got[i] = _canon(db.search("t", reqs[i]).response())
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert got == serial
+
+
+@pytest.mark.skipif("len(__import__('jax').devices()) < 2")
+def test_byte_identity_mesh_path(tmp_path):
+    """Ownership on/off identity with the batch sharded over the device
+    mesh (the dist kernel serving path)."""
+    db = _mkdb(tmp_path, n_blocks=4, auto_mesh=True)
+    req = _req(limit=10_000)
+    base = _canon(db.search("t", req).response())
+    ownership.configure(enabled=True, members="m0,m1", self_id="m1",
+                        groups=32)
+    assert _canon(db.search("t", req).response()) == base
+
+
+def test_non_owner_stages_nothing(tmp_path):
+    """A pure non-owner serves every group through the host route and
+    its HBM cache stays EMPTY — the no-duplicate-copy contract."""
+    db = _mkdb(tmp_path, n_blocks=4, search_max_batch_pages=8)
+    req = _req(limit=10_000)
+    ownership.configure(enabled=True, members="m0,m1",
+                        self_id="spectator", groups=32)
+    before_non = obs.hbm_owner_routed.value(route="non_owner_host")
+    r = db.search("t", req).response()
+    assert r.metrics.inspected_blocks == 4
+    assert not db.batcher._cache  # nothing staged to HBM
+    assert db.batcher._host_cache  # served from the host tier
+    assert obs.hbm_owner_routed.value(route="non_owner_host") > before_non
+
+
+def test_prewarm_skips_non_owned_groups(tmp_path):
+    db = _mkdb(tmp_path, n_blocks=4, search_max_batch_pages=8)
+    jobs = [db._scan_job(m) for m in db.blocklist.metas("t")]
+    groups = db.batcher.plan(jobs)
+    assert len(groups) >= 2
+    ownership.configure(enabled=True, members="m0,m1",
+                        self_id="spectator", groups=32)
+    assert db.batcher.prewarm(groups, warm_compile=False) == 0
+    assert not db.batcher._cache
+    OWNERSHIP.self_id = "m0"
+    owned = [g for g in groups
+             if OWNERSHIP.owns_group(tuple(j.key for j in g))]
+    staged = db.batcher.prewarm(groups, warm_compile=False)
+    assert staged == len(owned)
+    assert len(db.batcher._cache) == len(owned)
+
+
+# ------------------------------------------- rebalance + eviction shape
+
+
+def test_rebalance_drops_unowned_defers_pinned(tmp_path):
+    db = _mkdb(tmp_path, n_blocks=4, search_max_batch_pages=8)
+    req = _req(limit=10_000)
+    db.search("t", req)  # stage everything (ownership off)
+    b = db.batcher
+    assert b._cache
+    ownership.configure(enabled=True, members="m0,m1",
+                        self_id="spectator", groups=32)
+    # pin one batch (an in-flight search), leave the rest unpinned
+    with b._lock:
+        keys = list(b._cache)
+        pinned_key = keys[0]
+        b._cache[pinned_key].pins += 1
+    out = b.rebalance_ownership()
+    assert out["hbm_dropped"] == len(keys) - 1
+    assert out["hbm_deferred"] == 1
+    assert set(b._cache) == {pinned_key}
+    assert b._cache_total == b._cache[pinned_key].nbytes
+    # unpin: the deferred eviction runs exactly once
+    with b._lock:
+        b._cache[pinned_key].pins -= 1
+        b._run_deferred_evictions_locked()
+    assert not b._cache and b._cache_total == 0
+    assert not b._evict_deferred
+    # idempotent: a second sweep cannot double-subtract (the
+    # negative-bytes regression shape)
+    with b._lock:
+        b._run_deferred_evictions_locked()
+        b._evict_hbm_locked()
+    assert b._cache_total == 0
+
+
+def test_deferred_eviction_stale_marker_never_double_evicts(tmp_path):
+    """An ownership deferral and an LRU eviction targeting the SAME
+    batch must evict once: after the LRU (or a re-stage) got there
+    first, the stale marker is discarded by entry identity — the budget
+    never goes negative and a fresh batch under the same key
+    survives."""
+    db = _mkdb(tmp_path, n_blocks=4, search_max_batch_pages=8)
+    req = _req(limit=10_000)
+    db.search("t", req)
+    b = db.batcher
+    ownership.configure(enabled=True, members="m0,m1",
+                        self_id="spectator", groups=32)
+    with b._lock:
+        gkey = next(iter(b._cache))
+        entry = b._cache[gkey]
+        entry.pins += 1
+    b.rebalance_ownership()
+    assert gkey in b._evict_deferred
+    # unpin, then an LRU eviction claims the batch BEFORE the sweep
+    with b._lock:
+        entry.pins -= 1
+        b._drop_hbm_locked(gkey)
+        total_after_lru = b._cache_total
+        b._run_deferred_evictions_locked()  # stale marker: must no-op
+    assert b._cache_total == total_after_lru >= 0
+    assert gkey not in b._evict_deferred
+    # a fresh batch re-staged under the same key is NOT a victim of the
+    # old marker either
+    OWNERSHIP.reset()
+    db.search("t", req)  # re-stages (ownership off)
+    with b._lock:
+        assert b._cache_total >= 0
+        b._run_deferred_evictions_locked()
+    assert b._cache_total >= 0
+
+
+def test_tempodb_rebalance_prestages_new_groups(tmp_path):
+    db = _mkdb(tmp_path, n_blocks=4, search_max_batch_pages=8)
+    req = _req(limit=10_000)
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=32)
+    db.search("t", req)  # warm the jobs cache + stage owned groups
+    owned_before = len(db.batcher._cache)
+    # m1 leaves: m0 now owns everything; prestage runs in background
+    out = db.rebalance_ownership(["m0"], self_id="m0", prestage=True)
+    assert out["generation"] == OWNERSHIP.generation
+    assert out["moved_groups"] > 0
+    deadline = __import__("time").time() + 30
+    jobs = [db._scan_job(m) for m in db.blocklist.metas("t")]
+    n_groups = len(db.batcher.plan(jobs))
+    while __import__("time").time() < deadline:
+        if len(db.batcher._cache) >= n_groups:
+            break
+        __import__("time").sleep(0.05)
+    assert len(db.batcher._cache) >= max(owned_before, n_groups)
+    assert _canon(db.search("t", req).response())  # still serves
+
+
+# ------------------------------------------------------- frontend layer
+
+
+class _RecordingQuerier:
+    """Wraps a real Querier; records routed block ids and can play a
+    dead owner (raise on search_blocks)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.db = inner.db
+        self.die = False
+        self.block_batches: list = []
+
+    def search_recent(self, tenant, req):
+        return self.inner.search_recent(tenant, req)
+
+    def search_blocks(self, breq):
+        self.block_batches.append([j.block_id for j in breq.jobs])
+        if self.die:
+            raise RuntimeError("owner died")
+        return self.inner.search_blocks(breq)
+
+
+def _frontend(tmp_path, n_blocks=6):
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.modules.ring import Ring
+
+    db = _mkdb(tmp_path, n_blocks=n_blocks, search_max_batch_pages=8)
+    q = Querier(db, Ring(), {})
+    proxies = [_RecordingQuerier(q), _RecordingQuerier(q)]
+    fe = QueryFrontend(proxies, FrontendConfig(retries=3))
+    return db, proxies, fe
+
+
+def test_frontend_routes_batches_to_owner(tmp_path):
+    db, proxies, fe = _frontend(tmp_path)
+    req = _req(limit=10_000)
+    base = _canon(fe.search("t", req))
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=32)
+    for p in proxies:
+        p.block_batches.clear()
+    got = _canon(fe.search("t", req))
+    assert got == base
+    # every batch a querier received is owned (first attempt) by the
+    # member that maps to it — owner-pure batches, owner-routed
+    routed = 0
+    for qi, p in enumerate(proxies):
+        for batch in p.block_batches:
+            owners = {OWNERSHIP.owner_index(b) for b in batch}
+            assert len(owners) == 1, "batch mixes owners"
+            assert owners == {qi}
+            routed += 1
+    assert routed >= 1
+    # each member that owns any block served at least one batch
+    owners_present = {OWNERSHIP.owner_index(m.block_id)
+                      for m in db.blocklist.metas("t")}
+    for qi in owners_present:
+        assert proxies[qi].block_batches, f"owner {qi} never routed to"
+
+
+def test_frontend_owner_death_degrades_to_peer(tmp_path):
+    """Owner death: the first attempt fails, the retry lands on the
+    round-robin pool and the answer stays byte-identical — the peer is
+    a non-owner, so it serves the host route, never a duplicate
+    stage."""
+    db, proxies, fe = _frontend(tmp_path)
+    req = _req(limit=10_000)
+    base = _canon(fe.search("t", req))
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=32)
+    proxies[0].die = True  # member 0's querier is gone
+    got = _canon(fe.search("t", req))
+    assert got == base
+    assert not db.batcher._cache or True  # serving path decided per self
+
+
+def test_frontend_batch_plan_rekeys_on_generation(tmp_path):
+    db, proxies, fe = _frontend(tmp_path)
+    ownership.configure(enabled=True, members="m0,m1", groups=32)
+    b1 = fe._search_batches("t")
+    assert fe._search_batches("t") is b1  # memoized within a generation
+    OWNERSHIP.set_members(["m0", "m1", "m2"])
+    b2 = fe._search_batches("t")
+    assert b2 is not b1  # a rebalance invalidates the routing plan
+
+
+# ------------------------------------------------------------- surfaces
+
+
+def test_debug_ownership_snapshot_shape(tmp_path):
+    from tempo_tpu.api.http import HTTPApi
+
+    db = _mkdb(tmp_path, n_blocks=2)
+    db.search("t", _req())
+
+    class _App:
+        reader_db = db
+
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=16)
+    api = HTTPApi(_App(), debug_endpoints=True)
+    code, body = api._debug_ownership_route({})
+    assert code == 200
+    import json
+
+    doc = json.loads(json.dumps(body))
+    assert doc["enabled"] is True
+    assert doc["members"] == ["m0", "m1"]
+    assert len(doc["owners"]) == 16
+    assert isinstance(doc["residency"], list) and doc["residency"]
+    row = doc["residency"][0]
+    assert {"anchor_block", "placement_group", "owner", "owned",
+            "bytes", "pins", "deferred_evict"} <= set(row)
+
+
+def test_ownership_metrics_documented():
+    """The tempo_search_hbm_owner_* rows must stay in the observability
+    catalog (thin wrapper over the drift engine, like the faultpoint
+    test)."""
+    from tempo_tpu.analysis.drift import catalog_findings
+
+    findings = [f for f in catalog_findings("metric-names")
+                if "hbm_owner" in f.message]
+    assert not findings, "\n".join(
+        f"{f.path}:{f.line}: {f.message}" for f in findings)
+
+
+def test_noop_contract_registered():
+    """The ownership gate rides the static noop-contract checker like
+    the planner/query-stats knobs."""
+    from tempo_tpu.analysis.contracts import GATED_FUNCTIONS, GUARDED_CALLS
+
+    knobs = {g.knob for g in GATED_FUNCTIONS}
+    assert "search_hbm_ownership_enabled" in knobs
+    assert any(r.receiver == "OWNERSHIP" for r in GUARDED_CALLS)
