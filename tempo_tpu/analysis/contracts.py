@@ -94,6 +94,15 @@ GATED_FUNCTIONS = (
     GatedFunction("tempo_tpu.search.ownership",
                   "OwnershipMap.owner_index", ("enabled",),
                   "search_hbm_ownership_enabled"),
+    # packed HBM residency: width planning and mask packing are the
+    # gate functions — disabled staging pays one attribute read and
+    # keeps the byte-identical legacy layout
+    GatedFunction("tempo_tpu.search.packing",
+                  "PackedResidency.plan_widths", ("enabled",),
+                  "search_packed_residency"),
+    GatedFunction("tempo_tpu.search.packing",
+                  "PackedResidency.pack_hits", ("enabled",),
+                  "search_packed_residency"),
 )
 
 GUARDED_CALLS = (
@@ -107,6 +116,10 @@ GUARDED_CALLS = (
     # gate read — the disabled serving path never enters the map
     GuardedCall("OWNERSHIP", ("owns_group",), (), "enabled", "OWNERSHIP",
                 "search_hbm_ownership_enabled"),
+    # staging-site packing calls likewise: the disabled path must not
+    # even compute the width-planner inputs (duration rollup maxes)
+    GuardedCall("PACKING", ("plan_widths", "pack_hits"), (), "enabled",
+                "PACKING", "search_packed_residency"),
 )
 
 

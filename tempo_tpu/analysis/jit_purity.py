@@ -43,6 +43,27 @@ _SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
 _CLOCK_MODS = {"time", "_time"}
 _NP_NAMES = {"np", "numpy"}
 _NP_HOST_FNS = {"asarray", "array", "frombuffer", "copy"}
+# packed-residency width-descriptor parameter names (search/packing.py
+# unpack helpers + the kernels' `widths` static): a descriptor decides
+# SHAPES and branch structure at trace time, so a tracer reaching one
+# is a guaranteed ConcretizationTypeError — and a non-static python
+# value would silently retrace per distinct value. The rule only fires
+# for helpers that actually BRANCH on the parameter (descriptor
+# dispatchers) — a numeric parameter that merely shares a name
+# (`def weighted(x, w)`) is ordinary traced data, not a descriptor.
+_DESCRIPTOR_PARAMS = {"w", "dw", "widths"}
+
+
+def _branches_on_param(helper: ast.AST, param: str) -> bool:
+    """Does the helper's body test `param` in an if/while condition (or
+    compare it / call methods on it inside one)? That is the descriptor-
+    dispatcher shape the taint rule exists for."""
+    for node in ast.walk(helper):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            for n in ast.walk(node.test):
+                if isinstance(n, ast.Name) and n.id == param:
+                    return True
+    return False
 
 
 @dataclass
@@ -316,6 +337,32 @@ class JitPurityChecker(Checker):
                     callee = self._resolve_helper(pkg, mod, fn.id)
                     if callee is not None:
                         helper_mod, helper_qual, helper_node = callee
+                        # width descriptors must be STATIC: a helper
+                        # whose descriptor param receives tracer data
+                        # would branch on it at trace time (the packed-
+                        # residency unpack helpers all do; helpers that
+                        # never branch on the name are exempt)
+                        hp = _params(helper_node)
+                        bad = [
+                            hp[i] for i, a in enumerate(node.args)
+                            if i < len(hp) and hp[i] in _DESCRIPTOR_PARAMS
+                            and expr_tainted(a)
+                            and _branches_on_param(helper_node, hp[i])
+                        ] + [
+                            kw.arg for kw in node.keywords
+                            if kw.arg in _DESCRIPTOR_PARAMS
+                            and expr_tainted(kw.value)
+                            and _branches_on_param(helper_node, kw.arg)
+                        ]
+                        for p in bad:
+                            flag(node, "descriptor-taint",
+                                 f"passes tracer data as width "
+                                 f"descriptor {p!r} of {fn.id}() — "
+                                 "descriptors select shapes/branches "
+                                 "at trace time and must be static",
+                                 "thread the descriptor through "
+                                 "static_argnames (the `widths` jit "
+                                 "static) instead of a traced value")
                         statics = self._classify_call(helper_node, node,
                                                       expr_tainted)
                         self._check_kernel(

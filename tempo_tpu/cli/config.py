@@ -116,6 +116,16 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "search_offload_planner_ewma", 0.25),
         search_offload_planner_ring=storage.get(
             "search_offload_planner_ring", 256),
+        # packed HBM residency (docs/search-packed-residency.md):
+        # bit-width-adaptive staged columns + in-kernel unpack; false
+        # (default) is a true noop and byte-identical either way
+        search_packed_residency=storage.get(
+            "search_packed_residency", False),
+        # persistent XLA compile cache for the search kernels
+        # (docs/search-packed-residency.md#persistent-compile-cache);
+        # empty = off, hits surface as jit_cache_events{result=persisted}
+        search_compile_cache_dir=storage.get(
+            "search_compile_cache_dir", ""),
         # owner-routed HBM (docs/search-hbm-ownership.md): consistent-
         # hash block-group ownership across the fleet; false (default)
         # is a true noop, members/self auto-derive from the multihost

@@ -123,6 +123,25 @@ class TempoDBConfig:
     # rebalance unit): more groups = finer rebalance granularity at a
     # larger /debug/ownership map
     search_hbm_ownership_groups: int = 64
+    # packed HBM residency (search/packing.py,
+    # docs/search-packed-residency.md): staged value-id columns narrow
+    # to the width the per-block dictionary cardinality allows (4-bit/
+    # uint8/uint16/uint32 codes), durations quantize to uint16 buckets
+    # with an exact residual check at bucket boundaries, and device-
+    # probe hit masks bit-pack to uint32 words — kernels unpack
+    # in-register (the width descriptor is part of the jit shape key),
+    # so ~2x more blocks fit a given HBM budget at byte-identical
+    # results. False (default) is a true noop: one attribute read per
+    # staging site, byte-identical layout and results.
+    search_packed_residency: bool = False
+    # persistent XLA compilation cache directory for the SEARCH kernels
+    # (jax_compilation_cache_dir): a cold process replays first-seen-
+    # shape compiles from disk instead of re-paying XLA. Empty
+    # (default) = off. Hits surface as jit_cache_events{result=
+    # persisted}. (host_state_dir's auto mode already wires this for
+    # full TempoDB deployments; this knob reaches the same machinery
+    # without the rest of host state.)
+    search_compile_cache_dir: str = ""
     # stage + compile-warm hot batches in the background after each poll
     # so the first query pays neither (off by default: polls in tests and
     # write-only processes must not spin up device work)
@@ -298,6 +317,11 @@ class TempoDB:
         _planner.configure(enabled=self.cfg.search_offload_planner_enabled,
                            alpha=self.cfg.search_offload_planner_ewma,
                            ring_size=self.cfg.search_offload_planner_ring)
+        # packed HBM residency: process-wide gate like the layers above
+        # (docs/search-packed-residency.md)
+        from tempo_tpu.search import packing as _packing
+
+        _packing.configure(enabled=self.cfg.search_packed_residency)
         # owner-routed HBM placement: process-wide like the layers above
         # (docs/search-hbm-ownership.md)
         from tempo_tpu.search import ownership as _ownership
@@ -341,6 +365,14 @@ class TempoDB:
         # (search_blocks)
         self._breq_jobs_cache = BoundedCache(32)
         self._search_lock = threading.Lock()
+        # explicit search-kernel compile cache (search_compile_cache_dir):
+        # applied BEFORE the host-state auto wiring below so an
+        # operator's explicit location wins (enable_compile_cache keeps
+        # the first configured dir)
+        if self.cfg.search_compile_cache_dir:
+            from tempo_tpu.utils.jaxenv import enable_compile_cache
+
+            enable_compile_cache(self.cfg.search_compile_cache_dir)
         # restartable host state: header snapshot + persistent XLA
         # compile cache. Auto default lives under the WAL dir — per-node
         # durable storage that already must survive restarts. The

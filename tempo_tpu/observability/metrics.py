@@ -357,6 +357,14 @@ host_cache_bytes = Gauge("tempo_search_host_cache_bytes",
 probe_dict_bytes = Gauge("tempo_search_probe_dict_bytes",
                          "HBM held by staged device-probe dictionaries "
                          "across resident batches (bytes)")
+hbm_logical_bytes = Gauge("tempo_search_hbm_logical_bytes",
+                          "unpacked-layout equivalent of the staged-batch "
+                          "HBM occupancy — equals tempo_search_hbm_cache_"
+                          "bytes unless search_packed_residency narrows "
+                          "the resident columns")
+host_logical_bytes = Gauge("tempo_search_host_logical_bytes",
+                           "unpacked-layout equivalent of the host-RAM "
+                           "stacked-batch tier occupancy")
 coalesce_pending = Gauge("tempo_search_coalesce_pending_queries",
                          "queries parked in coalescing windows right now "
                          "(the coalescer queue depth)")

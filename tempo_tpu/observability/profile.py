@@ -465,6 +465,37 @@ def configure(enabled: bool | None = None, fence: bool | None = None,
     return PROFILER
 
 
+_persist_watch_registered = False
+
+
+def watch_persistent_compile_cache() -> bool:
+    """Register a jax.monitoring listener that books every persistent-
+    compilation-cache HIT as jit_cache_events{result=persisted} — the
+    operator-visible proof that a cold process is replaying first-seen-
+    shape compiles from disk (utils.jaxenv.enable_compile_cache wires
+    the cache itself; TempoDBConfig.search_compile_cache_dir /
+    host_state_dir turn it on). Idempotent; returns False when the
+    running jax build lacks the monitoring hooks."""
+    global _persist_watch_registered
+    if _persist_watch_registered:
+        return True
+    try:
+        from jax import monitoring as _monitoring
+
+        def _on_event(event: str, **kw) -> None:
+            # jax 0.4.x records '/jax/compilation_cache/cache_hits'
+            # per retrieval; match loosely so minor renames keep the
+            # signal rather than silently zeroing it
+            if "compilation_cache" in event and "hit" in event:
+                obs.jit_cache_events.inc(result="persisted")
+
+        _monitoring.register_event_listener(_on_event)
+    except Exception:  # noqa: BLE001 — observability extra, never fatal
+        return False
+    _persist_watch_registered = True
+    return True
+
+
 def dispatch(mode: str):
     """Module-level convenience mirroring tracing.start_span."""
     return PROFILER.dispatch(mode)

@@ -45,6 +45,9 @@ class ShardedPages:
     # offload planner enabled, its cost model — which charges the mesh
     # probe's all_gather/collective overhead) at staging time
     staged_dict: object = None
+    # packed-residency width descriptor (search/packing.py): static per
+    # staged block, part of the dist kernel's jit shape key
+    widths: tuple | None = None
 
 
 class DistributedScanEngine:
@@ -81,10 +84,21 @@ class DistributedScanEngine:
         from tempo_tpu.observability import profile
         from tempo_tpu.search.engine import stage_block_dict
 
+        from tempo_tpu.search import packing
+
         n = self.n_shards
         B = -(-pages.n_pages // n) * n
         spec = NamedSharding(self.mesh, P(SCAN_AXIS))
         host = pad_page_axis(pages, B)
+        widths = None
+        if packing.PACKING.enabled:
+            # packed residency: the sharded staging packs the same
+            # per-column widths the single-block stage would choose
+            widths = packing.PACKING.plan_widths(
+                len(pages.key_dict), len(pages.val_dict),
+                pages.max_dur_ms())
+            if widths is not None:
+                host = packing.pack_columns(host, widths)
         t0 = time.perf_counter()
         dev = {name: jax.device_put(arr, spec)
                for name, arr in host.items()}
@@ -94,25 +108,29 @@ class DistributedScanEngine:
         sd = stage_block_dict(pages, self.probe_min_vals,
                               n_shards=self.n_shards, mesh=self.mesh)
         return ShardedPages(device=dev, n_pages=pages.n_pages, pages=pages,
-                            staged_dict=sd)
+                            staged_dict=sd, widths=widths)
 
     # ---- kernel ----
 
-    @functools.partial(jax.jit, static_argnames=("self", "n_terms", "top_k"))
+    @functools.partial(jax.jit, static_argnames=("self", "n_terms",
+                                                 "top_k", "widths"))
     def _dist_kernel(self, kv_key, kv_val, entry_start, entry_end,
                      entry_dur, entry_valid, term_keys, val_ranges,
                      dur_lo, dur_hi, win_start, win_end, val_hits=None,
-                     *, n_terms: int, top_k: int):
+                     entry_dur_res=None,
+                     *, n_terms: int, top_k: int, widths=None):
         E = entry_valid.shape[1]
         local_flat = kv_key.shape[0] // self.n_shards * E
 
         def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                      entry_valid, term_keys, val_ranges,
-                     dur_lo, dur_hi, win_start, win_end, val_hits):
+                     dur_lo, dur_hi, win_start, win_end, val_hits,
+                     entry_dur_res):
             mask = entry_match_mask(
                 kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, term_keys, val_ranges, dur_lo, dur_hi,
                 win_start, win_end, n_terms=n_terms, val_hits=val_hits,
+                entry_dur_res=entry_dur_res, widths=widths,
             )
             local_count = jnp.sum(mask, dtype=jnp.int32)
             local_inspected = jnp.sum(entry_valid, dtype=jnp.int32)
@@ -134,17 +152,18 @@ class DistributedScanEngine:
         return shard_map_compat(
             shard_fn, mesh=self.mesh,
             # val_hits (the device-probe hit mask) replicates like the
-            # other predicate tables; a None leaf makes its spec a no-op
+            # other predicate tables; a None leaf makes its spec a no-op;
+            # the packed-duration residual shards with the page axis
             in_specs=(P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS),
                       P(SCAN_AXIS), P(SCAN_AXIS),
-                      P(), P(), P(), P(), P(), P(), P()),
+                      P(), P(), P(), P(), P(), P(), P(), P(SCAN_AXIS)),
             out_specs=(P(), P(), P(), P()),
             # all_gather+top_k yields identical values on every shard, but
             # the replication checker can't infer it through the gather
             check=False,
         )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
           term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end,
-          val_hits)
+          val_hits, entry_dur_res)
 
     # ---- public API ----
 
@@ -166,10 +185,12 @@ class DistributedScanEngine:
             with rec.stage("build"):
                 tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(cq)
             vh = getattr(cq, "val_hits", None)
+            widths = getattr(sp, "widths", None)
             miss = rec.compile_check(
                 ("dist", d["kv_key"].shape, str(d["kv_key"].dtype),
                  str(d["kv_val"].dtype), vr.shape,
-                 None if vh is None else tuple(vh.shape), cq.n_terms, k))
+                 None if vh is None else (tuple(vh.shape), str(vh.dtype)),
+                 widths, cq.n_terms, k))
             from tempo_tpu.parallel.mesh import locked_collective
 
             # process-wide collective-ordering lock (parallel.mesh):
@@ -184,7 +205,8 @@ class DistributedScanEngine:
                         d["entry_start"], d["entry_end"], d["entry_dur"],
                         d["entry_valid"],
                         tk, vr, dlo, dhi, ws, we, vh,
-                        n_terms=cq.n_terms, top_k=k,
+                        d.get("entry_dur_res"),
+                        n_terms=cq.n_terms, top_k=k, widths=widths,
                     )
             # fence after releasing the collective lock: a fenced wait
             # under dispatch_lock would stall every other mesh dispatch
@@ -197,7 +219,10 @@ class DistributedScanEngine:
             with rec.stage("d2h"):
                 res = fetch_scan_out(out)
             rec.add_bytes(d2h=res[2].nbytes + res[3].nbytes + 8)
-            rec.set(n_pages=sp.n_pages, shards=self.n_shards)
+            # scan_bytes: the planner's per-byte scan-rate feed (physical
+            # staged bytes this dispatch read — packed when packing is on)
+            rec.set(n_pages=sp.n_pages, shards=self.n_shards,
+                    scan_bytes=sum(int(a.nbytes) for a in d.values()))
         return res
 
     def scan(self, pages: ColumnarPages, cq: CompiledQuery):

@@ -92,7 +92,12 @@ def host_scan(host, mq, top_k: int):
             jnp.uint32(mq.dur_lo), jnp.uint32(min(mq.dur_hi, 0xFFFFFFFF)),
             jnp.uint32(mq.win_start),
             jnp.uint32(min(mq.win_end, 0xFFFFFFFF)),
-            None, None, n_terms=mq.n_terms, top_k=top_k)
+            None, None, dev.get("entry_dur_res"),
+            n_terms=mq.n_terms, top_k=top_k,
+            # the host tier stages the SAME packed layout (stack_host
+            # packs before the tiers fork), so the fallback kernel
+            # unpacks with the batch's own width descriptor
+            widths=getattr(host, "widths", None))
         count, inspected, scores, idx = out
         res = (int(count), int(inspected), np.asarray(scores),
                np.asarray(idx))
@@ -125,6 +130,10 @@ class ScanJob:
 class _CachedBatch:
     batch: object           # multiblock.BlockBatch
     nbytes: int
+    # unpacked-layout equivalent of nbytes (the logical side of the
+    # packed-residency accounting split; == nbytes when packing is off).
+    # Fixed at stage time so add/remove stay symmetric.
+    logical: int = 0
     jobs: list = field(default_factory=list)
     # per-query memo: everything O(group-size) that depends only on the
     # request's predicate (header prune, per-block compile tables, metric
@@ -496,6 +505,12 @@ class BlockBatcher:
         self._cache: OrderedDict[tuple, _CachedBatch] = OrderedDict()
         self._cache_total = 0
         self._probe_dict_total = 0  # staged-dict bytes across _cache
+        # logical (unpacked-layout) bytes across both tiers — the other
+        # half of the packed-residency accounting split: budgets charge
+        # PHYSICAL bytes (that is why packing fits more blocks), the
+        # logical gauges say how much unpacked data those bytes carry
+        self._cache_logical = 0
+        self._host_logical = 0
         # host-RAM tier between the object store and HBM: stacked numpy
         # batches, byte-budgeted separately. An HBM eviction leaves the
         # host copy, so re-staging an evicted batch is one H2D copy, not
@@ -605,6 +620,8 @@ class BlockBatcher:
         obs.hbm_cache_bytes.set(self._cache_total)
         obs.host_cache_bytes.set(self._host_total)
         obs.probe_dict_bytes.set(self._probe_dict_total)
+        obs.hbm_logical_bytes.set(self._cache_logical)
+        obs.host_logical_bytes.set(self._host_logical)
 
     def _evict_host_locked(self) -> None:
         """LRU-evict host-tier batches until the budget holds — caller
@@ -614,6 +631,7 @@ class BlockBatcher:
                and len(self._host_cache) > 1):
             k, oldh = self._host_cache.popitem(last=False)
             self._host_total -= oldh.nbytes
+            self._host_logical -= oldh.logical_nbytes
             self._host_total -= self._cpu_staged_bytes.pop(k, 0)
             obs.batch_cache_events.inc(result="host_evict")
 
@@ -627,6 +645,7 @@ class BlockBatcher:
         if old is None:
             return
         self._cache_total -= old.nbytes
+        self._cache_logical -= old.logical
         self._probe_dict_total -= self._dict_bytes(old.batch)
         obs.batch_cache_events.inc(result="evict")
 
@@ -748,16 +767,21 @@ class BlockBatcher:
                 "h2d", lambda: self.engine.place(host))
             # batch.nbytes covers the stacked page arrays AND any staged
             # probe dictionaries — both live in HBM under this budget
+            # (physical/packed bytes; the logical twin feeds the gauges)
             nbytes = int(batch.nbytes)
-            entry = _CachedBatch(batch=batch, nbytes=nbytes, jobs=list(group))
+            entry = _CachedBatch(batch=batch, nbytes=nbytes,
+                                 logical=int(batch.logical_nbytes),
+                                 jobs=list(group))
             with self._lock:
                 obs.batch_cache_events.inc(result="miss")
                 prev = self._cache.pop(key, None)
                 if prev is not None:
                     self._cache_total -= prev.nbytes
+                    self._cache_logical -= prev.logical
                     self._probe_dict_total -= self._dict_bytes(prev.batch)
                 self._cache[key] = entry
                 self._cache_total += nbytes
+                self._cache_logical += entry.logical
                 self._probe_dict_total += self._dict_bytes(batch)
                 self._evict_hbm_locked()
             return entry
@@ -790,6 +814,7 @@ class BlockBatcher:
             with self._lock:
                 self._host_cache[key] = host
                 self._host_total += host.nbytes
+                self._host_logical += host.logical_nbytes
                 self._evict_host_locked()
                 self._publish_gauges_locked()
             obs.batch_cache_events.inc(result="host_miss")
@@ -833,6 +858,7 @@ class BlockBatcher:
             for k in dead:
                 old = self._cache.pop(k)
                 self._cache_total -= old.nbytes
+                self._cache_logical -= old.logical
                 self._probe_dict_total -= self._dict_bytes(old.batch)
                 # a pending rebalance deferral for a dead block's batch
                 # is satisfied by this removal — keeping the marker
@@ -841,7 +867,9 @@ class BlockBatcher:
             dead_h = [k for k in self._host_cache
                       if any(jk[0] not in live_block_ids for jk in k)]
             for k in dead_h:
-                self._host_total -= self._host_cache.pop(k).nbytes
+                oldh = self._host_cache.pop(k)
+                self._host_total -= oldh.nbytes
+                self._host_logical -= oldh.logical_nbytes
                 self._host_total -= self._cpu_staged_bytes.pop(k, 0)
             self._publish_gauges_locked()
 
@@ -901,11 +929,13 @@ class BlockBatcher:
 
         # dtypes are part of the jit cache key too: dictionary-size
         # narrowing means two same-shaped batches can carry int8 vs
-        # int16 kv columns and compile separately (code-review r5)
+        # int16 kv columns and compile separately (code-review r5);
+        # the packed-residency width descriptor likewise
         shape_sig = (cached.batch.device["entry_valid"].shape,
                      cached.batch.device["kv_key"].shape,
                      str(cached.batch.device["kv_key"].dtype),
                      str(cached.batch.device["kv_val"].dtype),
+                     cached.batch.widths,
                      len(cached.batch.blocks))
         with self._lock:
             if shape_sig in self._warmed_shapes:
@@ -1075,6 +1105,13 @@ class BlockBatcher:
                 qs.add_inspected(blocks=pre["inspected_blocks"],
                                  nbytes=pre["inspected_bytes"],
                                  placement="device")
+                # staged bytes this group's scan actually read, both
+                # sides of the packed-residency split (physical ==
+                # logical when packing is off)
+                b = cached.batch
+                qs.add_staged(b.device_nbytes,
+                              int(b.logical_device_nbytes
+                                  or b.device_nbytes))
             # harvest the uploaded per-query tables AFTER the dispatch
             # ran: under coalescing the flush (and its H2D upload) can
             # happen on the window-timer thread, after submit returned —
@@ -1251,6 +1288,9 @@ class BlockBatcher:
                     qs.add_inspected(blocks=pre["inspected_blocks"],
                                      nbytes=pre["inspected_bytes"],
                                      placement="host")
+                    qs.add_staged(host.cat_nbytes,
+                                  int(host.cat_logical_nbytes
+                                      or host.cat_nbytes))
                 for m in self.engine.results(host, mq, scores, idx):
                     results.add(m)
             finally:
@@ -1558,11 +1598,13 @@ class BlockBatcher:
                 "hbm_cache": {
                     "batches": len(self._cache),
                     "bytes": self._cache_total,
+                    "logical_bytes": self._cache_logical,
                     "budget_bytes": self.cache_bytes,
                 },
                 "host_cache": {
                     "batches": len(self._host_cache),
                     "bytes": self._host_total,
+                    "logical_bytes": self._host_logical,
                     "budget_bytes": self.host_cache_bytes,
                 },
                 "memo": {

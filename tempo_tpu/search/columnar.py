@@ -116,6 +116,19 @@ class ColumnarPages:
                 setattr(out, attr, cached)
         return out
 
+    def max_dur_ms(self) -> int:
+        """Upper bound on this container's durations — the packed-
+        residency width planner's input (search/packing.py). The build
+        records the exact max in the header; synthetic containers
+        without the rollup fall back to one memoized array scan."""
+        v = self.header.get("max_dur_ms")
+        if v is None:
+            v = getattr(self, "_max_dur_ms", None)
+            if v is None:
+                v = self._max_dur_ms = (int(self.entry_dur.max())
+                                        if self.entry_dur.size else 0)
+        return int(v)
+
     def packed_val_dict(self) -> tuple:
         """Cached (bytes, offsets) packing for the native substring scan
         (huge dictionaries — see pipeline.substring_value_ids)."""

@@ -394,5 +394,12 @@ def probe_value_hits(ddev: DeviceDict, needles: list[bytes]):
 
 def hits_to_ids(hits_row) -> np.ndarray:
     """Host-side view of one term's hit mask as a sorted id array — the
-    parity bridge to pipeline.substring_value_ids for tests/bench."""
-    return np.nonzero(np.asarray(hits_row))[0].astype(np.int32)
+    parity bridge to pipeline.substring_value_ids for tests/bench.
+    Accepts both mask formats: bool rows and the packed-residency
+    uint32 bit-words (search/packing.py)."""
+    a = np.asarray(hits_row)
+    if a.dtype == np.uint32:
+        from .packing import unpack_mask_words
+
+        a = unpack_mask_words(a, a.shape[-1] * 32)
+    return np.nonzero(a)[0].astype(np.int32)

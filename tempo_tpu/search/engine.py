@@ -41,6 +41,8 @@ from tempo_tpu.observability import profile
 
 from .columnar import ColumnarPages
 from .pipeline import CompiledQuery
+from . import packing
+from .packing import duration_ok, mask_select, unpack_ids
 
 DEFAULT_TOP_K = 128
 
@@ -57,6 +59,10 @@ class StagedPages:
     # runs the substring probe ON DEVICE (pipeline._device_probe_tags)
     # instead of the host memmem walk
     staged_dict: object = None
+    # packed-residency width descriptor (search/packing.py) — static
+    # per staged block, part of the scan kernel's jit shape key; None
+    # = the unpacked legacy layout
+    widths: tuple | None = None
 
 
 DEVICE_ARRAYS = ("kv_key", "kv_val", "entry_start", "entry_end",
@@ -119,13 +125,22 @@ def stage(pages: ColumnarPages, page_bucket: int | None = None,
     compilation just uses whatever was staged."""
     B = page_bucket or _bucket(pages.n_pages)
     host = pad_page_axis(pages, B)
+    widths = None
+    if packing.PACKING.enabled:
+        # packed residency: the single-block staging packs the SAME
+        # per-column widths the batched stack_host would choose for a
+        # one-block batch (search/packing.py)
+        widths = packing.PACKING.plan_widths(
+            len(pages.key_dict), len(pages.val_dict), pages.max_dur_ms())
+        if widths is not None:
+            host = packing.pack_columns(host, widths)
     t0 = time.perf_counter()
     dev = {k: jnp.asarray(v) for k, v in host.items()}
     profile.observe_stage("h2d", "single", time.perf_counter() - t0,
                           nbytes=sum(int(v.nbytes) for v in host.values()))
     sd = stage_block_dict(pages, probe_min_vals)
     return StagedPages(device=dev, n_pages=pages.n_pages, pages=pages,
-                       staged_dict=sd)
+                       staged_dict=sd, widths=widths)
 
 
 def stage_block_dict(pages: ColumnarPages, probe_min_vals: int | None,
@@ -159,7 +174,7 @@ def stage_block_dict(pages: ColumnarPages, probe_min_vals: int | None,
 def entry_match_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
                      entry_valid, term_keys, val_ranges,
                      dur_lo, dur_hi, win_start, win_end, *, n_terms: int,
-                     val_hits=None):
+                     val_hits=None, entry_dur_res=None, widths=None):
     """The core predicate: [P,E] bool mask of matching entries. Shared by
     the single-device kernel and the shard_map distributed kernel (each
     shard evaluates it over its local page slice).
@@ -172,27 +187,34 @@ def entry_match_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
     the membership test is a mask LOOKUP — one [P,E,C] gather per term —
     and the range tables are the never-match padding; the probe result
     never crossed the host boundary. (bench.py's high-cardinality phases
-    re-validate the lookup-vs-range tradeoff each round.)"""
+    re-validate the lookup-vs-range tradeoff each round.)
+
+    `widths` (STATIC at every call site) + `entry_dur_res`: the
+    packed-residency column descriptor (search/packing.py) — the kv
+    unpack runs inside the term body so the widening shifts/masks fuse
+    into the compares; no unpacked copy materializes in HBM."""
+    kw, vw, dw = widths if widths is not None else (None, None, None)
     mask = entry_valid
     if n_terms:
         def term_body(t, acc):
+            kk = unpack_ids(kv_key, kw)              # fused widen
+            vv = unpack_ids(kv_val, vw)
             k = term_keys[t]
-            keym = kv_key == k                       # [P,E,C]
+            keym = kk == k                           # [P,E,C]
             if val_hits is not None:
-                safe_v = jnp.maximum(kv_val, 0).astype(jnp.int32)
-                valm = val_hits[t][safe_v] & (kv_val >= 0)  # [P,E,C]
+                safe_v = jnp.maximum(vv, 0).astype(jnp.int32)
+                valm = mask_select(val_hits[t], safe_v) & (vv >= 0)
             else:
                 lo = val_ranges[t, :, 0]                 # [R]
                 hi = val_ranges[t, :, 1]
-                v = kv_val[..., None]                    # [P,E,C,1]
+                v = vv[..., None]                        # [P,E,C,1]
                 valm = ((v >= lo) & (v <= hi)).any(-1)   # [P,E,C], fused over R
             hit = jnp.any(keym & valm, axis=-1)      # [P,E] lane reduction
             return acc & hit
 
         mask = jax.lax.fori_loop(0, n_terms, term_body, mask)
 
-    dur = entry_dur.astype(jnp.uint32)
-    mask = mask & (dur >= dur_lo.astype(jnp.uint32)) & (dur <= dur_hi.astype(jnp.uint32))
+    mask = mask & duration_ok(entry_dur, entry_dur_res, dur_lo, dur_hi, dw)
     mask = mask & (entry_end.astype(jnp.uint32) >= win_start.astype(jnp.uint32))
     mask = mask & (entry_start.astype(jnp.uint32) <= win_end.astype(jnp.uint32))
     return mask
@@ -279,19 +301,22 @@ def masked_topk(mask, entry_start, top_k: int):
     return top_scores, top_idx.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("n_terms", "top_k"))
+@functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths"))
 def scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, term_keys, val_ranges, dur_lo, dur_hi,
-                win_start, win_end, val_hits=None,
-                *, n_terms: int, top_k: int):
+                win_start, win_end, val_hits=None, entry_dur_res=None,
+                *, n_terms: int, top_k: int, widths=None):
     """Returns (match_count i32, inspected i32, topk_scores i32 [k],
     topk_flat_idx i32 [k]) — flat index = page * E + entry. `val_hits`
-    (None or bool [T, v_pad]) selects the device-probe membership path;
-    jit treats None as pytree structure, so each variant compiles once."""
+    (None, bool [T, v_pad], or packed uint32 words) selects the
+    device-probe membership path; jit treats None as pytree structure,
+    so each variant compiles once. `widths` is the static packed-
+    residency descriptor (search/packing.py)."""
     mask = entry_match_mask(
         kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
         term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end,
-        n_terms=n_terms, val_hits=val_hits,
+        n_terms=n_terms, val_hits=val_hits, entry_dur_res=entry_dur_res,
+        widths=widths,
     )
     count = jnp.sum(mask, dtype=jnp.int32)
     inspected = jnp.sum(entry_valid, dtype=jnp.int32)
@@ -373,18 +398,20 @@ class ScanEngine:
         with _rec.stage("build"):
             tk, vr, dlo, dhi, ws, we = self.query_device_params(cq)
         vh = getattr(cq, "val_hits", None)
+        widths = getattr(sp, "widths", None)
         k = self._resolve_top_k(cq)
         miss = _rec.compile_check(
             ("scan_kernel", d["kv_key"].shape, str(d["kv_key"].dtype),
              str(d["kv_val"].dtype), vr.shape,
-             None if vh is None else tuple(vh.shape), cq.n_terms, k))
+             None if vh is None else (tuple(vh.shape), str(vh.dtype)),
+             widths, cq.n_terms, k))
         with _rec.stage("compile" if miss else "execute"):
             out = scan_kernel(
                 d["kv_key"], d["kv_val"],
                 d["entry_start"], d["entry_end"], d["entry_dur"],
                 d["entry_valid"],
-                tk, vr, dlo, dhi, ws, we, vh,
-                n_terms=cq.n_terms, top_k=k,
+                tk, vr, dlo, dhi, ws, we, vh, d.get("entry_dur_res"),
+                n_terms=cq.n_terms, top_k=k, widths=widths,
             )
             _rec.fence(out)
         return out
@@ -404,7 +431,11 @@ class ScanEngine:
             with rec.stage("d2h"):
                 res = fetch_scan_out(out)
             rec.add_bytes(d2h=res[2].nbytes + res[3].nbytes + 8)
-            rec.set(n_pages=sp.n_pages)
+            # scan_bytes feeds the planner's per-byte scan rate (physical
+            # staged bytes — packed when packed residency is on)
+            rec.set(n_pages=sp.n_pages,
+                    scan_bytes=sum(int(a.nbytes)
+                                   for a in sp.device.values()))
         return res
 
     def scan(self, pages: ColumnarPages, cq: CompiledQuery):

@@ -31,6 +31,8 @@ from tempo_tpu.observability import profile
 from .columnar import ColumnarPages
 from .dict_probe import _pow2
 from .engine import DEFAULT_TOP_K, masked_topk
+from . import packing
+from .packing import duration_ok, mask_select_grouped, unpack_ids
 from .pipeline import (
     CompiledQuery,
     compile_query,
@@ -55,18 +57,44 @@ class BlockBatch:
     # these instead of the host memmem walk. Staged with the batch,
     # accounted in `nbytes`, re-uploaded with it after an HBM eviction.
     staged_dicts: dict = field(default_factory=dict)
+    # packed-residency width descriptor (search/packing.py): static per
+    # batch, part of every consuming kernel's jit shape key; None = the
+    # unpacked legacy layout
+    widths: tuple | None = None
+    # what the unpacked layout would pin for these page arrays — the
+    # logical side of the physical/logical accounting split (equal to
+    # device_nbytes when widths is None)
+    logical_device_nbytes: int = 0
 
     @property
     def n_pages(self) -> int:
         return int(self.page_block.shape[0])
 
     @property
+    def device_nbytes(self) -> int:
+        """Physical HBM pinned by the stacked page arrays alone (packed
+        bytes when widths is set)."""
+        hit = getattr(self, "_device_nbytes", None)
+        if hit is None:
+            hit = self._device_nbytes = int(
+                sum(int(a.nbytes) for a in self.device.values()))
+        return hit
+
+    @property
     def nbytes(self) -> int:
         """HBM pinned by this batch: the stacked page arrays PLUS the
         staged dictionary arrays — the cache budget must see both or a
         high-cardinality tenant's dictionaries become unaccounted
-        residents."""
-        return (int(sum(int(a.nbytes) for a in self.device.values()))
+        residents. Physical (packed) bytes: that is what the budget
+        buys, and why packing fits ~2x more blocks per budget."""
+        return (self.device_nbytes
+                + int(sum(d.nbytes for d in self.staged_dicts.values())))
+
+    @property
+    def logical_nbytes(self) -> int:
+        """The unpacked-layout equivalent of `nbytes` (dictionaries are
+        already byte buffers — same on both sides of the split)."""
+        return (int(self.logical_device_nbytes or self.device_nbytes)
                 + int(sum(d.nbytes for d in self.staged_dicts.values())))
 
 
@@ -88,6 +116,25 @@ class HostBatch:
     # kept with the batch so an HBM-evicted batch re-uploads with one
     # H2D copy, not a re-pack of 10M strings
     packed_dicts: dict = field(default_factory=dict)
+    # packed-residency descriptor + logical bytes of the stacked copies
+    # (see BlockBatch) — the host tier stages the SAME packed format, so
+    # an HBM re-stage is one H2D put of the packed arrays and the
+    # host-fallback scan runs the packed kernel directly
+    widths: tuple | None = None
+    cat_logical_nbytes: int = 0
+
+    @property
+    def cat_nbytes(self) -> int:
+        """Physical bytes of the stacked copies alone (the H2D unit)."""
+        return int(sum(a.nbytes for a in self.cat.values()))
+
+    @property
+    def logical_nbytes(self) -> int:
+        """`nbytes` with the stacked copies at the unpacked layout —
+        the logical side of the host-tier accounting split."""
+        return int((self.cat_logical_nbytes or self.cat_nbytes)
+                   + sum(b.nbytes for b in self.blocks)
+                   + sum(d.nbytes for d in self.packed_dicts.values()))
 
     @property
     def nbytes(self) -> int:
@@ -166,7 +213,20 @@ def stack_host(blocks: list[ColumnarPages],
     path (MultiBlockEngine.stage_host) passes its configured
     threshold."""
     E = blocks[0].geometry.entries_per_page
-    C = max(b.geometry.kv_per_entry for b in blocks)
+    C = C0 = max(b.geometry.kv_per_entry for b in blocks)
+    n_keys = max(len(b.key_dict) for b in blocks)
+    n_vals = max(len(b.val_dict) for b in blocks)
+    # packed residency (search/packing.py): choose per-column storage
+    # widths from the recorded dictionary cardinalities + the duration
+    # rollup. Gate off = widths None = the legacy layout below,
+    # byte-identical, one attribute read.
+    widths = None
+    if packing.PACKING.enabled:
+        widths = packing.PACKING.plan_widths(
+            n_keys, n_vals, max(b.max_dur_ms() for b in blocks))
+        if widths is not None and "u4" in widths[:2] and C % 2:
+            C += 1  # nibble packing pairs slots; both kv columns must
+            # unpack to one slot count (extra slot is pad, never matches)
     # narrow the kv columns to the smallest dtype the dictionaries allow:
     # the kernel compares against int32 term tables with XLA promoting
     # inline (no widened copy materializes), so the RESIDENT format can
@@ -174,12 +234,14 @@ def stack_host(blocks: list[ColumnarPages],
     # HBM footprint and an evicted group's re-stage time (H2D-bound
     # through the axon relay at ~50 MB/s) shrink proportionally
     # (VERDICT r4 #2). Dtype chosen BEFORE stacking so concatenate
-    # produces the narrow array directly (no full-width transient).
+    # produces the narrow array directly (no full-width transient);
+    # packed widths likewise transform per block before stacking.
     def _narrow(n):
         return (np.int8 if n <= 127          # -1 sentinel stays in range
                 else np.int16 if n <= 32_767 else np.int32)
-    kv_dtype = {"kv_key": _narrow(max(len(b.key_dict) for b in blocks)),
-                "kv_val": _narrow(max(len(b.val_dict) for b in blocks))}
+    kv_dtype = {"kv_key": _narrow(n_keys), "kv_val": _narrow(n_vals)}
+    kv_width = None if widths is None else {"kv_key": widths[0],
+                                            "kv_val": widths[1]}
     arrays = {name: [] for name in ("kv_key", "kv_val", "entry_start",
                                     "entry_end", "entry_dur", "entry_valid")}
     page_block = []
@@ -193,33 +255,62 @@ def stack_host(blocks: list[ColumnarPages],
         for name in arrays:
             arr = getattr(b, name)
             if name in ("kv_key", "kv_val"):
-                arr = arr.astype(kv_dtype[name], copy=False)
-                if arr.shape[2] < C:
-                    pad = np.full((P, E, C - arr.shape[2]), -1,
-                                  dtype=kv_dtype[name])
-                    arr = np.concatenate([arr, pad], axis=2)
+                if kv_width is None:
+                    arr = arr.astype(kv_dtype[name], copy=False)
+                    if arr.shape[2] < C:
+                        pad = np.full((P, E, C - arr.shape[2]), -1,
+                                      dtype=kv_dtype[name])
+                        arr = np.concatenate([arr, pad], axis=2)
+                else:
+                    if arr.shape[2] < C:
+                        pad = np.full((P, E, C - arr.shape[2]), -1,
+                                      dtype=arr.dtype)
+                        arr = np.concatenate([arr, pad], axis=2)
+                    arr = packing.pack_ids_array(arr, kv_width[name])
             arrays[name].append(arr)
         page_block.extend([bi] * P)
         total += P
-    cat = {k: np.concatenate(v, axis=0) for k, v in arrays.items()}
+    if len(blocks) == 1 and not (pad_to and pad_to > total):
+        # single-block fast path: the block already matches the bucket
+        # shape, so the concatenate below would be a pure copy of every
+        # column — serve views of the (possibly just-transformed)
+        # arrays instead
+        cat = {k: v[0] for k, v in arrays.items()}
+    else:
+        cat = {k: np.concatenate(v, axis=0) for k, v in arrays.items()}
     page_block = np.asarray(page_block, dtype=np.int32)
+
+    if widths is not None:
+        # duration column: exact uint16, or uint16 buckets + residual
+        # (packing.pack_duration) — packed BEFORE page padding so the
+        # pad rows below are valid zero buckets
+        q, res = packing.pack_duration(cat["entry_dur"], widths[2])
+        cat["entry_dur"] = q
+        if res is not None:
+            cat["entry_dur_res"] = res
 
     if pad_to and pad_to > total:
         extra = pad_to - total
         for name, arr in cat.items():
             pad = np.zeros((extra,) + arr.shape[1:], dtype=arr.dtype)
-            if name in ("kv_key", "kv_val"):
-                pad -= 1
+            if name in ("kv_key", "kv_val") and widths is None:
+                pad -= 1  # packed layouts pad with code 0 (= id -1)
             cat[name] = np.concatenate([arr, pad], axis=0)
         page_block = np.concatenate([
             page_block, np.full(extra, -1, dtype=np.int32)
         ])
 
     cat["page_block"] = page_block
+    entries_padded = int(page_block.shape[0]) * E
     return HostBatch(cat=cat, page_block=page_block, blocks=blocks,
                      page_offset=page_offset,
                      packed_dicts=_pack_batch_dicts(blocks, probe_min_vals,
-                                                    n_shards=n_shards))
+                                                    n_shards=n_shards),
+                     widths=widths,
+                     cat_logical_nbytes=(
+                         packing.logical_nbytes(entries_padded, C0,
+                                                n_keys, n_vals)
+                         + int(page_block.nbytes)))
 
 
 def place_batch(host: HostBatch, sharding=None, mesh=None) -> BlockBatch:
@@ -265,7 +356,8 @@ def place_batch(host: HostBatch, sharding=None, mesh=None) -> BlockBatch:
         staged[fp] = dict_probe.place_device_dict(pd, mesh=dict_mesh)
     return BlockBatch(device=dev, page_block=host.page_block,
                       blocks=host.blocks, page_offset=host.page_offset,
-                      staged_dicts=staged)
+                      staged_dicts=staged, widths=host.widths,
+                      logical_device_nbytes=host.cat_logical_nbytes)
 
 
 def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None,
@@ -411,10 +503,17 @@ def compile_multi(blocks: list[ColumnarPages], req: tempopb.SearchRequest,
     val_hits = block_group = None
     if probe_fps:
         Tp = max(1, T)
-        Vm = max(int(compiled[fp].val_hits.shape[1]) for fp in probe_fps)
+        # one assembled mask table must be format-uniform: a compile-
+        # cache product minted before a packed-residency gate flip can
+        # still be bool while its peers are bit-packed words — pack the
+        # stragglers (cheap device op) rather than stacking mixed dtypes
+        hs = {fp: compiled[fp].val_hits for fp in probe_fps}
+        if any(packing.is_packed_mask(h) for h in hs.values()):
+            hs = {fp: packing.pack_mask_words(h) for fp, h in hs.items()}
+        Vm = max(int(h.shape[1]) for h in hs.values())
         padded = []
         for fp in probe_fps:
-            h = compiled[fp].val_hits
+            h = hs[fp]
             h = jnp.pad(h, ((0, Tp - h.shape[0]), (0, Vm - h.shape[1])))
             padded.append(h)
         val_hits = jnp.stack(padded)                       # [G, Tp, Vm]
@@ -505,9 +604,15 @@ def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
     val_hits = block_group = None
     if any(mq.val_hits is not None for mq in mqs):
         probed = [mq for mq in mqs if mq.val_hits is not None]
-        Gm = max(int(mq.val_hits.shape[0]) for mq in probed)
-        Vm = max(int(mq.val_hits.shape[2]) for mq in probed)
-        zero = jnp.zeros((Gm, T, Vm), dtype=jnp.bool_)
+        # format-uniform like compile_multi: members compiled across a
+        # packed-residency gate flip pack up before stacking
+        hits = {id(mq): mq.val_hits for mq in probed}
+        if any(packing.is_packed_mask(h) for h in hits.values()):
+            hits = {k: packing.pack_mask_words(h) for k, h in hits.items()}
+        Gm = max(int(h.shape[0]) for h in hits.values())
+        Vm = max(int(h.shape[2]) for h in hits.values())
+        dt = next(iter(hits.values())).dtype
+        zero = jnp.zeros((Gm, T, Vm), dtype=dt)
         block_group = np.full((Q, B), -1, dtype=np.int32)
         rows = []
         for qi in range(Q):
@@ -515,7 +620,7 @@ def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
             if mq is None or mq.val_hits is None:
                 rows.append(zero)
                 continue
-            h = mq.val_hits
+            h = hits[id(mq)]
             rows.append(jnp.pad(h, ((0, Gm - h.shape[0]),
                                     (0, T - h.shape[1]),
                                     (0, Vm - h.shape[2]))))
@@ -530,7 +635,8 @@ def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
 def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
                      entry_valid, page_block, term_keys, val_ranges,
                      dur_lo, dur_hi, win_start, win_end, *, n_terms: int,
-                     term_active=None, val_hits=None, block_group=None):
+                     term_active=None, val_hits=None, block_group=None,
+                     entry_dur_res=None, widths=None):
     """The multi-block predicate: [P,E] bool mask of matching entries.
     Like engine.entry_match_mask but term columns are selected per page
     through the page_block index: key id and ranges become [P]-indexed
@@ -549,7 +655,15 @@ def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
     [B]]): the device-probe product — pages of a block mapped to group
     g >= 0 test value membership with a hit-mask lookup on that group's
     row (one gather per term); group -1 pages keep the range compares,
-    so device-probed and host-compiled blocks mix in one batch."""
+    so device-probed and host-compiled blocks mix in one batch.
+
+    `widths` (STATIC at every call site — part of the jit shape key) +
+    `entry_dur_res`: the packed-residency descriptor (search/packing.py).
+    The kv unpack runs INSIDE the term body so the widening shifts/masks
+    fuse into the compares of each pass over the columns — no unpacked
+    copy materializes in HBM; packed (uint32-word) hit masks select
+    their bit in-register the same way."""
+    kw, vw, dw = widths if widths is not None else (None, None, None)
     safe_block = jnp.maximum(page_block, 0)
     mask = entry_valid & (page_block >= 0)[:, None]
     if n_terms:
@@ -559,17 +673,20 @@ def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
             safe_g = jnp.maximum(bg_page, 0)
 
         def term_body(t, acc):
+            kk = unpack_ids(kv_key, kw)                    # fused widen
+            vv = unpack_ids(kv_val, vw)
             k_per_page = term_keys[safe_block, t]          # [P]
-            keym = kv_key == k_per_page[:, None, None]     # [P,E,C]
+            keym = kk == k_per_page[:, None, None]         # [P,E,C]
             lo = val_ranges[safe_block, t, :, 0]           # [P,R]
             hi = val_ranges[safe_block, t, :, 1]
-            v = kv_val[..., None]                          # [P,E,C,1]
+            v = vv[..., None]                              # [P,E,C,1]
             valm = ((v >= lo[:, None, None, :]) &
                     (v <= hi[:, None, None, :])).any(-1)   # [P,E,C]
             if val_hits is not None:
-                safe_v = jnp.maximum(kv_val, 0).astype(jnp.int32)
-                mh = (val_hits[safe_g[:, None, None], t, safe_v]
-                      & (kv_val >= 0))                     # [P,E,C]
+                safe_v = jnp.maximum(vv, 0).astype(jnp.int32)
+                mh = (mask_select_grouped(val_hits, safe_g[:, None, None],
+                                          t, safe_v)
+                      & (vv >= 0))                         # [P,E,C]
                 valm = jnp.where(probe_page, mh, valm)
             hit = jnp.any(keym & valm, axis=-1)            # [P,E]
             if term_active is not None:
@@ -578,24 +695,24 @@ def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
 
         mask = jax.lax.fori_loop(0, n_terms, term_body, mask)
 
-    dur = entry_dur.astype(jnp.uint32)
-    mask = mask & (dur >= dur_lo.astype(jnp.uint32)) & (dur <= dur_hi.astype(jnp.uint32))
+    mask = mask & duration_ok(entry_dur, entry_dur_res, dur_lo, dur_hi, dw)
     mask = mask & (entry_end.astype(jnp.uint32) >= win_start.astype(jnp.uint32))
     mask = mask & (entry_start.astype(jnp.uint32) <= win_end.astype(jnp.uint32))
     return mask
 
 
-@functools.partial(jax.jit, static_argnames=("n_terms", "top_k"))
+@functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths"))
 def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                       entry_valid, page_block, term_keys, val_ranges,
                       dur_lo, dur_hi, win_start, win_end,
-                      val_hits=None, block_group=None,
-                      *, n_terms: int, top_k: int):
+                      val_hits=None, block_group=None, entry_dur_res=None,
+                      *, n_terms: int, top_k: int, widths=None):
     mask = multi_entry_mask(
         kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
         page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
         win_end, n_terms=n_terms, val_hits=val_hits,
-        block_group=block_group,
+        block_group=block_group, entry_dur_res=entry_dur_res,
+        widths=widths,
     )
     count = jnp.sum(mask, dtype=jnp.int32)
     inspected = jnp.sum(entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
@@ -603,12 +720,14 @@ def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
     return count, inspected, scores, idx
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "n_terms", "top_k"))
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "n_terms", "top_k", "widths"))
 def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                            entry_dur, entry_valid, page_block, term_keys,
                            val_ranges, dur_lo, dur_hi, win_start, win_end,
                            val_hits=None, block_group=None,
-                           *, n_terms: int, top_k: int):
+                           entry_dur_res=None,
+                           *, n_terms: int, top_k: int, widths=None):
     """Multi-block scan sharded over the mesh's scan axis: the stacked
     page axis (blocks × pages — the corpus 'sequence' axis, SURVEY.md §5)
     splits across devices; the [B,...] term tables replicate; counts
@@ -625,12 +744,13 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
     def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                  entry_valid, page_block, term_keys, val_ranges,
                  dur_lo, dur_hi, win_start, win_end, val_hits,
-                 block_group):
+                 block_group, entry_dur_res):
         mask = multi_entry_mask(
             kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
             page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
             win_end, n_terms=n_terms, val_hits=val_hits,
-            block_group=block_group,
+            block_group=block_group, entry_dur_res=entry_dur_res,
+            widths=widths,
         )
         local_count = jnp.sum(mask, dtype=jnp.int32)
         local_inspected = jnp.sum(
@@ -651,23 +771,25 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
     return shard_map_compat(
         shard_fn, mesh=mesh,
         # the probe hit mask + block->group map replicate like the other
-        # predicate tables (a None leaf makes its spec a no-op)
-        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 8,
+        # predicate tables (a None leaf makes its spec a no-op); the
+        # duration residual shards with the page axis
+        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 8 + (P(SCAN_AXIS),),
         out_specs=(P(), P(), P(), P()),
         # all_gather+top_k yields identical values on every shard, but the
         # replication checker can't infer it through the gather
         check=False,
     )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
       page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
-      win_end, val_hits, block_group)
+      win_end, val_hits, block_group, entry_dur_res)
 
 
-@functools.partial(jax.jit, static_argnames=("n_terms", "top_k"))
+@functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths"))
 def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                           entry_valid, page_block, term_keys, val_ranges,
                           term_active, dur_lo, dur_hi, win_start, win_end,
                           val_hits=None, block_group=None,
-                          *, n_terms: int, top_k: int):
+                          entry_dur_res=None,
+                          *, n_terms: int, top_k: int, widths=None):
     """The query-axis variant of multi_scan_kernel: predicate tables are
     [Q, ...]-stacked and vmap lifts the per-query mask + top-k over the
     query axis — ONE dispatch serves Q concurrent requests over the same
@@ -683,7 +805,8 @@ def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
         mask = multi_entry_mask(
             kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
             page_block, tk, vr, dlo, dhi, ws, we,
-            n_terms=n_terms, term_active=ta, val_hits=vh, block_group=bg)
+            n_terms=n_terms, term_active=ta, val_hits=vh, block_group=bg,
+            entry_dur_res=entry_dur_res, widths=widths)
         count = jnp.sum(mask, dtype=jnp.int32)
         scores, idx = masked_topk(mask, entry_start, top_k)
         return count, scores, idx
@@ -696,13 +819,14 @@ def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
     return counts, inspected, scores, idx
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "n_terms", "top_k"))
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "n_terms", "top_k", "widths"))
 def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                                entry_dur, entry_valid, page_block, term_keys,
                                val_ranges, term_active, dur_lo, dur_hi,
                                win_start, win_end, val_hits=None,
-                               block_group=None, *, n_terms: int,
-                               top_k: int):
+                               block_group=None, entry_dur_res=None,
+                               *, n_terms: int, top_k: int, widths=None):
     """Coalesced scan sharded over the mesh's scan axis: the page axis
     splits across devices, the [Q,...] query tables replicate, and the
     per-shard per-query top-k candidates all_gather into a per-query
@@ -717,7 +841,7 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
     def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                  entry_valid, page_block, term_keys, val_ranges,
                  term_active, dur_lo, dur_hi, win_start, win_end,
-                 val_hits, block_group):
+                 val_hits, block_group, entry_dur_res):
         local_inspected = jnp.sum(
             entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
 
@@ -726,7 +850,8 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                 kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, page_block, tk, vr, dlo, dhi, ws, we,
                 n_terms=n_terms, term_active=ta, val_hits=vh,
-                block_group=bg)
+                block_group=bg, entry_dur_res=entry_dur_res,
+                widths=widths)
             count = jnp.sum(mask, dtype=jnp.int32)
             scores, idx = masked_topk(mask, entry_start, top_k)
             return count, scores, idx
@@ -752,14 +877,14 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
 
     return shard_map_compat(
         shard_fn, mesh=mesh,
-        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 9,
+        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 9 + (P(SCAN_AXIS),),
         out_specs=(P(), P(), P(), P()),
         # same stance as dist_multi_scan_kernel: the gather+top_k output
         # is replicated but the replication checker can't infer it
         check=False,
     )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
       page_block, term_keys, val_ranges, term_active, dur_lo, dur_hi,
-      win_start, win_end, val_hits, block_group)
+      win_start, win_end, val_hits, block_group, entry_dur_res)
 
 
 class MultiBlockEngine:
@@ -843,15 +968,19 @@ class MultiBlockEngine:
                 tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(mq)
                 vh = getattr(mq, "val_hits", None)
                 bg = None if vh is None else jnp.asarray(mq.block_group)
+            widths = batch.widths
             args = (d["kv_key"], d["kv_val"], d["entry_start"],
                     d["entry_end"], d["entry_dur"], d["entry_valid"],
-                    d["page_block"], tk, vr, dlo, dhi, ws, we, vh, bg)
+                    d["page_block"], tk, vr, dlo, dhi, ws, we, vh, bg,
+                    d.get("entry_dur_res"))
             miss = rec.compile_check(
                 ("multi", self.mesh is not None, d["kv_key"].shape,
                  str(d["kv_key"].dtype), str(d["kv_val"].dtype), vr.shape,
-                 None if vh is None else tuple(vh.shape), mq.n_terms, k))
+                 None if vh is None else (tuple(vh.shape), str(vh.dtype)),
+                 widths, mq.n_terms, k))
             stage = "compile" if miss else "execute"
-            rec.set(kernel="multi", blocks=len(batch.blocks))
+            rec.set(kernel="multi", blocks=len(batch.blocks),
+                    scan_bytes=batch.device_nbytes)
             if self.mesh is not None:
                 from tempo_tpu.parallel import mesh as mesh_mod
 
@@ -860,7 +989,8 @@ class MultiBlockEngine:
                 with mesh_mod.locked_collective(rec):
                     with rec.stage(stage):
                         out = dist_multi_scan_kernel(
-                            self.mesh, *args, n_terms=mq.n_terms, top_k=k)
+                            self.mesh, *args, n_terms=mq.n_terms, top_k=k,
+                            widths=widths)
                 # fence AFTER releasing the collective lock: a fenced
                 # wait under dispatch_lock would serialize every other
                 # mesh dispatch behind this kernel's completion (the
@@ -871,7 +1001,8 @@ class MultiBlockEngine:
                     rec.fence(out)
                 return out
             with rec.stage(stage):
-                out = multi_scan_kernel(*args, n_terms=mq.n_terms, top_k=k)
+                out = multi_scan_kernel(*args, n_terms=mq.n_terms, top_k=k,
+                                        widths=widths)
                 rec.fence(out)
             return out
 
@@ -911,17 +1042,20 @@ class MultiBlockEngine:
                     jnp.asarray(cq.win_start), jnp.asarray(cq.win_end))
             rec.add_bytes(h2d=cq.term_keys.nbytes + cq.val_ranges.nbytes
                           + cq.term_active.nbytes + 16 * len(cq.dur_lo))
+            widths = batch.widths
             args = (d["kv_key"], d["kv_val"], d["entry_start"],
                     d["entry_end"], d["entry_dur"], d["entry_valid"],
-                    d["page_block"], *tables, vh, bg)
+                    d["page_block"], *tables, vh, bg,
+                    d.get("entry_dur_res"))
             miss = rec.compile_check(
                 ("coalesced", self.mesh is not None, d["kv_key"].shape,
                  str(d["kv_key"].dtype), str(d["kv_val"].dtype),
                  cq.term_keys.shape, cq.val_ranges.shape,
-                 None if vh is None else tuple(vh.shape),
-                 cq.n_terms, top_k))
+                 None if vh is None else (tuple(vh.shape), str(vh.dtype)),
+                 widths, cq.n_terms, top_k))
             stage = "compile" if miss else "execute"
-            rec.set(kernel="coalesced", queries=cq.n_queries)
+            rec.set(kernel="coalesced", queries=cq.n_queries,
+                    scan_bytes=batch.device_nbytes)
             if self.mesh is not None:
                 from tempo_tpu.parallel import mesh as mesh_mod
 
@@ -929,7 +1063,7 @@ class MultiBlockEngine:
                     with rec.stage(stage):
                         out = dist_coalesced_scan_kernel(
                             self.mesh, *args, n_terms=cq.n_terms,
-                            top_k=top_k)
+                            top_k=top_k, widths=widths)
                 # fence outside the collective lock (see
                 # _scan_async_impl — same lock-order stance)
                 with rec.stage(stage):
@@ -937,7 +1071,7 @@ class MultiBlockEngine:
                 return out
             with rec.stage(stage):
                 out = coalesced_scan_kernel(*args, n_terms=cq.n_terms,
-                                            top_k=top_k)
+                                            top_k=top_k, widths=widths)
                 rec.fence(out)
             return out
 

@@ -138,8 +138,11 @@ _COMPILE_CACHE_MAX = 128     # distinct tag-sets kept per dictionary
 _COMPILE_CACHE_DICTS = 4096  # distinct dictionaries tracked
 # entries whose probe product is a DEVICE hit mask pin HBM (~v_pad bytes
 # per term — 10 MB/term at 10M values), so they get a much tighter
-# per-dictionary bound than the host-only entries
+# per-dictionary bound than the host-only entries. Bit-packed masks
+# (search/packing.py, 8x fewer bytes per entry) afford an 8x deeper
+# bound at the same HBM charge — more distinct tag-sets stay compiled.
 _PROBE_CACHE_MAX = 8
+_PROBE_CACHE_MAX_PACKED = 64
 _COMPILE_CACHE: OrderedDict = OrderedDict()
 _compile_cache_lock = threading.Lock()
 
@@ -241,7 +244,14 @@ def compile_query(key_dict: list, val_dict: list,
             # path) — recompile through host and overwrite it
             from tempo_tpu.robustness import BREAKER
 
+            from . import packing
+
             if host_only or BREAKER.blocking():
+                hit = None
+            elif packing.is_packed_mask(hit[3]) != packing.PACKING.enabled:
+                # minted under the other packed-residency gate state:
+                # treat as a miss so one assembled batch never mixes
+                # mask formats (the fresh product overwrites it)
                 hit = None
         if hit is not None:
             # _PRUNED can only come from a non-exhaustive probe (the
@@ -251,17 +261,27 @@ def compile_query(key_dict: list, val_dict: list,
     out = _probe_tags(key_dict, val_dict, req, packed_vals,
                       staged_dict=staged_dict, fp=fp)
     if sig is not None:
+        from . import packing
+
         with _compile_cache_lock:
             cache = _COMPILE_CACHE.get(fp)
             if cache is not None:
                 cache[sig] = _PRUNED if out is None else out
                 while len(cache) > _COMPILE_CACHE_MAX:
                     cache.popitem(last=False)
+                # device hit masks pin HBM: keep only the newest few.
+                # Bit-packed masks are 8x smaller, so they get an 8x
+                # deeper bound at the same HBM charge.
                 probed = [s for s, o in cache.items()
-                          if not isinstance(o, str) and o[3] is not None]
-                # device hit masks pin HBM: keep only the newest few
+                          if not isinstance(o, str) and o[3] is not None
+                          and not packing.is_packed_mask(o[3])]
                 while len(probed) > _PROBE_CACHE_MAX:
                     cache.pop(probed.pop(0), None)
+                packed = [s for s, o in cache.items()
+                          if not isinstance(o, str) and o[3] is not None
+                          and packing.is_packed_mask(o[3])]
+                while len(packed) > _PROBE_CACHE_MAX_PACKED:
+                    cache.pop(packed.pop(0), None)
     return None if out is None else _from_probe(out, req)
 
 
@@ -314,6 +334,14 @@ def _device_probe_tags(terms, key_dict, staged_dict, exhaustive):
         import jax.numpy as jnp
 
         hits = hits & jnp.asarray(key_ok)[:, None]
+    from . import packing
+
+    if packing.PACKING.enabled:
+        # packed residency: the compile-cache product (and everything
+        # assembled from it) carries uint32 bit-words instead of 1-byte
+        # bools — 8x fewer HBM bytes pinned per cached tag-set; the
+        # scan kernels select the bit in-register (packing.mask_select)
+        hits = packing.PACKING.pack_hits(hits)
     T = len(term_key_ids)
     term_keys = np.asarray(term_key_ids, dtype=np.int32)
     term_vals = np.full((T, 1), INT32_SENTINEL, dtype=np.int32)

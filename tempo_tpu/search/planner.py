@@ -71,8 +71,17 @@ from tempo_tpu.observability import metrics as obs
 
 # per-byte cost kinds (seconds per byte; probe kinds are per TERM-byte —
 # observations pass nbytes = n_terms * bytes so predictions and
-# observations stay in one unit)
-PER_BYTE_KINDS = ("host_probe", "device_probe", "pack", "h2d")
+# observations stay in one unit). "scan" is the fused scan kernel's
+# execute rate over PHYSICAL staged bytes: with packed residency the
+# same pages land in smaller buckets, so the rate table is effectively
+# bucketed by the columns' packed width — the /debug/planner view an
+# operator reads to see what a byte of residency buys.
+PER_BYTE_KINDS = ("host_probe", "device_probe", "pack", "h2d", "scan")
+# kinds the one-shot microbenchmark seeds: everything the probe
+# DECISION consumes. "scan" is observational (it needs a real staged
+# batch, which the seed deliberately never creates) and fills from the
+# first live dispatches instead.
+SEEDED_KINDS = ("host_probe", "device_probe", "pack", "h2d")
 # fixed per-event costs (seconds)
 FIXED_KINDS = ("dispatch", "compile", "collective")
 
@@ -84,6 +93,7 @@ _DEFAULT_RATES = {
     "device_probe": 8e-9,    # ~125 MB/s (CPU-backend probe kernel)
     "pack": 6e-9,
     "h2d": 1e-9,             # ~1 GB/s put
+    "scan": 1e-10,           # ~10 GB/s linear pass (HBM-bound on chip)
 }
 _DEFAULT_FIXED = {"dispatch": 1e-3, "compile": 0.5, "collective": 2e-3}
 
@@ -458,11 +468,25 @@ class OffloadPlanner:
     # profiler feed (observability/profile.py listeners)
 
     def ingest_record(self, rec: dict) -> int:
-        """One finished dispatch record (Dispatch.as_dict shape). Only
-        dict_probe dispatches carry probe-placement signal. Returns the
-        number of model updates (the offline replay counts them)."""
-        if not self.enabled or self._seeding \
-                or rec.get("mode") != "dict_probe":
+        """One finished dispatch record (Dispatch.as_dict shape).
+        dict_probe dispatches carry the probe-placement signal; scan
+        dispatches feed the per-byte scan rate over their PHYSICAL
+        staged bytes (packed residency moves the same pages into
+        smaller size buckets, so the rate table splits by effective
+        column width). Returns the number of model updates (the
+        offline replay counts them)."""
+        if not self.enabled or self._seeding:
+            return 0
+        mode = rec.get("mode")
+        if mode in ("batched", "mesh", "coalesced", "single"):
+            stages = rec.get("stages_ms") or {}
+            sb = int((rec.get("attrs") or {}).get("scan_bytes") or 0)
+            ex = stages.get("execute")
+            if ex and sb:
+                self._update("scan", ex / 1e3, sb)
+                return 1
+            return 0
+        if mode != "dict_probe":
             return 0
         stages = rec.get("stages_ms") or {}
         attrs = rec.get("attrs") or {}
@@ -492,13 +516,17 @@ class OffloadPlanner:
     def ingest_stage(self, stage: str, mode: str, seconds: float,
                      nbytes: int) -> int:
         """One out-of-record stage observation (profile.observe_stage
-        listener): dictionary staging H2D. The host prefilter is NOT
-        harvested here — pipeline._probe_tags feeds it directly with the
-        dictionary fingerprint attached (and also reports it to the
-        profiler, where only the aggregate lands)."""
+        listener): dictionary AND page-batch staging H2D — the batch
+        observations carry PHYSICAL (packed) byte counts, so the
+        staging-cost side of every decision scales with what actually
+        crosses the relay, not the unpacked layout. The host prefilter
+        is NOT harvested here — pipeline._probe_tags feeds it directly
+        with the dictionary fingerprint attached (and also reports it
+        to the profiler, where only the aggregate lands)."""
         if not self.enabled or self._seeding:
             return 0
-        if stage == "h2d" and mode == "dict_probe" and nbytes:
+        if stage == "h2d" and nbytes \
+                and mode in ("dict_probe", "batched", "mesh", "single"):
             self._update("h2d", seconds, nbytes)
             return 1
         return 0

@@ -93,7 +93,8 @@ class QueryStats:
                  "bytes_host", "bytes_device", "cache", "stages",
                  "device_stages", "h2d_bytes", "dispatches",
                  "fused_dispatches", "coalesced_with", "planner",
-                 "host_probe", "subqueries", "fronted", "_lock")
+                 "host_probe", "subqueries", "fronted",
+                 "staged_physical", "staged_logical", "_lock")
 
     def __init__(self, tenant: str, scope: str = "exec",
                  query: dict | None = None):
@@ -120,6 +121,11 @@ class QueryStats:
         self.coalesced_with = 0   # peer queries sharing my dispatches
         self.planner = {"host": 0, "device": 0, "predicted_ms": 0.0}
         self.host_probe = {"count": 0, "seconds": 0.0, "bytes": 0}
+        # staged bytes this query's scans read, both sides of the
+        # packed-residency split (search/packing.py): physical = bytes
+        # as resident (packed), logical = the unpacked equivalent
+        self.staged_physical = 0
+        self.staged_logical = 0
         self.subqueries = 0       # request scope: sub-responses merged
         self.fronted = _FRONTED.get()
         self._lock = threading.Lock()
@@ -168,6 +174,13 @@ class QueryStats:
         with self._lock:
             self.planner[target] = self.planner.get(target, 0) + 1
             self.planner["predicted_ms"] += predicted_s * 1e3
+
+    def add_staged(self, physical: int, logical: int) -> None:
+        """Staged bytes one group's scan read — the bytes-inspected
+        physical/logical split the explain breakdown reports."""
+        with self._lock:
+            self.staged_physical += int(physical)
+            self.staged_logical += int(logical)
 
     def add_host_probe(self, seconds: float, nbytes: int) -> None:
         with self._lock:
@@ -220,6 +233,9 @@ class QueryStats:
                              self.device_stages)):
                 for k, v in (d or {}).items():
                     mine[k] = mine.get(k, 0.0) + v / 1e3
+            sb = child.get("staged_bytes") or {}
+            self.staged_physical += int(sb.get("physical", 0))
+            self.staged_logical += int(sb.get("logical", 0))
             for k, v in (child.get("planner") or {}).items():
                 self.planner[k] = self.planner.get(k, 0) + v
             hp = child.get("host_probe") or {}
@@ -252,6 +268,9 @@ class QueryStats:
                 "h2d_bytes": int(round(self.h2d_bytes)),
                 "cache": dict(self.cache),
             }
+            if self.staged_physical or self.staged_logical:
+                d["staged_bytes"] = {"physical": self.staged_physical,
+                                     "logical": self.staged_logical}
             if self.query:
                 d["query"] = dict(self.query)
             if self.trace_id:

@@ -33,6 +33,18 @@ def enable_compile_cache(path: str,
         # exactly the sum of many sub-second compiles
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           float(min_compile_time_s))
+        # surface on-disk cache hits in the jit_cache_events counter
+        # (result=persisted) so an operator can SEE cold-start compiles
+        # being replayed from disk instead of inferring it from wall
+        # time; best-effort — the metric is an observability extra
+        try:
+            from tempo_tpu.observability.profile import (
+                watch_persistent_compile_cache,
+            )
+
+            watch_persistent_compile_cache()
+        except Exception:  # noqa: BLE001 — never fail cache enablement
+            pass
 
         def apply(d: str) -> None:
             if jax.config.jax_compilation_cache_dir == d:

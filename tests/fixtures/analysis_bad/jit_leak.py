@@ -29,6 +29,27 @@ def retraced_kernel(scores, *, top_k):
     return jax.lax.top_k(scores, 4)
 
 
+def _unpack_fixture(arr, w):
+    """Width-descriptor-shaped helper (packed-residency idiom): branches
+    on its descriptor, so a tracer reaching `w` is a trace-time leak."""
+    if w == "u4":
+        return arr & 0xF
+    return arr
+
+
+@functools.partial(jax.jit, static_argnames=("widths",))
+def descriptor_taint_kernel(arr, sel, *, widths):
+    # VIOLATION: tracer data passed as a width descriptor — the helper
+    # branches on it at trace time
+    return _unpack_fixture(arr, sel[0])
+
+
+@functools.partial(jax.jit, static_argnames=("widths",))
+def descriptor_clean_kernel(arr, *, widths):
+    # the good twin: the descriptor comes from the static `widths`
+    return _unpack_fixture(arr, widths[0])
+
+
 @functools.partial(jax.jit, static_argnames=("top_k",))
 def clean_kernel(scores, mask, extra=None, *, top_k):
     n = scores.shape[0]            # shape reads are static: fine

@@ -426,8 +426,9 @@ def test_cold_process_decides_without_crashing():
     snap = p.snapshot()
     assert snap["seeded"] is True
     assert snap["seed_ms"] is not None
-    # the seed populated every per-byte rate
-    for kind in planner.PER_BYTE_KINDS:
+    # the seed populated every decision-consumed per-byte rate ("scan"
+    # is observational — it fills from the first live scan dispatches)
+    for kind in planner.SEEDED_KINDS:
         assert snap["cost_model"]["rates"][kind]["observations"] > 0
 
 

@@ -199,6 +199,17 @@ def test_jit_purity_clean_twin_not_flagged(bad_pkg):
         [f.message for f in findings]
 
 
+def test_jit_purity_flags_tainted_width_descriptor(bad_pkg):
+    findings = JitPurityChecker().check(bad_pkg)
+    taint = [f for f in findings if f.key.startswith("descriptor-taint:")
+             and "descriptor_taint_kernel" in f.key]
+    assert taint and "'w'" in taint[0].message, \
+        [f.message for f in findings]
+    assert not [f for f in findings
+                if "descriptor_clean_kernel" in f.key], \
+        [f.message for f in findings]
+
+
 def test_jit_purity_clean_on_real_kernels(real_pkg):
     assert JitPurityChecker().check(real_pkg) == []
 
