@@ -2070,6 +2070,160 @@ def phase_packing():
         }
 
 
+def phase_structural():
+    """Structural query engine contract (ISSUE 14,
+    docs/search-structural-queries.md): a parent/child + descendant +
+    aggregate query mix over a span-bearing corpus, asserting
+
+      - byte-identity: the compiled device path's match set equals the
+        host reference evaluator's (structural.eval_host), per query;
+      - a throughput floor vs the equivalent POST-FILTER baseline (the
+        pre-structural architecture: run the legacy scan, fetch, then
+        evaluate the structural predicate per trace on host) — the
+        compiled path must not lose to interpreting the tree per row;
+      - the compiled plan tree with per-node device-seconds lands in
+        this phase's detail (the ?explain=1 surface).
+    """
+    import tempfile
+
+    from tempo_tpu import tempopb
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.search import ir, structural
+    from tempo_tpu.search.columnar import PageGeometry
+    from tempo_tpu.search.data import (SearchData, SpanData,
+                                       encode_search_data)
+
+    n_blocks = int(os.environ.get("BENCH_STRUCTURAL_BLOCKS", 6))
+    entries_per_block = int(os.environ.get("BENCH_STRUCTURAL_ENTRIES",
+                                           4096))
+    rounds = int(os.environ.get("BENCH_STRUCTURAL_ROUNDS", 3))
+    svcs = [f"svc-{i:02d}" for i in range(12)]
+
+    def mk_entries(s):
+        rng = np.random.default_rng(2000 + s)
+        out = []
+        for i in range(entries_per_block):
+            sd = SearchData(
+                trace_id=rng.bytes(16),
+                start_s=int(rng.integers(1, 5_000)),
+                end_s=int(rng.integers(5_000, 10_000)),
+                dur_ms=int(rng.integers(1, 30_000)),
+            )
+            svc = svcs[int(rng.integers(0, len(svcs)))]
+            sd.kvs = {"service.name": {svc},
+                      "env": {"prod" if i % 2 else "dev"}}
+            n_sp = int(rng.integers(1, 8))
+            for j in range(n_sp):
+                sd.spans.append(SpanData(
+                    parent=(-1 if j == 0 else int(rng.integers(0, j))),
+                    dur_ms=int(rng.integers(1, 2_000)),
+                    kind=int(rng.integers(0, 6)),
+                    kvs={"service.name":
+                         {svcs[int(rng.integers(0, len(svcs)))]},
+                         "name": {f"op{int(rng.integers(0, 4))}"}}))
+            out.append(sd)
+        return out
+
+    queries = {
+        "parent_child": ir.parse(
+            '{"child": {"parent": {"tag": {"k": "service.name",'
+            ' "v": "svc-03"}}, "child": {"dur": {"min_ms": 500}}}}'),
+        "descendant": ir.parse(
+            '{"desc": {"anc": {"kind": "server"},'
+            ' "span": {"tag": {"k": "name", "v": "op1"}}}}'),
+        "count": ir.parse(
+            '{"count": {"of": {"dur": {"min_ms": 1000}},'
+            ' "op": ">", "n": 2}}'),
+        "quantile": ir.parse(
+            '{"quantile": {"of": {"tag": {"k": "name", "v": "op"}},'
+            ' "q": "0.9", "op": ">=", "ms": 1200}}'),
+    }
+
+    with tempfile.TemporaryDirectory() as td:
+        be = LocalBackend(td + "/blocks")
+        db = TempoDB(be, td + "/wal", TempoDBConfig(
+            auto_mesh=False, search_structural_enabled=True,
+            search_geometry=PageGeometry(256, 8)))
+        corpus = []
+        for s in range(n_blocks):
+            entries = sorted(mk_entries(s), key=lambda sd: sd.trace_id)
+            corpus.extend(entries)
+            db.write_block_direct(
+                "bench",
+                [(sd.trace_id, encode_search_data(sd), sd.start_s,
+                  sd.end_s) for sd in entries],
+                search_entries=entries)
+
+        total = len(corpus)
+        results = {}
+        compiled_wall = 0.0
+        for name, expr in queries.items():
+            want = {sd.trace_id for sd in corpus
+                    if structural.eval_host(expr, sd)}
+            req = tempopb.SearchRequest()
+            req.limit = total
+            structural.attach_query(req, expr)
+            # warm (stage + compile), then measure
+            db.search("bench", req)
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                res = db.search("bench", req)
+            wall = (time.perf_counter() - t0) / rounds
+            compiled_wall += wall
+            got = {bytes.fromhex(m.trace_id)
+                   for m in res.response().traces}
+            assert got == want, (
+                f"{name}: compiled match set diverged from the host "
+                f"reference ({len(got)} vs {len(want)})")
+            # post-filter-on-host baseline: the legacy scan already ran
+            # once above; the honest extra cost of the old architecture
+            # is interpreting the structural tree per fetched trace
+            t0 = time.perf_counter()
+            n_match = sum(1 for sd in corpus
+                          if structural.eval_host(expr, sd))
+            base_wall = time.perf_counter() - t0
+            results[name] = {
+                "matches": len(want),
+                "compiled_ms": round(wall * 1e3, 3),
+                "post_filter_baseline_ms": round(base_wall * 1e3, 3),
+                "speedup_vs_post_filter": round(base_wall / max(wall,
+                                                                1e-9), 2),
+            }
+            _ = n_match
+
+        # throughput floor: the compiled mix must beat interpreting the
+        # tree per row (generous floor for shared-CPU noise)
+        base_total = sum(r["post_filter_baseline_ms"]
+                         for r in results.values()) / 1e3
+        assert compiled_wall <= base_total / 0.5, (
+            f"compiled structural mix ({compiled_wall:.3f}s) lost to the "
+            f"post-filter baseline ({base_total:.3f}s) by >2x")
+
+        # explain surface: per-node device-seconds in the plan tree
+        req = tempopb.SearchRequest()
+        req.limit = 10
+        req.explain = True
+        structural.attach_query(req, queries["parent_child"])
+        stats = json.loads(
+            db.search("bench", req).response().metrics.query_stats_json)
+        nodes = stats["structural"]["nodes"]
+        assert nodes and all("device_ms" in n for n in nodes)
+
+        return {
+            "blocks": n_blocks,
+            "entries_per_block": entries_per_block,
+            "total_traces": total,
+            "byte_identical": True,
+            "compiled_mix_traces_per_s": round(
+                total * len(queries) / max(compiled_wall, 1e-9)),
+            "post_filter_traces_per_s": round(
+                total * len(queries) / max(base_total, 1e-9)),
+            "queries": results,
+            "explain_plan_nodes": nodes,
+        }
+
+
 def phase_scale_10k():
     n_blocks = int(os.environ.get("BENCH_SCALE_BLOCKS", 10_000))
     if not n_blocks:
@@ -2103,6 +2257,7 @@ PHASES = {
     "chaos": phase_chaos,
     "ownership": phase_ownership,
     "packing": phase_packing,
+    "structural": phase_structural,
     "scale_10k": phase_scale_10k,
     "scale_large_blocks": phase_scale_large_blocks,
 }
@@ -2124,6 +2279,7 @@ PHASE_TIMEOUTS = {
     "chaos": 420.0,
     "ownership": 420.0,
     "packing": 420.0,
+    "structural": 420.0,
     "scale_10k": 900.0,
     "scale_large_blocks": 1200.0,
 }

@@ -103,6 +103,11 @@ GATED_FUNCTIONS = (
     GatedFunction("tempo_tpu.search.packing",
                   "PackedResidency.pack_hits", ("enabled",),
                   "search_packed_residency"),
+    # structural query engine: the per-request gate — disabled search
+    # paths pay one attribute read and return None before any tag get,
+    # parse, or cache touch
+    GatedFunction("tempo_tpu.search.structural", "structural_query",
+                  ("enabled",), "search_structural_enabled"),
 )
 
 GUARDED_CALLS = (
@@ -120,6 +125,10 @@ GUARDED_CALLS = (
     # even compute the width-planner inputs (duration rollup maxes)
     GuardedCall("PACKING", ("plan_widths", "pack_hits"), (), "enabled",
                 "PACKING", "search_packed_residency"),
+    # structural span staging: the disabled path must not even inspect
+    # blocks for span segments, let alone stack/pad/upload them
+    GuardedCall("STRUCTURAL", ("stack_spans", "stage_single"), (),
+                "enabled", "STRUCTURAL", "search_structural_enabled"),
 )
 
 

@@ -44,14 +44,17 @@ _CLOCK_MODS = {"time", "_time"}
 _NP_NAMES = {"np", "numpy"}
 _NP_HOST_FNS = {"asarray", "array", "frombuffer", "copy"}
 # packed-residency width-descriptor parameter names (search/packing.py
-# unpack helpers + the kernels' `widths` static): a descriptor decides
-# SHAPES and branch structure at trace time, so a tracer reaching one
-# is a guaranteed ConcretizationTypeError — and a non-static python
-# value would silently retrace per distinct value. The rule only fires
-# for helpers that actually BRANCH on the parameter (descriptor
-# dispatchers) — a numeric parameter that merely shares a name
-# (`def weighted(x, w)`) is ordinary traced data, not a descriptor.
-_DESCRIPTOR_PARAMS = {"w", "dw", "widths"}
+# unpack helpers + the kernels' `widths` static) AND the structural
+# query engine's plan descriptors (search/structural.py `plan` — the
+# compiled query tree the kernel lowering recurses over at trace time):
+# a descriptor decides SHAPES and branch structure at trace time, so a
+# tracer reaching one is a guaranteed ConcretizationTypeError — and a
+# non-static python value would silently retrace per distinct value.
+# The rule only fires for helpers that actually BRANCH on the parameter
+# (descriptor dispatchers) — a numeric parameter that merely shares a
+# name (`def weighted(x, w)`) is ordinary traced data, not a
+# descriptor.
+_DESCRIPTOR_PARAMS = {"w", "dw", "widths", "plan"}
 
 
 def _branches_on_param(helper: ast.AST, param: str) -> bool:

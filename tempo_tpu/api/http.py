@@ -237,6 +237,16 @@ class HTTPApi:
             return code, json_format.MessageToDict(resp.trace)
         if path == PATH_SEARCH:
             req = parse_search_request(query)
+            from tempo_tpu.search.structural import (STRUCTURAL,
+                                                     STRUCTURAL_QUERY_TAG)
+
+            if STRUCTURAL_QUERY_TAG in req.tags and not STRUCTURAL.enabled:
+                # structural queries are gated per deployment
+                # (docs/search-structural-queries.md): a clear client
+                # error, not a silent legacy-scan answer
+                return 400, {"error": "structural queries disabled "
+                                      "(storage.search_structural_"
+                                      "enabled: true enables)"}
             # explain opt-in: ?explain=1 (parse_search_request) or the
             # X-Tempo-Explain header — the response then carries the
             # full per-query execution breakdown. Same value set as the

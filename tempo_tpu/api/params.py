@@ -81,7 +81,25 @@ def parse_search_request(query: dict[str, str]) -> tempopb.SearchRequest:
         if query.get("explain", "").strip().lower() in ("1", "true",
                                                         "yes"):
             req.explain = True
+        if query.get("q"):
+            # structural query (docs/search-structural-queries.md):
+            # compact JSON IR in ?q=. Parsed HERE — a malformed tree is
+            # a 400 carrying the node's JSON path, never a 500 from deep
+            # in compile — then stowed canonically in the reserved tag
+            # so it survives the frontend <-> querier round-trip.
+            from tempo_tpu.search import ir as _ir
+            from tempo_tpu.search.structural import attach_query
+
+            try:
+                attach_query(req, _ir.parse(query["q"]))
+            except _ir.IRSyntaxError as e:
+                raise InvalidArgument(
+                    f"bad structural query: {e}") from None
         return req
+    except InvalidArgument:
+        # already the dedicated client-error type with its own message
+        # (the structural-query path) — re-wrapping would double-prefix
+        raise
     except ValueError as e:
         # query-param parse failures are CLIENT errors (400), never the
         # 500 a bare ValueError now maps to on the serving path

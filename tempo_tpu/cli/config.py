@@ -121,6 +121,15 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
         # (default) is a true noop and byte-identical either way
         search_packed_residency=storage.get(
             "search_packed_residency", False),
+        # structural query engine (docs/search-structural-queries.md):
+        # the ?q= IR compiled onto the fused scan kernels; false
+        # (default) is a true noop on the legacy search path
+        search_structural_enabled=storage.get(
+            "search_structural_enabled", False),
+        search_structural_max_spans=storage.get(
+            "search_structural_max_spans", 512),
+        search_structural_max_span_kvs=storage.get(
+            "search_structural_max_span_kvs", 16),
         # persistent XLA compile cache for the search kernels
         # (docs/search-packed-residency.md#persistent-compile-cache);
         # empty = off, hits surface as jit_cache_events{result=persisted}

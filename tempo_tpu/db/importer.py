@@ -209,15 +209,20 @@ def import_reference_block(read, db, tenant: str):
     when the imported object count disagrees with meta.totalObjects —
     a silently-partial migration must never look like success."""
     from tempo_tpu.search.data import extract_search_data
+    from tempo_tpu.search.structural import STRUCTURAL
     from tempo_tpu.utils.ids import pad_trace_id
 
     meta = parse_ref_meta(read("meta.json"))
     objects = []
     entries = []
+    # structural gate on: migrated blocks carry the span segment too,
+    # so structural queries see imported traces exactly like ingested
+    # ones (gate off keeps the legacy extraction byte-identical)
+    want_spans = STRUCTURAL.enabled
     for oid, seg, start_s, end_s, trace in iter_reference_block(read, meta):
         tid = pad_trace_id(oid)
         objects.append((tid, seg, start_s, end_s))
-        entries.append(extract_search_data(tid, trace))
+        entries.append(extract_search_data(tid, trace, spans=want_spans))
     if meta.total_objects and len(objects) != meta.total_objects:
         raise ImportError_(
             f"imported {len(objects)} objects, meta.totalObjects says "

@@ -123,6 +123,22 @@ class TempoDBConfig:
     # rebalance unit): more groups = finer rebalance granularity at a
     # larger /debug/ownership map
     search_hbm_ownership_groups: int = 64
+    # structural query engine (search/ir.py + search/structural.py,
+    # docs/search-structural-queries.md): a typed query IR — span-level
+    # predicates, AND/OR/NOT, parent-child / descendant relations,
+    # count and duration-quantile aggregates — parsed from ?q= on the
+    # search API and COMPILED into the fused scan kernels (parent-
+    # pointer joins + segment reductions over per-trace span segments).
+    # Enabling also captures per-span summary rows at ingest (the span
+    # segment of new search containers). False (default) is a true
+    # noop: legacy tag/duration requests read one attribute and take
+    # the existing byte-identical path; requests carrying ?q= get a 400.
+    search_structural_enabled: bool = False
+    # span rows captured per trace at ingest (walk-order truncation —
+    # the span segment's max_search_bytes analog)
+    search_structural_max_spans: int = 512
+    # kv pairs captured per span at ingest
+    search_structural_max_span_kvs: int = 16
     # packed HBM residency (search/packing.py,
     # docs/search-packed-residency.md): staged value-id columns narrow
     # to the width the per-block dictionary cardinality allows (4-bit/
@@ -322,6 +338,14 @@ class TempoDB:
         from tempo_tpu.search import packing as _packing
 
         _packing.configure(enabled=self.cfg.search_packed_residency)
+        # structural query engine: process-wide gate like the layers
+        # above (docs/search-structural-queries.md)
+        from tempo_tpu.search import structural as _structural
+
+        _structural.configure(
+            enabled=self.cfg.search_structural_enabled,
+            max_spans=self.cfg.search_structural_max_spans,
+            max_span_kvs=self.cfg.search_structural_max_span_kvs)
         # owner-routed HBM placement: process-wide like the layers above
         # (docs/search-hbm-ownership.md)
         from tempo_tpu.search import ownership as _ownership

@@ -75,10 +75,38 @@ def matches(trace: tempopb.Trace, req: tempopb.SearchRequest) -> bool:
     if req.end and start_ns // 1_000_000_000 > req.end:
         return False
     if req.tags:
-        attrs = list(_iter_all_attrs(trace))
+        from tempo_tpu.search.pipeline import EXHAUSTIVE_SEARCH_TAG
+        from tempo_tpu.search.structural import STRUCTURAL_QUERY_TAG
+
+        attrs = None
         for k, v in req.tags.items():
+            if k in (EXHAUSTIVE_SEARCH_TAG, STRUCTURAL_QUERY_TAG):
+                continue  # in-band flags, not tag predicates
+            if attrs is None:
+                attrs = list(_iter_all_attrs(trace))
             if not any(_attr_matches(kv, k, v) for kv in attrs):
                 return False
+    # structural predicate over the full proto (container-less blocks'
+    # fallback scan): extract the span rows and run the host reference
+    # evaluator — the same semantics the compiled kernels answer
+    from tempo_tpu.search import structural as _structural
+
+    expr = _structural.structural_query(req)
+    if expr is not None:
+        from tempo_tpu.search.data import collect_span_rows, SearchData
+
+        from tempo_tpu.search.data import _any_value_str
+
+        sd = SearchData(dur_ms=min(max(0, dur_ms), 0xFFFFFFFF))
+        for kv in _iter_all_attrs(trace):
+            v = _any_value_str(kv.value)
+            if v:
+                sd.kvs.setdefault(kv.key, set()).add(v)
+        sd.spans = collect_span_rows(
+            trace, max_spans=_structural.STRUCTURAL.max_spans,
+            max_kvs=_structural.STRUCTURAL.max_span_kvs)
+        if not _structural.eval_host(expr, sd):
+            return False
     return True
 
 

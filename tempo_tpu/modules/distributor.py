@@ -128,17 +128,36 @@ class Distributor:
         lim = self.overrides.limits(tenant)
         items = None  # [(tid, start_s, end_s, segment, sd_bytes)]
         summaries = None
+        from tempo_tpu.search.structural import STRUCTURAL
+
         if blobs is not None:
-            try:
-                native_out = self._native.ingest_regroup(
-                    blobs, lim.max_search_bytes_per_trace)
-            except self._native.InvalidTraceId:
-                native_out = None  # python path raises the canonical error
+            if STRUCTURAL.enabled:
+                # the native walker emits no span rows yet: with the
+                # structural gate on, ingest takes the python walk so
+                # every flushed block carries the span segment
+                native_out = None
+            else:
+                try:
+                    native_out = self._native.ingest_regroup(
+                        blobs, lim.max_search_bytes_per_trace)
+                except self._native.InvalidTraceId:
+                    native_out = None  # python path raises canonical error
             if native_out is not None:
                 n_spans, items, summaries = native_out
         if items is None:
             by_trace, n_spans, sd_by_trace = self._regroup_extract(
                 batches, lim.max_search_bytes_per_trace)
+            if STRUCTURAL.enabled:
+                # structural engine: per-span summary rows ride the
+                # search-data payload (a second walk over the regrouped
+                # trace, paid ONLY behind the gate — gate off keeps the
+                # fused single walk and the byte-identical wire form)
+                from tempo_tpu.search.data import collect_span_rows
+
+                for tid, trace in by_trace.items():
+                    sd_by_trace[tid].spans = collect_span_rows(
+                        trace, max_spans=STRUCTURAL.max_spans,
+                        max_kvs=STRUCTURAL.max_span_kvs)
             items = []
             for tid, trace in by_trace.items():
                 sd = sd_by_trace[tid]

@@ -48,6 +48,10 @@ class ShardedPages:
     # packed-residency width descriptor (search/packing.py): static per
     # staged block, part of the dist kernel's jit shape key
     widths: tuple | None = None
+    # structural span columns (search/structural.py): REPLICATED — the
+    # parent joins index the global span axis; the structural verdict
+    # computes outside shard_map and enters the scan page-sharded
+    span_device: dict | None = None
 
 
 class DistributedScanEngine:
@@ -107,31 +111,60 @@ class DistributedScanEngine:
                                          for v in host.values()))
         sd = stage_block_dict(pages, self.probe_min_vals,
                               n_shards=self.n_shards, mesh=self.mesh)
+        from tempo_tpu.search.structural import STRUCTURAL
+
+        span_dev = None
+        if STRUCTURAL.enabled:
+            span_host = STRUCTURAL.stage_single(pages, B)
+            if span_host is not None:
+                # replicate (P()): parent pointers index the global span
+                # axis, which a page shard cannot see locally
+                rep = NamedSharding(self.mesh, P())
+                span_dev = {k: jax.device_put(v, rep)
+                            for k, v in span_host.items()}
         return ShardedPages(device=dev, n_pages=pages.n_pages, pages=pages,
-                            staged_dict=sd, widths=widths)
+                            staged_dict=sd, widths=widths,
+                            span_device=span_dev)
 
     # ---- kernel ----
 
     @functools.partial(jax.jit, static_argnames=("self", "n_terms",
-                                                 "top_k", "widths"))
+                                                 "top_k", "widths",
+                                                 "plan"))
     def _dist_kernel(self, kv_key, kv_val, entry_start, entry_end,
                      entry_dur, entry_valid, term_keys, val_ranges,
                      dur_lo, dur_hi, win_start, win_end, val_hits=None,
-                     entry_dur_res=None,
-                     *, n_terms: int, top_k: int, widths=None):
+                     entry_dur_res=None, span_cols=None, s_tables=None,
+                     *, n_terms: int, top_k: int, widths=None,
+                     plan=None):
         E = entry_valid.shape[1]
         local_flat = kv_key.shape[0] // self.n_shards * E
+
+        struct_mask = None
+        if plan is not None:
+            # structural verdicts evaluate over the REPLICATED span
+            # columns outside shard_map (the parent joins index the
+            # global span axis), then shard with the page axis below
+            from tempo_tpu.search.structural import structural_entry_mask
+
+            page_block = jnp.zeros(entry_valid.shape[0], dtype=jnp.int32)
+            struct_mask = structural_entry_mask(
+                kv_key, kv_val, entry_dur, entry_valid, page_block,
+                entry_dur_res, span_cols, s_tables, plan=plan,
+                widths=widths)
 
         def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                      entry_valid, term_keys, val_ranges,
                      dur_lo, dur_hi, win_start, win_end, val_hits,
-                     entry_dur_res):
+                     entry_dur_res, struct_mask):
             mask = entry_match_mask(
                 kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, term_keys, val_ranges, dur_lo, dur_hi,
                 win_start, win_end, n_terms=n_terms, val_hits=val_hits,
                 entry_dur_res=entry_dur_res, widths=widths,
             )
+            if struct_mask is not None:
+                mask = mask & struct_mask
             local_count = jnp.sum(mask, dtype=jnp.int32)
             local_inspected = jnp.sum(entry_valid, dtype=jnp.int32)
             scores, idx = masked_topk(mask, entry_start, top_k)
@@ -156,14 +189,15 @@ class DistributedScanEngine:
             # the packed-duration residual shards with the page axis
             in_specs=(P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS),
                       P(SCAN_AXIS), P(SCAN_AXIS),
-                      P(), P(), P(), P(), P(), P(), P(), P(SCAN_AXIS)),
+                      P(), P(), P(), P(), P(), P(), P(), P(SCAN_AXIS),
+                      P(SCAN_AXIS)),
             out_specs=(P(), P(), P(), P()),
             # all_gather+top_k yields identical values on every shard, but
             # the replication checker can't infer it through the gather
             check=False,
         )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
           term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end,
-          val_hits, entry_dur_res)
+          val_hits, entry_dur_res, struct_mask)
 
     # ---- public API ----
 
@@ -186,11 +220,17 @@ class DistributedScanEngine:
                 tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(cq)
             vh = getattr(cq, "val_hits", None)
             widths = getattr(sp, "widths", None)
+            st = getattr(cq, "structural", None)
+            plan = None if st is None else st.plan
+            s_tables = None if st is None else st.device_tables()
+            span_cols = (getattr(sp, "span_device", None)
+                         if st is not None else None)
             miss = rec.compile_check(
                 ("dist", d["kv_key"].shape, str(d["kv_key"].dtype),
                  str(d["kv_val"].dtype), vr.shape,
                  None if vh is None else (tuple(vh.shape), str(vh.dtype)),
-                 widths, cq.n_terms, k))
+                 widths, cq.n_terms, k,
+                 None if st is None else st.shape_sig()))
             from tempo_tpu.parallel.mesh import locked_collective
 
             # process-wide collective-ordering lock (parallel.mesh):
@@ -205,8 +245,9 @@ class DistributedScanEngine:
                         d["entry_start"], d["entry_end"], d["entry_dur"],
                         d["entry_valid"],
                         tk, vr, dlo, dhi, ws, we, vh,
-                        d.get("entry_dur_res"),
+                        d.get("entry_dur_res"), span_cols, s_tables,
                         n_terms=cq.n_terms, top_k=k, widths=widths,
+                        plan=plan,
                     )
             # fence after releasing the collective lock: a fenced wait
             # under dispatch_lock would stall every other mesh dispatch

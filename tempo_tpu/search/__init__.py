@@ -14,18 +14,22 @@ result merge.
   pipeline.py    host-side query compilation (dictionary prefilter,
                  substring semantics) + block-level pruning
   engine.py      the jit scan kernels (single device)
+  ir.py          the structural query IR + JSON parser (?q=)
+  structural.py  structural compiler: IR -> static plan + tables fused
+                 into the scan kernels (parent joins, segment reduces)
   backend_search_block.py  block build/open/search orchestration
 """
 
-from .data import SearchData, extract_search_data, encode_search_data, decode_search_data
+from .data import SearchData, SpanData, extract_search_data, \
+    encode_search_data, decode_search_data
 from .streaming import StreamingSearchBlock
 from .columnar import ColumnarPages, PageGeometry
 from .backend_search_block import BackendSearchBlock, write_search_block
 from .results import SearchResults
 
 __all__ = [
-    "SearchData", "extract_search_data", "encode_search_data",
-    "decode_search_data", "StreamingSearchBlock", "ColumnarPages",
-    "PageGeometry", "BackendSearchBlock", "write_search_block",
-    "SearchResults",
+    "SearchData", "SpanData", "extract_search_data",
+    "encode_search_data", "decode_search_data", "StreamingSearchBlock",
+    "ColumnarPages", "PageGeometry", "BackendSearchBlock",
+    "write_search_block", "SearchResults",
 ]

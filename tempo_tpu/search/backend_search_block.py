@@ -54,10 +54,27 @@ def host_scan_single(pages: ColumnarPages, cq, top_k: int):
         scan_kernel,
     )
 
+    from .structural import STRUCTURAL
+
     t0 = time.perf_counter()
     with cpu_pinned():
         host = pad_page_axis(pages, _bucket(pages.n_pages))
         dev = {k: jnp.asarray(v) for k, v in host.items()}
+        # structural predicate on the single-block host route: the
+        # host-only compile attached range tables; span columns stage on
+        # the CPU backend — same kernel, same plan, byte-identical
+        st = getattr(cq, "structural", None)
+        plan = s_tables = span_dev = None
+        if st is not None:
+            plan = st.plan
+            s_tables = tuple(jnp.asarray(t) if t is not None else None
+                             for t in st.tables())
+            if STRUCTURAL.enabled:
+                span_host = STRUCTURAL.stage_single(
+                    pages, _bucket(pages.n_pages))
+                if span_host is not None:
+                    span_dev = {k: jnp.asarray(v)
+                                for k, v in span_host.items()}
         out = scan_kernel(
             dev["kv_key"], dev["kv_val"], dev["entry_start"],
             dev["entry_end"], dev["entry_dur"], dev["entry_valid"],
@@ -65,7 +82,8 @@ def host_scan_single(pages: ColumnarPages, cq, top_k: int):
             jnp.uint32(cq.dur_lo), jnp.uint32(min(cq.dur_hi, 0xFFFFFFFF)),
             jnp.uint32(cq.win_start),
             jnp.uint32(min(cq.win_end, 0xFFFFFFFF)),
-            None, n_terms=cq.n_terms, top_k=top_k)
+            None, None, span_dev, s_tables,
+            n_terms=cq.n_terms, top_k=top_k, plan=plan)
         res = fetch_scan_out(out)
     profile.observe_stage("execute", "host_fallback",
                           time.perf_counter() - t0)
@@ -208,6 +226,9 @@ class BackendSearchBlock:
             if not OWNERSHIP.owns_block(self.meta.block_id):
                 allow_device = False
                 obs.hbm_owner_routed.inc(route="non_owner_host")
+        from tempo_tpu.search import structural as _structural
+
+        expr = _structural.structural_query(req)
         if allow_device:
             try:
                 sp = GUARD.run("h2d", self.staged)
@@ -222,6 +243,21 @@ class BackendSearchBlock:
                         sp.pages.key_dict, sp.pages.val_dict, req,
                         packed_vals=_packed(sp.pages), cache_on=sp.pages,
                         staged_dict=sp.staged_dict)
+                    if cq is not None and expr is not None:
+                        from .pipeline import _dict_fingerprint
+
+                        sd_map = None
+                        if sp.staged_dict is not None:
+                            fp = _dict_fingerprint(
+                                sp.pages, sp.pages.key_dict,
+                                sp.pages.val_dict)
+                            sd_map = {fp: sp.staged_dict}
+                        cq.structural = _structural.compile_structural(
+                            expr, [sp.pages], cache_on=sp.pages,
+                            staged_dicts=sd_map,
+                            entry_kv_slots=sp.pages.geometry.kv_per_entry)
+                        if qs is not None:
+                            qs.add_structural(cq.structural)
                 if cq is None:  # dictionary prefilter pruned the block
                     pruned = True
                 else:
@@ -238,6 +274,12 @@ class BackendSearchBlock:
             cq = compile_query(pages.key_dict, pages.val_dict, req,
                                packed_vals=_packed(pages), cache_on=pages,
                                host_only=True)
+            if cq is not None and expr is not None:
+                cq.structural = _structural.compile_structural(
+                    expr, [pages], cache_on=pages, host_only=True,
+                    entry_kv_slots=pages.geometry.kv_per_entry)
+                if qs is not None:
+                    qs.add_structural(cq.structural)
             if cq is None:
                 pruned = True
             else:

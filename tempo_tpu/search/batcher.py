@@ -46,6 +46,7 @@ from tempo_tpu.observability import profile
 from tempo_tpu.observability import tracing
 
 from . import query_stats
+from . import structural as _structural
 from .engine import DEFAULT_TOP_K, fetch_coalesced_out, resolve_top_k, \
     start_fetch
 from .ownership import OWNERSHIP
@@ -85,6 +86,24 @@ def host_scan(host, mq, top_k: int):
             host._cpu_staged = dev
         tk = jnp.asarray(mq.term_keys)
         vr = jnp.asarray(mq.val_ranges)
+        # structural predicate on the host route: the host-only compile
+        # produced range tables (no device mask is ever touched) and the
+        # span columns stage once per batch on the CPU backend — same
+        # kernel, same plan, byte-identical verdicts
+        st = getattr(mq, "structural", None)
+        plan = s_tables = span_dev = None
+        if st is not None:
+            plan = st.plan
+            s_tables = tuple(
+                (jnp.asarray(t) if t is not None and not hasattr(
+                    t, "devices") else t) for t in st.tables())
+            span_host = getattr(host, "span_cat", None)
+            if span_host is not None:
+                span_dev = getattr(host, "_cpu_span_staged", None)
+                if span_dev is None:
+                    span_dev = {k: jnp.asarray(v)
+                                for k, v in span_host.items()}
+                    host._cpu_span_staged = span_dev
         out = multi_scan_kernel(
             dev["kv_key"], dev["kv_val"], dev["entry_start"],
             dev["entry_end"], dev["entry_dur"], dev["entry_valid"],
@@ -93,11 +112,12 @@ def host_scan(host, mq, top_k: int):
             jnp.uint32(mq.win_start),
             jnp.uint32(min(mq.win_end, 0xFFFFFFFF)),
             None, None, dev.get("entry_dur_res"),
+            span_dev, s_tables,
             n_terms=mq.n_terms, top_k=top_k,
             # the host tier stages the SAME packed layout (stack_host
             # packs before the tiers fork), so the fallback kernel
             # unpacks with the batch's own width descriptor
-            widths=getattr(host, "widths", None))
+            widths=getattr(host, "widths", None), plan=plan)
         count, inspected, scores, idx = out
         res = (int(count), int(inspected), np.asarray(scores),
                np.asarray(idx))
@@ -156,11 +176,16 @@ _PRUNE_CACHE_MAX = 4096  # (group, predicate) header-prune memos kept
 
 def _predicate_sig(req) -> tuple:
     """Everything about the request that affects pruning/compilation —
-    NOT limit (scalar on the MultiQuery, filled per query)."""
+    NOT limit (scalar on the MultiQuery, filled per query). The raw
+    structural tag rides separately: _tags_sig excludes it (it is not a
+    dictionary term), but two requests differing only structurally must
+    not share a prepare() memo."""
     from .pipeline import _tags_sig
+    from .structural import STRUCTURAL_QUERY_TAG
 
     return (_tags_sig(req), req.min_duration_ms or 0,
-            req.max_duration_ms or 0, req.start or 0, req.end or 0)
+            req.max_duration_ms or 0, req.start or 0, req.end or 0,
+            req.tags.get(STRUCTURAL_QUERY_TAG, ""))
 
 
 class _PendingCoalesce:
@@ -309,6 +334,16 @@ class QueryCoalescer:
         import time as _time
 
         fut = concurrent.futures.Future()
+        if getattr(mq, "structural", None) is not None:
+            # structural plans are static kernel descriptors: they can
+            # neither stack along the fused query axis nor share a
+            # window with stackable peers — dispatch solo NOW (the solo
+            # flush path reuses this plan's compiled executable)
+            grp = _PendingCoalesce(batch, 0)
+            grp.items.append((mq, top_k, fut, _time.perf_counter(),
+                              query_stats.current()))
+            self._run(grp)
+            return fut
         flush_now = None
         with self._lock:
             key = id(batch)
@@ -1176,6 +1211,21 @@ class BlockBatcher:
                 return {"all_skip": True, "skipped": len(group),
                         "skip_reasons": _skip_reason_counts(
                             [True] * len(group), reasons)}
+            # structural plan (gated: structural_query reads ONE
+            # attribute when search_structural_enabled is off). Compiled
+            # per (batch, predicate) and memoized with this pre dict; the
+            # host route compiles its own host-only twin (range tables,
+            # no staged dictionary — byte-identical verdicts).
+            st = None
+            expr = _structural.structural_query(req)
+            if expr is not None:
+                blocks = list(holder.blocks)
+                st = _structural.compile_structural(
+                    expr, blocks, cache_on=holder,
+                    staged_dicts=(None if host_only else
+                                  getattr(holder, "staged_dicts", None)),
+                    host_only=host_only,
+                    entry_kv_slots=blocks[0].geometry.kv_per_entry)
             # dictionary-pruned jobs (term key -1 across all terms) count
             # as skipped; under the exhaustive flag nothing is skipped —
             # every page is scanned by definition
@@ -1190,6 +1240,7 @@ class BlockBatcher:
                 "val_ranges": mq.val_ranges,
                 "val_hits": mq.val_hits,
                 "block_group": mq.block_group,
+                "structural": st,
                 "n_terms": mq.n_terms,
                 "dur_lo": mq.dur_lo, "dur_hi": mq.dur_hi,
                 "win_start": mq.win_start, "win_end": mq.win_end,
@@ -1259,25 +1310,33 @@ class BlockBatcher:
                     val_ranges=pre["val_ranges"],
                     dur_lo=pre["dur_lo"], dur_hi=pre["dur_hi"],
                     win_start=pre["win_start"], win_end=pre["win_end"],
-                    limit=req.limit or 20, n_terms=pre["n_terms"])
-                had_cpu = getattr(host, "_cpu_staged", None) is not None
+                    limit=req.limit or 20, n_terms=pre["n_terms"],
+                    structural=pre.get("structural"))
+                if qs is not None and pre.get("structural") is not None:
+                    qs.add_structural(pre["structural"])
                 count, inspected, scores, idx = host_scan(
                     host, mq, resolve_top_k(self.engine.top_k, mq.limit))
-                if not had_cpu \
-                        and getattr(host, "_cpu_staged", None) is not None:
-                    # the CPU-pinned copies host_scan memoized are real
-                    # RAM: charge them to the host-tier budget (evicting
-                    # the entry releases both — _load_host subtracts the
-                    # recorded cpu bytes alongside nbytes)
-                    cpu_b = sum(int(a.nbytes)
-                                for a in host._cpu_staged.values())
+                # the CPU-pinned copies host_scan memoized are real RAM:
+                # charge them to the host-tier budget (evicting the
+                # entry releases both — _load_host subtracts the
+                # recorded cpu bytes alongside nbytes). Delta-charged:
+                # the span-column memo (_cpu_span_staged) can appear on
+                # a LATER structural query after the cat arrays were
+                # already charged, and it must not pin unaccounted RAM.
+                cpu_b = sum(
+                    int(a.nbytes)
+                    for memo in (getattr(host, "_cpu_staged", None),
+                                 getattr(host, "_cpu_span_staged", None))
+                    if memo is not None for a in memo.values())
+                if cpu_b:
                     with self._lock:
-                        if (self._host_cache.get(gkey) is host
-                                and gkey not in self._cpu_staged_bytes):
-                            self._cpu_staged_bytes[gkey] = cpu_b
-                            self._host_total += cpu_b
-                            self._evict_host_locked()
-                            self._publish_gauges_locked()
+                        if self._host_cache.get(gkey) is host:
+                            prev = self._cpu_staged_bytes.get(gkey, 0)
+                            if cpu_b > prev:
+                                self._cpu_staged_bytes[gkey] = cpu_b
+                                self._host_total += cpu_b - prev
+                                self._evict_host_locked()
+                                self._publish_gauges_locked()
                 obs.scan_dispatches.inc(mode="host_fallback")
                 inspected -= pre["entries_skipped"]
                 results.metrics.inspected_blocks += pre["inspected_blocks"]
@@ -1497,7 +1556,13 @@ class BlockBatcher:
                     win_start=pre["win_start"], win_end=pre["win_end"],
                     limit=req.limit or 20, n_terms=pre["n_terms"],
                     val_hits=pre.get("val_hits"),
-                    block_group=pre.get("block_group"))
+                    block_group=pre.get("block_group"),
+                    structural=pre.get("structural"))
+                if qs is not None and pre.get("structural") is not None:
+                    # explain plan registration: node cost weights merge
+                    # across this query's groups; measured device time
+                    # apportions over them at finalize
+                    qs.add_structural(pre["structural"])
                 dp = pre.get("device_params")
                 if dp is not None:
                     # repeated predicates reuse the H2D-uploaded query
@@ -1511,7 +1576,10 @@ class BlockBatcher:
                     # window share ONE fused kernel launch; a dispatch
                     # with no possible same-batch peer (solo search, or
                     # a sibling sub-request over a disjoint batch) flushes
-                    # immediately (no added latency)
+                    # immediately (no added latency). Structural queries
+                    # always flush solo — submit() itself short-circuits
+                    # them (their static plans cannot stack along the
+                    # vmap query axis).
                     with self._lock:
                         peers = (self._interest.get(gkey, 1)
                                  + self._unplanned)

@@ -75,10 +75,31 @@ class ColumnarPages:
     trace_ids: np.ndarray    # uint8 [P,E,16]
     n_entries: int = 0
     header: dict = field(default_factory=dict)
+    # ---- optional span segment (structural query engine) ----
+    # Flat span axis S in build order, per-trace CONTIGUOUS (the segment
+    # property the structural kernels' cumsum reductions and parent
+    # joins rely on); absent (None) for legacy containers and whenever
+    # search_structural_enabled captured no spans at ingest.
+    span_trace: np.ndarray | None = None    # int32 [S] flat entry p*E+e
+    span_parent: np.ndarray | None = None   # int32 [S] flat span idx, -1
+    span_dur: np.ndarray | None = None      # uint32 [S] ms
+    span_kind: np.ndarray | None = None     # int8 [S] OTLP kind
+    span_kv_key: np.ndarray | None = None   # int32 [S, Cs] (pad -1)
+    span_kv_val: np.ndarray | None = None   # int32 [S, Cs] (pad -1)
+    entry_span_begin: np.ndarray | None = None  # int32 [P,E]
+    entry_span_count: np.ndarray | None = None  # int32 [P,E]
 
     @property
     def n_pages(self) -> int:
         return self.kv_key.shape[0]
+
+    @property
+    def has_spans(self) -> bool:
+        return self.span_trace is not None and self.span_trace.size > 0
+
+    @property
+    def n_spans(self) -> int:
+        return 0 if self.span_trace is None else int(self.span_trace.shape[0])
 
     @property
     def nbytes(self) -> int:
@@ -86,7 +107,10 @@ class ColumnarPages:
         over-count toward the parent's full buffers — conservative for a
         byte budget)."""
         return int(sum(getattr(self, name).nbytes
-                       for name, _ in self._ARRAYS))
+                       for name, _ in self._ARRAYS)
+                   + sum(getattr(self, name).nbytes
+                         for name, _ in self._SPAN_ARRAYS
+                         if getattr(self, name) is not None))
 
     def slice_pages(self, start: int, count: int) -> "ColumnarPages":
         """A view over pages [start, start+count) — the unit of the
@@ -99,6 +123,31 @@ class ColumnarPages:
         hdr = dict(self.header)
         hdr["n_pages"] = end - start
         hdr["n_entries"] = int(kw["entry_valid"].sum())
+        if self.has_spans:
+            # spans are per-trace contiguous in build order, so the
+            # slice's span rows are one contiguous run; flat entry and
+            # span indices remap to the slice's origin (copies, not
+            # views — the remap rewrites values)
+            E = self.geometry.entries_per_page
+            begin = self.entry_span_begin[start:end]
+            cnt = self.entry_span_count[start:end]
+            live = cnt > 0
+            if live.any():
+                sb = int(begin[live].min())
+                se = int((begin[live] + cnt[live]).max())
+            else:
+                sb = se = 0
+            kw["span_trace"] = self.span_trace[sb:se] - start * E
+            par = self.span_parent[sb:se].copy()
+            par[par >= 0] -= sb
+            kw["span_parent"] = par
+            for name in ("span_dur", "span_kind",
+                         "span_kv_key", "span_kv_val"):
+                kw[name] = getattr(self, name)[sb:se]
+            kw["entry_span_begin"] = np.where(live, begin - sb,
+                                              0).astype(np.int32)
+            kw["entry_span_count"] = cnt
+            hdr["n_spans"] = se - sb
         out = ColumnarPages(
             geometry=self.geometry, key_dict=self.key_dict,
             val_dict=self.val_dict, n_entries=hdr["n_entries"],
@@ -164,6 +213,8 @@ class ColumnarPages:
 
         keys: set[str] = set()
         vals: set[str] = set()
+        total_spans = 0
+        span_kv_max = 0
         for sd in entries:
             for k, vs in sd.kvs.items():
                 keys.add(k)
@@ -172,6 +223,17 @@ class ColumnarPages:
                 vals.add(sd.root_service)
             if sd.root_name:
                 vals.add(sd.root_name)
+            # span rows share the block dictionaries with the trace-level
+            # rollup: one sorted id space serves both the legacy term
+            # compares and the structural span-leaf compares
+            for sp in getattr(sd, "spans", ()):
+                total_spans += 1
+                width = 0
+                for k, vs in sp.kvs.items():
+                    keys.add(k)
+                    vals.update(vs)
+                    width += len(vs)
+                span_kv_max = max(span_kv_max, width)
         key_dict = sorted(keys)
         val_dict = sorted(vals)
         kidx = {k: i for i, k in enumerate(key_dict)}
@@ -199,12 +261,61 @@ class ColumnarPages:
         entry_root_name = np.full((P, E), -1, dtype=np.int32)
         trace_ids = np.zeros((P, E, 16), dtype=np.uint8)
 
+        # span segment (structural engine): flat arrays in entry order,
+        # per-trace contiguous; Cs sized like C (pow2 of the widest span,
+        # capped) — absent entirely when no entry carries spans, keeping
+        # gate-off containers byte-identical to the legacy layout
+        SPAN_KV_CAP = 64
+        span_arrays = None
+        if total_spans:
+            Cs = 1
+            while Cs < min(span_kv_max, SPAN_KV_CAP):
+                Cs *= 2
+            Cs = min(max(Cs, 1), SPAN_KV_CAP)
+            span_arrays = {
+                "span_trace": np.full(total_spans, -1, dtype=np.int32),
+                "span_parent": np.full(total_spans, -1, dtype=np.int32),
+                "span_dur": np.zeros(total_spans, dtype=np.uint32),
+                "span_kind": np.zeros(total_spans, dtype=np.int8),
+                "span_kv_key": np.full((total_spans, Cs), -1,
+                                       dtype=np.int32),
+                "span_kv_val": np.full((total_spans, Cs), -1,
+                                       dtype=np.int32),
+                "entry_span_begin": np.zeros((P, E), dtype=np.int32),
+                "entry_span_count": np.zeros((P, E), dtype=np.int32),
+            }
+        span_cursor = 0
+
         n_entries = 0
         truncated = 0
         min_start, max_end = 0xFFFFFFFF, 0
         min_dur, max_dur = 0xFFFFFFFF, 0
         for i, sd in enumerate(entries):
             p, e = divmod(i, E)
+            sd_spans = getattr(sd, "spans", ())
+            if span_arrays is not None and sd_spans:
+                sa = span_arrays
+                base = span_cursor
+                sa["entry_span_begin"][p, e] = base
+                sa["entry_span_count"][p, e] = len(sd_spans)
+                for si, sp in enumerate(sd_spans):
+                    row = base + si
+                    sa["span_trace"][row] = i
+                    if 0 <= sp.parent < len(sd_spans):
+                        sa["span_parent"][row] = base + sp.parent
+                    sa["span_dur"][row] = min(sp.dur_ms, 0xFFFFFFFF)
+                    sa["span_kind"][row] = sp.kind & 0x7F
+                    slot = 0
+                    for k in sorted(sp.kvs):
+                        if slot >= Cs:
+                            break
+                        for v in sorted(sp.kvs[k]):
+                            if slot >= Cs:
+                                break
+                            sa["span_kv_key"][row, slot] = kidx[k]
+                            sa["span_kv_val"][row, slot] = vidx[v]
+                            slot += 1
+                span_cursor += len(sd_spans)
             entry_start[p, e] = sd.start_s & 0xFFFFFFFF
             entry_end[p, e] = sd.end_s & 0xFFFFFFFF
             entry_dur[p, e] = min(sd.dur_ms, 0xFFFFFFFF)
@@ -247,6 +358,10 @@ class ColumnarPages:
             "min_dur_ms": 0 if min_dur == 0xFFFFFFFF else min_dur,
             "max_dur_ms": max_dur,
         }
+        if span_arrays is not None:
+            header["n_spans"] = total_spans
+            header["span_kv_per_entry"] = int(
+                span_arrays["span_kv_key"].shape[1])
         return cls(
             geometry=PageGeometry(E, C), key_dict=key_dict, val_dict=val_dict,
             kv_key=kv_key, kv_val=kv_val,
@@ -254,6 +369,7 @@ class ColumnarPages:
             entry_valid=entry_valid, entry_root_svc=entry_root_svc,
             entry_root_name=entry_root_name, trace_ids=trace_ids,
             n_entries=n_entries, header=header,
+            **(span_arrays or {}),
         )
 
     # ------------------------------------------------------------------
@@ -294,6 +410,30 @@ class ColumnarPages:
             sd = slot_index.get((p, e))
             if sd is not None:
                 sd.kvs.setdefault(self.key_dict[k], set()).add(self.val_dict[v])
+        if self.has_spans:
+            # span segment round-trip (search-block compaction rebuilds
+            # merged search data from inputs): flat parent pointers fold
+            # back to intra-trace indices
+            from .data import SpanData
+
+            begins = self.entry_span_begin[ps, es].tolist()
+            counts = self.entry_span_count[ps, es].tolist()
+            for i in range(len(ps)):
+                sd = out[i]
+                b, n = begins[i], counts[i]
+                for row in range(b, b + n):
+                    par = int(self.span_parent[row])
+                    sp = SpanData(
+                        parent=(par - b if par >= 0 else -1),
+                        dur_ms=int(self.span_dur[row]),
+                        kind=int(self.span_kind[row]))
+                    kk = self.span_kv_key[row]
+                    vv = self.span_kv_val[row]
+                    for k, v in zip(kk[kk >= 0].tolist(),
+                                    vv[kk >= 0].tolist()):
+                        sp.kvs.setdefault(self.key_dict[k],
+                                          set()).add(self.val_dict[v])
+                    sd.spans.append(sp)
         return out
 
     # ------------------------------------------------------------------
@@ -306,11 +446,24 @@ class ColumnarPages:
         ("entry_root_svc", np.int32), ("entry_root_name", np.int32),
         ("trace_ids", np.uint8),
     )
+    # optional span-segment sections (structural engine): written only
+    # when the container carries spans, so legacy/gate-off containers
+    # stay byte-identical; readers treat absence as "no spans"
+    _SPAN_ARRAYS = (
+        ("span_trace", np.int32), ("span_parent", np.int32),
+        ("span_dur", np.uint32), ("span_kind", np.int8),
+        ("span_kv_key", np.int32), ("span_kv_val", np.int32),
+        ("entry_span_begin", np.int32), ("entry_span_count", np.int32),
+    )
 
     def to_bytes(self) -> bytes:
         sections: dict[str, bytes] = {}
         for name, _ in self._ARRAYS:
             sections[name] = np.ascontiguousarray(getattr(self, name)).tobytes()
+        if self.has_spans:
+            for name, _ in self._SPAN_ARRAYS:
+                sections[name] = np.ascontiguousarray(
+                    getattr(self, name)).tobytes()
         sections["key_dict"] = _pack_strs(self.key_dict)
         sections["val_dict"] = _pack_strs(self.val_dict)
 
@@ -362,6 +515,22 @@ class ColumnarPages:
             arr = np.frombuffer(buf, dtype=dtype, count=length // np.dtype(dtype).itemsize,
                                 offset=base + off)
             kw[name] = arr.reshape(shapes[name])
+        S = int(hdr.get("n_spans", 0) or 0)
+        if S and "span_trace" in sections:
+            Cs = int(hdr.get("span_kv_per_entry", 1))
+            span_shapes = {
+                "span_trace": (S,), "span_parent": (S,),
+                "span_dur": (S,), "span_kind": (S,),
+                "span_kv_key": (S, Cs), "span_kv_val": (S, Cs),
+                "entry_span_begin": (P, E), "entry_span_count": (P, E),
+            }
+            for name, dtype in cls._SPAN_ARRAYS:
+                off, length = sections[name]
+                arr = np.frombuffer(
+                    buf, dtype=dtype,
+                    count=length // np.dtype(dtype).itemsize,
+                    offset=base + off)
+                kw[name] = arr.reshape(span_shapes[name])
         off, length = sections["key_dict"]
         key_sec = buf[base + off: base + off + length]
         key_dict = _unpack_strs(key_sec)

@@ -28,6 +28,27 @@ _U16 = struct.Struct("<H")
 # hard cap per trace, cf. reference max_search_bytes_per_trace default 5KB
 DEFAULT_MAX_SEARCH_BYTES = 5 << 10
 
+# structural-query span rows: caps applied at extraction so a hostile
+# trace can't explode the columnar span segment (the gate's config
+# knobs search_structural_max_spans / _max_span_kvs override)
+DEFAULT_MAX_SPANS = 512
+DEFAULT_MAX_SPAN_KVS = 16
+
+
+@dataclass
+class SpanData:
+    """One span's summary row for the structural query engine
+    (search/structural.py): parent index WITHIN the trace's span list
+    (-1 = root/unknown parent), duration, OTLP kind, and the span-level
+    kv set (span attributes + "name"/"error", same derivation as the
+    trace-level rollup). Only present when search_structural_enabled
+    captured spans at ingest; legacy data decodes with spans == []."""
+
+    parent: int = -1
+    dur_ms: int = 0
+    kind: int = 0
+    kvs: dict = field(default_factory=dict)  # str -> set[str]
+
 
 @dataclass
 class SearchData:
@@ -38,6 +59,9 @@ class SearchData:
     root_service: str = ""
     root_name: str = ""
     kvs: dict = field(default_factory=dict)  # str -> set[str]
+    # per-span rows (SpanData) — the structural engine's substrate;
+    # empty for legacy data and whenever the gate is off
+    spans: list = field(default_factory=list)
 
     @property
     def start_ns(self) -> int:
@@ -56,15 +80,31 @@ class SearchData:
             self.root_name = other.root_name
         for k, vs in other.kvs.items():
             self.kvs.setdefault(k, set()).update(vs)
+        if other.spans:
+            # span rows append; their intra-trace parent indices shift
+            # by the rows already here (cross-push parent links are not
+            # reconstructable from summaries — those parents stay -1)
+            base = len(self.spans)
+            for sp in other.spans:
+                self.spans.append(SpanData(
+                    parent=(sp.parent + base if sp.parent >= 0 else -1),
+                    dur_ms=sp.dur_ms, kind=sp.kind,
+                    kvs={k: set(vs) for k, vs in sp.kvs.items()}))
 
 
 def extract_search_data(trace_id: bytes, trace: tempopb.Trace,
                         max_bytes: int = DEFAULT_MAX_SEARCH_BYTES,
-                        range_ns: tuple[int, int] | None = None) -> SearchData:
+                        range_ns: tuple[int, int] | None = None,
+                        spans: bool = False) -> SearchData:
     """range_ns: precomputed (start_ns, end_ns) — the distributor already
     walked the spans for it; re-walking per trace was measurable on the
     ingest ack path (profiled r5). The hot kv loop below is deliberately
-    inline (no closure per attribute) for the same reason."""
+    inline (no closure per attribute) for the same reason.
+
+    ``spans=True`` additionally collects per-span summary rows for the
+    structural engine (collect_span_rows) — callers gate this on
+    search_structural_enabled; the default keeps the legacy walk and
+    wire payload exactly."""
     sd = SearchData(trace_id=trace_id)
     if range_ns is None:
         from tempo_tpu.model.matches import trace_range_ns
@@ -150,7 +190,73 @@ def extract_search_data(trace_id: bytes, trace: tempopb.Trace,
                         root = (span.start_time_unix_nano, svc, span.name)
     if root is not None:
         sd.root_service, sd.root_name = root[1], root[2]
+    if spans:
+        from .structural import STRUCTURAL
+
+        sd.spans = collect_span_rows(trace,
+                                     max_spans=STRUCTURAL.max_spans,
+                                     max_kvs=STRUCTURAL.max_span_kvs)
     return sd
+
+
+def collect_span_rows(trace: tempopb.Trace,
+                      max_spans: int = DEFAULT_MAX_SPANS,
+                      max_kvs: int = DEFAULT_MAX_SPAN_KVS) -> list:
+    """Per-span summary rows (SpanData) for the structural engine: one
+    walk over a (regrouped) trace resolving parent pointers by span id.
+    Called by the extraction paths ONLY when search_structural_enabled —
+    the gate-off ingest path never pays this walk and the wire payload
+    stays byte-identical to the legacy form. Rows cap at ``max_spans``
+    in walk order; kvs per span cap at ``max_kvs``."""
+    rows: list[SpanData] = []
+    idx_of: dict[bytes, int] = {}       # span id -> row index
+    parents: list[bytes] = []           # raw parent ids, resolved after
+    ERROR = tempopb.Status.STATUS_CODE_ERROR
+    for batch in trace.batches:
+        svc = ""
+        for kv in batch.resource.attributes:
+            if kv.key == "service.name":
+                svc = kv.value.string_value
+        for ss in batch.scope_spans:
+            for span in ss.spans:
+                if len(rows) >= max_spans:
+                    break
+                st, en = span.start_time_unix_nano, span.end_time_unix_nano
+                sp = SpanData(
+                    parent=-1,
+                    dur_ms=min(max(0, en - st) // 1_000_000, 0xFFFFFFFF)
+                    if en else 0,
+                    kind=int(span.kind),
+                )
+                kvs = sp.kvs
+                n_kv = 0
+                if svc:
+                    kvs["service.name"] = {svc}
+                    n_kv += 1
+                if span.name and n_kv < max_kvs:
+                    kvs["name"] = {span.name}
+                    n_kv += 1
+                if span.status.code == ERROR and n_kv < max_kvs:
+                    kvs["error"] = {"true"}
+                    n_kv += 1
+                for kv in span.attributes:
+                    if n_kv >= max_kvs:
+                        break
+                    v = _any_value_str(kv.value)
+                    if v:
+                        kvs.setdefault(kv.key, set()).add(v)
+                        n_kv += 1
+                if span.span_id:
+                    idx_of.setdefault(bytes(span.span_id), len(rows))
+                parents.append(bytes(span.parent_span_id))
+                rows.append(sp)
+    for i, pid in enumerate(parents):
+        if pid:
+            pi = idx_of.get(pid)
+            # a span can never be its own parent (malformed input)
+            if pi is not None and pi != i:
+                rows[i].parent = pi
+    return rows
 
 
 def _any_value_str(v: tempopb.AnyValue) -> str:
@@ -179,15 +285,25 @@ def search_data_matches(sd: SearchData, req) -> bool:
     if req.end and sd.start_s > req.end:
         return False
     from .pipeline import EXHAUSTIVE_SEARCH_TAG
+    from .structural import STRUCTURAL_QUERY_TAG
 
     for k, v in req.tags.items():
-        if k == EXHAUSTIVE_SEARCH_TAG:
-            continue  # debug flag: forces traversal, is not itself a predicate
+        if k in (EXHAUSTIVE_SEARCH_TAG, STRUCTURAL_QUERY_TAG):
+            continue  # in-band flags: not themselves tag predicates
         vs = sd.kvs.get(k)
         if not vs:
             return False
         if v and not any(v in x for x in vs):
             return False
+    # structural predicate (gated: structural_query reads one attribute
+    # and returns None when search_structural_enabled is off) — the
+    # live/WAL scan path evaluates the host reference semantics, the
+    # same eval the device kernels are differentially fuzzed against
+    from . import structural as _structural
+
+    expr = _structural.structural_query(req)
+    if expr is not None and not _structural.eval_host(expr, sd):
+        return False
     return True
 
 
@@ -211,6 +327,31 @@ def encode_search_data(sd: SearchData) -> bytes:
         for v in vals:
             vb = v.encode("utf-8")[:0xFFFF]
             out += _U16.pack(len(vb)) + vb
+    if sd.spans:
+        # OPTIONAL trailing span section (structural engine): absent for
+        # legacy/gate-off payloads, so the wire form stays byte-identical
+        # whenever no spans were captured; decoders detect it by bytes
+        # remaining past the kv map.
+        #   | u16 n_spans | per span: u16 parent (0xFFFF = -1)
+        #   | u32 dur_ms | u8 kind | u16 n_keys
+        #   | per key: u16 klen k u16 n_vals (u16 vlen v)* |
+        spans = sd.spans[:0xFFFF]
+        out += _U16.pack(len(spans))
+        for sp in spans:
+            p = sp.parent if 0 <= sp.parent < 0xFFFF else 0xFFFF
+            out += _U16.pack(p)
+            out += _U32.pack(min(sp.dur_ms, 0xFFFFFFFF))
+            out.append(sp.kind & 0xFF)
+            skeys = sorted(sp.kvs)
+            out += _U16.pack(len(skeys))
+            for k in skeys:
+                kb = k.encode("utf-8")[:0xFFFF]
+                out += _U16.pack(len(kb)) + kb
+                vals = sorted(sp.kvs[k])
+                out += _U16.pack(len(vals))
+                for v in vals:
+                    vb = v.encode("utf-8")[:0xFFFF]
+                    out += _U16.pack(len(vb)) + vb
     return bytes(out)
 
 
@@ -242,4 +383,16 @@ def decode_search_data(buf: bytes, trace_id: bytes = b"") -> SearchData:
     for _ in range(u16()):
         k = s()
         sd.kvs[k] = {s() for _ in range(u16())}
+    if off < len(buf):
+        # optional span section (see encode_search_data): legacy
+        # payloads end exactly at the kv map
+        for _ in range(u16()):
+            p = u16()
+            sp = SpanData(parent=(-1 if p == 0xFFFF else p),
+                          dur_ms=u32(), kind=buf[off])
+            off += 1
+            for _ in range(u16()):
+                k = s()
+                sp.kvs[k] = {s() for _ in range(u16())}
+            sd.spans.append(sp)
     return sd

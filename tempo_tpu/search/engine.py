@@ -63,6 +63,10 @@ class StagedPages:
     # per staged block, part of the scan kernel's jit shape key; None
     # = the unpacked legacy layout
     widths: tuple | None = None
+    # structural-engine span columns on device (search/structural.py),
+    # staged only when search_structural_enabled AND the container
+    # carries spans; None keeps the legacy kernel signature pytree
+    span_device: dict | None = None
 
 
 DEVICE_ARRAYS = ("kv_key", "kv_val", "entry_start", "entry_end",
@@ -134,13 +138,26 @@ def stage(pages: ColumnarPages, page_bucket: int | None = None,
             len(pages.key_dict), len(pages.val_dict), pages.max_dur_ms())
         if widths is not None:
             host = packing.pack_columns(host, widths)
+    from .structural import STRUCTURAL
+
+    span_host = None
+    if STRUCTURAL.enabled:
+        # structural span segment rides the same staging (gate off =
+        # zero extra work and the identical device pytree)
+        span_host = STRUCTURAL.stage_single(pages, B)
     t0 = time.perf_counter()
     dev = {k: jnp.asarray(v) for k, v in host.items()}
+    span_dev = (None if span_host is None
+                else {k: jnp.asarray(v) for k, v in span_host.items()})
     profile.observe_stage("h2d", "single", time.perf_counter() - t0,
-                          nbytes=sum(int(v.nbytes) for v in host.values()))
+                          nbytes=sum(int(v.nbytes) for v in host.values())
+                          + (0 if span_host is None else
+                             sum(int(v.nbytes)
+                                 for v in span_host.values())))
     sd = stage_block_dict(pages, probe_min_vals)
     return StagedPages(device=dev, n_pages=pages.n_pages, pages=pages,
-                       staged_dict=sd, widths=widths)
+                       staged_dict=sd, widths=widths,
+                       span_device=span_dev)
 
 
 def stage_block_dict(pages: ColumnarPages, probe_min_vals: int | None,
@@ -301,23 +318,34 @@ def masked_topk(mask, entry_start, top_k: int):
     return top_scores, top_idx.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths"))
+@functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths",
+                                             "plan"))
 def scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, term_keys, val_ranges, dur_lo, dur_hi,
                 win_start, win_end, val_hits=None, entry_dur_res=None,
-                *, n_terms: int, top_k: int, widths=None):
+                span_cols=None, s_tables=None,
+                *, n_terms: int, top_k: int, widths=None, plan=None):
     """Returns (match_count i32, inspected i32, topk_scores i32 [k],
     topk_flat_idx i32 [k]) — flat index = page * E + entry. `val_hits`
     (None, bool [T, v_pad], or packed uint32 words) selects the
     device-probe membership path; jit treats None as pytree structure,
     so each variant compiles once. `widths` is the static packed-
-    residency descriptor (search/packing.py)."""
+    residency descriptor (search/packing.py); `plan` + span_cols/
+    s_tables are the structural query lowering (search/structural.py) —
+    its [P,E] verdicts AND into the same mask, one fused dispatch."""
     mask = entry_match_mask(
         kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
         term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end,
         n_terms=n_terms, val_hits=val_hits, entry_dur_res=entry_dur_res,
         widths=widths,
     )
+    if plan is not None:
+        from .structural import structural_entry_mask
+
+        page_block = jnp.zeros(entry_valid.shape[0], dtype=jnp.int32)
+        mask = mask & structural_entry_mask(
+            kv_key, kv_val, entry_dur, entry_valid, page_block,
+            entry_dur_res, span_cols, s_tables, plan=plan, widths=widths)
     count = jnp.sum(mask, dtype=jnp.int32)
     inspected = jnp.sum(entry_valid, dtype=jnp.int32)
     top_scores, top_idx = masked_topk(mask, entry_start, top_k)
@@ -399,19 +427,32 @@ class ScanEngine:
             tk, vr, dlo, dhi, ws, we = self.query_device_params(cq)
         vh = getattr(cq, "val_hits", None)
         widths = getattr(sp, "widths", None)
+        # structural plan (search/structural.py): compiled against this
+        # block and attached to the CompiledQuery; None = the legacy
+        # pytree, same executables as before
+        st = getattr(cq, "structural", None)
+        plan = None if st is None else st.plan
+        s_tables = None if st is None else st.device_tables()
+        span_cols = getattr(sp, "span_device", None) if st is not None \
+            else None
         k = self._resolve_top_k(cq)
         miss = _rec.compile_check(
             ("scan_kernel", d["kv_key"].shape, str(d["kv_key"].dtype),
              str(d["kv_val"].dtype), vr.shape,
              None if vh is None else (tuple(vh.shape), str(vh.dtype)),
-             widths, cq.n_terms, k))
+             widths, cq.n_terms, k,
+             None if st is None else st.shape_sig(),
+             None if span_cols is None else
+             tuple(sorted((n, tuple(a.shape))
+                          for n, a in span_cols.items()))))
         with _rec.stage("compile" if miss else "execute"):
             out = scan_kernel(
                 d["kv_key"], d["kv_val"],
                 d["entry_start"], d["entry_end"], d["entry_dur"],
                 d["entry_valid"],
                 tk, vr, dlo, dhi, ws, we, vh, d.get("entry_dur_res"),
-                n_terms=cq.n_terms, top_k=k, widths=widths,
+                span_cols, s_tables,
+                n_terms=cq.n_terms, top_k=k, widths=widths, plan=plan,
             )
             _rec.fence(out)
         return out

@@ -65,6 +65,10 @@ class BlockBatch:
     # logical side of the physical/logical accounting split (equal to
     # device_nbytes when widths is None)
     logical_device_nbytes: int = 0
+    # structural-engine span columns on device (search/structural.py):
+    # staged with the batch only when search_structural_enabled AND some
+    # block carries spans; None keeps the legacy kernel pytree exactly
+    span_device: dict | None = None
 
     @property
     def n_pages(self) -> int:
@@ -73,11 +77,14 @@ class BlockBatch:
     @property
     def device_nbytes(self) -> int:
         """Physical HBM pinned by the stacked page arrays alone (packed
-        bytes when widths is set)."""
+        bytes when widths is set; span columns included — they are
+        resident with the batch)."""
         hit = getattr(self, "_device_nbytes", None)
         if hit is None:
             hit = self._device_nbytes = int(
-                sum(int(a.nbytes) for a in self.device.values()))
+                sum(int(a.nbytes) for a in self.device.values())
+                + sum(int(a.nbytes)
+                      for a in (self.span_device or {}).values()))
         return hit
 
     @property
@@ -122,11 +129,16 @@ class HostBatch:
     # host-fallback scan runs the packed kernel directly
     widths: tuple | None = None
     cat_logical_nbytes: int = 0
+    # structural span columns, host tier (see BlockBatch.span_device):
+    # the host-fallback scan runs the same structural kernel over these
+    span_cat: dict | None = None
 
     @property
     def cat_nbytes(self) -> int:
         """Physical bytes of the stacked copies alone (the H2D unit)."""
-        return int(sum(a.nbytes for a in self.cat.values()))
+        return int(sum(a.nbytes for a in self.cat.values())
+                   + sum(a.nbytes
+                         for a in (self.span_cat or {}).values()))
 
     @property
     def logical_nbytes(self) -> int:
@@ -142,7 +154,7 @@ class HostBatch:
         # ColumnarPages (needed for result rendering + query compile) —
         # budget against real RAM, not just the cat arrays, or a 32 GB
         # budget pins ~64 GB (code-review r4)
-        return int(sum(a.nbytes for a in self.cat.values())
+        return int(self.cat_nbytes
                    + sum(b.nbytes for b in self.blocks)
                    + sum(d.nbytes for d in self.packed_dicts.values()))
 
@@ -301,12 +313,20 @@ def stack_host(blocks: list[ColumnarPages],
         ])
 
     cat["page_block"] = page_block
+    from .structural import STRUCTURAL
+
+    span_cat = None
+    if STRUCTURAL.enabled:
+        # structural span segments stack alongside the page columns —
+        # gate off is one attribute read and the identical layout
+        span_cat = STRUCTURAL.stack_spans(blocks, E,
+                                          int(page_block.shape[0]))
     entries_padded = int(page_block.shape[0]) * E
     return HostBatch(cat=cat, page_block=page_block, blocks=blocks,
                      page_offset=page_offset,
                      packed_dicts=_pack_batch_dicts(blocks, probe_min_vals,
                                                     n_shards=n_shards),
-                     widths=widths,
+                     widths=widths, span_cat=span_cat,
                      cat_logical_nbytes=(
                          packing.logical_nbytes(entries_padded, C0,
                                                 n_keys, n_vals)
@@ -349,6 +369,24 @@ def place_batch(host: HostBatch, sharding=None, mesh=None) -> BlockBatch:
     # (mode=dict_probe) inside place_device_dict
     profile.observe_stage("h2d", mode, time.perf_counter() - t0,
                           nbytes=sum(int(v.nbytes) for v in cat.values()))
+    span_dev = None
+    if host.span_cat is not None:
+        # span columns REPLICATE (never page-sharded): parent pointers
+        # and segment ranges index the GLOBAL span axis, and the dist
+        # kernels evaluate the structural mask outside shard_map then
+        # hand the [P,E] verdicts to the sharded scan
+        if sharding is not None and jax.process_count() > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(sharding.mesh, P())
+            span_dev = {
+                k: jax.make_array_from_callback(
+                    v.shape, rep, lambda idx, v=v: v[idx])
+                for k, v in host.span_cat.items()
+            }
+        else:
+            span_dev = {k: jnp.asarray(v)
+                        for k, v in host.span_cat.items()}
     staged = {}
     for fp, pd in host.packed_dicts.items():
         dict_mesh = (mesh if mesh is not None and pd.n_shards > 1
@@ -357,7 +395,8 @@ def place_batch(host: HostBatch, sharding=None, mesh=None) -> BlockBatch:
     return BlockBatch(device=dev, page_block=host.page_block,
                       blocks=host.blocks, page_offset=host.page_offset,
                       staged_dicts=staged, widths=host.widths,
-                      logical_device_nbytes=host.cat_logical_nbytes)
+                      logical_device_nbytes=host.cat_logical_nbytes,
+                      span_device=span_dev)
 
 
 def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None,
@@ -390,6 +429,10 @@ class MultiQuery:
     # the kernel directly — no id-set ever crossed the host boundary.
     val_hits: object = None
     block_group: np.ndarray | None = None
+    # compiled structural predicate (structural.CompiledStructural):
+    # static plan + dynamic tables ANDed into the entry mask by the
+    # kernels; None = the legacy pytree and executables exactly
+    structural: object = None
 
 
 def _dict_groups(blocks: list[ColumnarPages], cache_on=None):
@@ -574,6 +617,11 @@ def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
     (dur_lo=1 > dur_hi=0) so their mask is all-false and their top-k is
     all sentinel — dead lanes, not wrong results."""
     Qn = len(mqs)
+    if any(getattr(mq, "structural", None) is not None for mq in mqs):
+        # the coalescer routes structural queries to solo flushes (their
+        # static plans cannot stack along the vmap query axis); a mixed
+        # stack here would silently drop the structural predicate
+        raise ValueError("structural queries cannot be coalesced")
     B = mqs[0].term_keys.shape[0]
     Q = _pow2(Qn)
     T = _pow2(max(1, max(mq.n_terms for mq in mqs)))
@@ -701,12 +749,15 @@ def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
     return mask
 
 
-@functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths"))
+@functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths",
+                                             "plan"))
 def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                       entry_valid, page_block, term_keys, val_ranges,
                       dur_lo, dur_hi, win_start, win_end,
                       val_hits=None, block_group=None, entry_dur_res=None,
-                      *, n_terms: int, top_k: int, widths=None):
+                      span_cols=None, s_tables=None,
+                      *, n_terms: int, top_k: int, widths=None,
+                      plan=None):
     mask = multi_entry_mask(
         kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
         page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
@@ -714,6 +765,15 @@ def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
         block_group=block_group, entry_dur_res=entry_dur_res,
         widths=widths,
     )
+    if plan is not None:
+        # structural predicate (search/structural.py): verdicts fuse
+        # into the same dispatch — compiled from the static plan, never
+        # interpreted
+        from .structural import structural_entry_mask
+
+        mask = mask & structural_entry_mask(
+            kv_key, kv_val, entry_dur, entry_valid, page_block,
+            entry_dur_res, span_cols, s_tables, plan=plan, widths=widths)
     count = jnp.sum(mask, dtype=jnp.int32)
     inspected = jnp.sum(entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
     scores, idx = masked_topk(mask, entry_start, top_k)
@@ -721,19 +781,29 @@ def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("mesh", "n_terms", "top_k", "widths"))
+                   static_argnames=("mesh", "n_terms", "top_k", "widths",
+                                    "plan"))
 def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                            entry_dur, entry_valid, page_block, term_keys,
                            val_ranges, dur_lo, dur_hi, win_start, win_end,
                            val_hits=None, block_group=None,
                            entry_dur_res=None,
-                           *, n_terms: int, top_k: int, widths=None):
+                           span_cols=None, s_tables=None,
+                           *, n_terms: int, top_k: int, widths=None,
+                           plan=None):
     """Multi-block scan sharded over the mesh's scan axis: the stacked
     page axis (blocks × pages — the corpus 'sequence' axis, SURVEY.md §5)
     splits across devices; the [B,...] term tables replicate; counts
     reduce with psum and per-shard top-k candidates all_gather into a
     global top-k — one jit call, collectives riding ICI (the TPU-native
-    Results funnel, reference results.go:38-141)."""
+    Results funnel, reference results.go:38-141).
+
+    The structural predicate (plan + span_cols/s_tables) evaluates
+    OUTSIDE the shard_map over the replicated span columns — parent
+    pointers and segment ranges index the global span axis, which a
+    page-axis shard cannot see — and its [P, E] verdicts enter the
+    sharded region as one more page-sharded operand (GSPMD reshards
+    them; same jit, still one dispatch)."""
     from jax.sharding import PartitionSpec as P
     from tempo_tpu.parallel.mesh import SCAN_AXIS
 
@@ -741,10 +811,18 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
     E = entry_valid.shape[1]
     local_flat = kv_key.shape[0] // n_shards * E
 
+    struct_mask = None
+    if plan is not None:
+        from .structural import structural_entry_mask
+
+        struct_mask = structural_entry_mask(
+            kv_key, kv_val, entry_dur, entry_valid, page_block,
+            entry_dur_res, span_cols, s_tables, plan=plan, widths=widths)
+
     def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                  entry_valid, page_block, term_keys, val_ranges,
                  dur_lo, dur_hi, win_start, win_end, val_hits,
-                 block_group, entry_dur_res):
+                 block_group, entry_dur_res, struct_mask):
         mask = multi_entry_mask(
             kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
             page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
@@ -752,6 +830,8 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
             block_group=block_group, entry_dur_res=entry_dur_res,
             widths=widths,
         )
+        if struct_mask is not None:
+            mask = mask & struct_mask
         local_count = jnp.sum(mask, dtype=jnp.int32)
         local_inspected = jnp.sum(
             entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
@@ -772,15 +852,17 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
         shard_fn, mesh=mesh,
         # the probe hit mask + block->group map replicate like the other
         # predicate tables (a None leaf makes its spec a no-op); the
-        # duration residual shards with the page axis
-        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 8 + (P(SCAN_AXIS),),
+        # duration residual and the structural verdicts shard with the
+        # page axis
+        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 8
+        + (P(SCAN_AXIS), P(SCAN_AXIS)),
         out_specs=(P(), P(), P(), P()),
         # all_gather+top_k yields identical values on every shard, but the
         # replication checker can't infer it through the gather
         check=False,
     )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
       page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
-      win_end, val_hits, block_group, entry_dur_res)
+      win_end, val_hits, block_group, entry_dur_res, struct_mask)
 
 
 @functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths"))
@@ -968,16 +1050,27 @@ class MultiBlockEngine:
                 tk, vr, dlo, dhi, ws, we = ScanEngine.query_device_params(mq)
                 vh = getattr(mq, "val_hits", None)
                 bg = None if vh is None else jnp.asarray(mq.block_group)
+                # structural plan (search/structural.py): static plan in
+                # the jit key, dynamic tables uploaded once per query
+                st = getattr(mq, "structural", None)
+                plan = None if st is None else st.plan
+                s_tables = None if st is None else st.device_tables()
+                span_cols = (batch.span_device if st is not None
+                             else None)
             widths = batch.widths
             args = (d["kv_key"], d["kv_val"], d["entry_start"],
                     d["entry_end"], d["entry_dur"], d["entry_valid"],
                     d["page_block"], tk, vr, dlo, dhi, ws, we, vh, bg,
-                    d.get("entry_dur_res"))
+                    d.get("entry_dur_res"), span_cols, s_tables)
             miss = rec.compile_check(
                 ("multi", self.mesh is not None, d["kv_key"].shape,
                  str(d["kv_key"].dtype), str(d["kv_val"].dtype), vr.shape,
                  None if vh is None else (tuple(vh.shape), str(vh.dtype)),
-                 widths, mq.n_terms, k))
+                 widths, mq.n_terms, k,
+                 None if st is None else st.shape_sig(),
+                 None if span_cols is None else
+                 tuple(sorted((n, tuple(a.shape))
+                              for n, a in span_cols.items()))))
             stage = "compile" if miss else "execute"
             rec.set(kernel="multi", blocks=len(batch.blocks),
                     scan_bytes=batch.device_nbytes)
@@ -990,7 +1083,7 @@ class MultiBlockEngine:
                     with rec.stage(stage):
                         out = dist_multi_scan_kernel(
                             self.mesh, *args, n_terms=mq.n_terms, top_k=k,
-                            widths=widths)
+                            widths=widths, plan=plan)
                 # fence AFTER releasing the collective lock: a fenced
                 # wait under dispatch_lock would serialize every other
                 # mesh dispatch behind this kernel's completion (the
@@ -1002,7 +1095,7 @@ class MultiBlockEngine:
                 return out
             with rec.stage(stage):
                 out = multi_scan_kernel(*args, n_terms=mq.n_terms, top_k=k,
-                                        widths=widths)
+                                        widths=widths, plan=plan)
                 rec.fence(out)
             return out
 

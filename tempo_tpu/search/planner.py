@@ -613,6 +613,19 @@ PLANNER = OffloadPlanner()
 _listener_registered = False
 
 
+def structural_node_seconds(node_bytes: dict) -> dict:
+    """Structural plan nodes registered with the cost model: each node's
+    byte estimate (structural.plan_node_bytes — leaf scans, pointer
+    joins with their doubling log-factor, segment reductions) through
+    the live per-byte scan rate, the SAME EWMA the fused scan kernels
+    calibrate via the dispatch-profiler feed. Consumed by the explain
+    tree's est_ms column and the per-node device-seconds apportionment
+    (one fused kernel has no per-node timer; the conserved split follows
+    this model)."""
+    return {nid: nb * PLANNER.rate("scan", nb)
+            for nid, nb in node_bytes.items()}
+
+
 def stage_veto(block, fp, n_shards: int = 1) -> bool:
     """True when the enabled planner places this dictionary's prefilter
     on HOST at staging time — call sites then skip packing/staging

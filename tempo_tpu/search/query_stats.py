@@ -94,7 +94,8 @@ class QueryStats:
                  "device_stages", "h2d_bytes", "dispatches",
                  "fused_dispatches", "coalesced_with", "planner",
                  "host_probe", "subqueries", "fronted",
-                 "staged_physical", "staged_logical", "_lock")
+                 "staged_physical", "staged_logical", "structural",
+                 "_lock")
 
     def __init__(self, tenant: str, scope: str = "exec",
                  query: dict | None = None):
@@ -126,6 +127,13 @@ class QueryStats:
         # as resident (packed), logical = the unpacked equivalent
         self.staged_physical = 0
         self.staged_logical = 0
+        # structural plan registration (search/structural.py): node id
+        # -> {op, detail, est_bytes} accumulated across this query's
+        # compiled groups; to_dict() apportions the measured device
+        # execute seconds over the byte weights (one fused kernel has no
+        # per-node timer — the conserved split follows the same per-byte
+        # model the planner calibrates)
+        self.structural: dict | None = None
         self.subqueries = 0       # request scope: sub-responses merged
         self.fronted = _FRONTED.get()
         self._lock = threading.Lock()
@@ -188,6 +196,19 @@ class QueryStats:
             self.host_probe["seconds"] += seconds
             self.host_probe["bytes"] += nbytes
 
+    def add_structural(self, compiled) -> None:
+        """Register a compiled structural plan (one per scanned group;
+        plans are identical across a query's groups, byte weights sum)."""
+        with self._lock:
+            if self.structural is None:
+                self.structural = {}
+            for nid, op, detail in compiled.node_info:
+                node = self.structural.get(nid)
+                if node is None:
+                    node = self.structural[nid] = {
+                        "op": op, "detail": detail, "est_bytes": 0}
+                node["est_bytes"] += int(compiled.node_bytes.get(nid, 0))
+
     # ---- derived ----
 
     @property
@@ -242,6 +263,23 @@ class QueryStats:
             self.host_probe["count"] += int(hp.get("count", 0))
             self.host_probe["seconds"] += float(hp.get("ms", 0.0)) / 1e3
             self.host_probe["bytes"] += int(hp.get("bytes", 0))
+            sn = (child.get("structural") or {}).get("nodes")
+            if sn:
+                # sub-responses share one plan (node ids are preorder
+                # positions in the same IR): bytes and measured shares sum
+                if self.structural is None:
+                    self.structural = {}
+                for node in sn:
+                    mine = self.structural.get(node["id"])
+                    if mine is None:
+                        mine = self.structural[node["id"]] = {
+                            "op": node.get("op", "?"),
+                            "detail": node.get("detail", ""),
+                            "est_bytes": 0, "_device_ms": 0.0}
+                    mine["est_bytes"] += int(node.get("est_bytes", 0))
+                    mine["_device_ms"] = (mine.get("_device_ms", 0.0)
+                                          + float(node.get("device_ms",
+                                                           0.0)))
 
     def to_dict(self) -> dict:
         with self._lock:
@@ -271,6 +309,33 @@ class QueryStats:
             if self.staged_physical or self.staged_logical:
                 d["staged_bytes"] = {"physical": self.staged_physical,
                                      "logical": self.staged_logical}
+            if self.structural:
+                # compiled plan tree with per-node device-seconds:
+                # measured execute time apportions over the registered
+                # byte weights (conserved — shares sum to the total).
+                # A first-seen shape books its time as "compile"; the
+                # fallback to the stage total keeps the tree honest
+                # rather than all-zero on cold dispatches.
+                exec_s = (self.device_stages.get("execute")
+                          or sum(self.device_stages.values()))
+                total_b = max(1, sum(n["est_bytes"]
+                                     for n in self.structural.values()))
+                d["structural"] = {
+                    "nodes": [
+                        {"id": nid, "op": n["op"],
+                         **({"detail": n["detail"]} if n["detail"]
+                            else {}),
+                         "est_bytes": n["est_bytes"],
+                         # merged (request-scope) records carry their
+                         # children's measured shares; exec-scope records
+                         # apportion their own execute total
+                         "device_ms": round(
+                             n["_device_ms"] if "_device_ms" in n
+                             else exec_s * (n["est_bytes"] / total_b)
+                             * 1e3, 6)}
+                        for nid, n in sorted(self.structural.items())
+                    ],
+                }
             if self.query:
                 d["query"] = dict(self.query)
             if self.trace_id:
@@ -466,12 +531,24 @@ def query_summary(req) -> dict:
     data exfiltration channel; tags are the operator's own predicates
     though, so keep them)."""
     try:
-        return {
-            "tags": dict(req.tags),
+        tags = dict(req.tags)
+        out = {
+            "tags": tags,
             "limit": req.limit or 20,
             "window_s": ((req.end - req.start)
                          if req.end and req.start else 0),
         }
+        from .structural import STRUCTURAL_QUERY_TAG
+
+        raw = tags.pop(STRUCTURAL_QUERY_TAG, None)
+        if raw is not None:
+            # the reserved transport tag is percent-quoted JSON — the
+            # slow log / debug ring should show the operator's query,
+            # not its wire escaping
+            import urllib.parse
+
+            out["structural_q"] = urllib.parse.unquote(raw)
+        return out
     except Exception:  # noqa: BLE001 — diagnostics never fail a query
         return {}
 

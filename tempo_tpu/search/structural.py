@@ -1,0 +1,1003 @@
+"""Structural query engine: compile the IR onto the fused scan kernels.
+
+The front half (tempo_tpu/search/ir.py) parses a typed query tree —
+span-scope leaves, AND/OR/NOT, parent-child / descendant relations,
+count and duration-quantile aggregates. This module is the back half,
+the TiLT idiom (arxiv 2301.12030): the tree is COMPILED, not
+interpreted — lowering walks the static plan descriptor at jax trace
+time and emits one fused XLA computation that evaluates the whole
+query as vectorized array ops over the staged columns, where the data
+already lives (the Taurus near-data argument, arxiv 2506.20010):
+
+  - **leaf predicates** reuse the scan engines' membership machinery:
+    tag terms probe the block dictionaries through the SAME host
+    (memmem → id ranges) and device (packed-dictionary kernel → hit
+    mask) paths query compilation uses, and the kernel-side membership
+    test is the same range-compare / ``mask_select_grouped`` lookup —
+    bit-packed masks and packed-width entry columns (``unpack_ids`` /
+    ``duration_ok``) included;
+  - **structural relations** lower to vectorized parent-pointer joins
+    over the per-trace span segments: ``child`` is one gather through
+    the parent-pointer column; ``desc`` is pointer-doubling (a log-many
+    static unroll over the padded span axis — jit cache keys stay
+    shape-only);
+  - **aggregates** lower to segment reductions (one cumsum + two
+    gathers per count, via the per-entry span-range columns) whose
+    [P, E] verdicts AND into the legacy entry mask feeding the existing
+    masked top-k;
+  - **quantiles** lower to exact integer COUNT predicates
+    (nearest-rank: ``p_q >= X  <=>  #(dur >= X) >= n - ceil(q*n) + 1``)
+    so host and device agree bit-for-bit with no sorting and no floats.
+
+``eval_host`` is the reference evaluator — plain python over
+``SearchData.spans`` — used by the live/WAL scan path, the proto
+fallback scan, and the differential fuzzer that pins compiled == host
+byte-for-byte across every engine path.
+
+Noop contract: ``search_structural_enabled`` off means
+``structural_query()`` reads one attribute and returns None; legacy
+requests take the existing byte-identical path (the noop-contract
+checker registers both the gate function and the staging call sites).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from . import ir
+
+# reserved in-band request tag carrying the percent-quoted compact JSON
+# IR (the EXHAUSTIVE_SEARCH_TAG idiom): the structural query survives
+# the frontend <-> querier SearchRequest proto round-trip and the URL
+# tags encoding without a schema change. Never itself a tag predicate —
+# every term-probing site excludes it alongside the exhaustive flag.
+STRUCTURAL_QUERY_TAG = "x-structural-q"
+
+_PARSE_CACHE_MAX = 256
+
+
+class StructuralGate:
+    """Process-wide gate + knobs (the PACKING/OWNERSHIP singleton
+    idiom). ``enabled`` is read ONCE per request by structural_query;
+    everything else in this module only runs behind it."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.max_spans = 512      # span rows captured per trace at ingest
+        self.max_span_kvs = 16    # kv pairs captured per span at ingest
+        self.max_nodes = ir.MAX_NODES  # parse-time IR size cap
+        self._parse_cache: OrderedDict = OrderedDict()
+        self._parse_lock = threading.Lock()
+
+    # ---- staging (called behind `if STRUCTURAL.enabled` guards — the
+    # noop-contract GuardedCall rule pins the call-site shape) ----
+
+    def stack_spans(self, blocks: list, E: int, pad_pages: int) -> dict | None:
+        """Stack the blocks' span segments for a batched staging:
+        flat span arrays concatenate with per-block index remaps (trace
+        index += page offset * E, parent/begin += span base), the span
+        axis pads to a power of two (shape-only jit keys), and a
+        per-span block-row column is precomputed so leaf tables gather
+        per block exactly like the page kernels do. Returns the host
+        numpy dict, or None when no block carries spans."""
+        if not any(getattr(b, "has_spans", False) for b in blocks):
+            return None
+        total = sum(b.n_spans for b in blocks)
+        S = _pow2(max(1, total))
+        Cs = max(b.span_kv_key.shape[1] for b in blocks if b.has_spans)
+        cols = {
+            "span_trace": np.full(S, -1, dtype=np.int32),
+            "span_parent": np.full(S, -1, dtype=np.int32),
+            "span_block": np.zeros(S, dtype=np.int32),
+            "span_dur": np.zeros(S, dtype=np.uint32),
+            "span_kind": np.zeros(S, dtype=np.int8),
+            "span_kv_key": np.full((S, Cs), -1, dtype=np.int32),
+            "span_kv_val": np.full((S, Cs), -1, dtype=np.int32),
+            "entry_span_begin": np.zeros((pad_pages, E), dtype=np.int32),
+            "entry_span_count": np.zeros((pad_pages, E), dtype=np.int32),
+        }
+        base = 0
+        page_off = 0
+        for bi, b in enumerate(blocks):
+            P = b.n_pages
+            if getattr(b, "has_spans", False):
+                n = b.n_spans
+                cols["span_trace"][base:base + n] = \
+                    b.span_trace + page_off * E
+                par = b.span_parent.astype(np.int32, copy=True)
+                par[par >= 0] += base
+                cols["span_parent"][base:base + n] = par
+                cols["span_block"][base:base + n] = bi
+                cols["span_dur"][base:base + n] = b.span_dur
+                cols["span_kind"][base:base + n] = b.span_kind
+                cols["span_kv_key"][base:base + n, :b.span_kv_key.shape[1]] \
+                    = b.span_kv_key
+                cols["span_kv_val"][base:base + n, :b.span_kv_val.shape[1]] \
+                    = b.span_kv_val
+                cnt = b.entry_span_count
+                cols["entry_span_begin"][page_off:page_off + P] = \
+                    np.where(cnt > 0, b.entry_span_begin + base, 0)
+                cols["entry_span_count"][page_off:page_off + P] = cnt
+                base += n
+            page_off += P
+        return cols
+
+    def stage_single(self, pages, pad_pages: int) -> dict | None:
+        """Single-block variant of stack_spans (engine.stage)."""
+        return self.stack_spans([pages], pages.geometry.entries_per_page,
+                                pad_pages)
+
+
+STRUCTURAL = StructuralGate()
+
+
+def configure(enabled: bool | None = None, max_spans: int | None = None,
+              max_span_kvs: int | None = None) -> StructuralGate:
+    """Apply TempoDBConfig.search_structural_* to the process gate (most
+    recent TempoDB wins — the PACKING/OWNERSHIP idiom)."""
+    if enabled is not None:
+        STRUCTURAL.enabled = bool(enabled)
+    if max_spans is not None:
+        STRUCTURAL.max_spans = max(1, int(max_spans))
+    if max_span_kvs is not None:
+        STRUCTURAL.max_span_kvs = max(1, int(max_span_kvs))
+    return STRUCTURAL
+
+
+def structural_query(req) -> "ir.TraceExpr | None":
+    """THE gate: the request's parsed structural IR, or None — one
+    attribute read (plus one tag-membership test) when
+    search_structural_enabled is off, one dict get when the request
+    carries no structural tag. A request CARRYING the tag against a
+    disabled gate is refused as a client error at this shared altitude
+    — every transport (HTTP, gRPC search_recent/search_block/
+    search_blocks, live/WAL scans) must answer 400/INVALID_ARGUMENT,
+    never a silent legacy-scan superset. Parse results memoize by the
+    raw quoted form (dashboards repeat their queries verbatim); a
+    malformed value that bypassed API validation surfaces as
+    InvalidArgument too, never a 500 from deep in compile."""
+    if not STRUCTURAL.enabled:
+        if STRUCTURAL_QUERY_TAG in req.tags:
+            from tempo_tpu.api.params import InvalidArgument
+
+            raise InvalidArgument(
+                "structural queries disabled "
+                "(storage.search_structural_enabled: true enables)")
+        return None
+    raw = req.tags.get(STRUCTURAL_QUERY_TAG, "")
+    if not raw:
+        return None
+    with STRUCTURAL._parse_lock:
+        hit = STRUCTURAL._parse_cache.get(raw)
+        if hit is not None:
+            STRUCTURAL._parse_cache.move_to_end(raw)
+            return hit
+    try:
+        expr = ir.parse_quoted(raw)
+    except ir.IRSyntaxError as e:
+        from tempo_tpu.api.params import InvalidArgument
+
+        raise InvalidArgument(f"bad structural query: {e}") from None
+    with STRUCTURAL._parse_lock:
+        STRUCTURAL._parse_cache[raw] = expr
+        while len(STRUCTURAL._parse_cache) > _PARSE_CACHE_MAX:
+            STRUCTURAL._parse_cache.popitem(last=False)
+    return expr
+
+
+def attach_query(req, expr: "ir.TraceExpr") -> None:
+    """Stow an IR tree on a request (the API layer's parse product):
+    canonical compact JSON, percent-quoted, in the reserved tag."""
+    req.tags[STRUCTURAL_QUERY_TAG] = ir.quote(ir.to_json(expr))
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# compilation: IR -> (static plan descriptor, dynamic parameter tables)
+
+
+@dataclass
+class CompiledStructural:
+    """One query's compiled structural predicate against one staged
+    batch: ``plan`` is the STATIC descriptor (nested tuples of ops and
+    leaf indices — part of every consuming kernel's jit key, exactly
+    like the packed-residency ``widths``), the tables are dynamic
+    arrays (thresholds, per-block term ids, value ranges / probe
+    masks), so two queries with the same SHAPE of plan share one
+    compiled executable and only the parameters change."""
+
+    plan: tuple
+    term_keys: np.ndarray | None      # int32 [B, T]
+    val_ranges: np.ndarray | None     # int32 [B, T, R, 2]
+    val_hits: object = None           # device [G, T, Vm] (bool/packed)
+    block_group: np.ndarray | None = None   # int32 [B]
+    dur_params: np.ndarray | None = None    # uint32 [D, 2]
+    kind_params: np.ndarray | None = None   # int32 [K]
+    agg_params: np.ndarray | None = None    # uint32 [A, 3]
+    # cost-model registration: node id (preorder) -> estimated bytes
+    # touched on device; the planner's live scan rate turns these into
+    # predicted seconds, and measured kernel time apportions across
+    # them for the explain tree (docs/search-structural-queries.md)
+    node_bytes: dict = field(default_factory=dict)
+    node_info: list = field(default_factory=list)  # (nid, op, detail)
+
+    def tables(self) -> tuple:
+        """The dynamic-argument pytree every kernel receives."""
+        return (self.term_keys, self.val_ranges, self.val_hits,
+                self.block_group, self.dur_params, self.kind_params,
+                self.agg_params)
+
+    def device_tables(self):
+        """Tables as device arrays, uploaded once per compiled query
+        (the query_device_params idiom — re-putting per dispatch costs
+        ~ms each through a relay)."""
+        import jax.numpy as jnp
+
+        cached = getattr(self, "_device_tables", None)
+        if cached is None:
+            cached = tuple(
+                (jnp.asarray(t) if isinstance(t, np.ndarray) else t)
+                for t in self.tables())
+            self._device_tables = cached
+        return cached
+
+    def shape_sig(self) -> tuple:
+        """Jit-cache contribution: the plan IS shape (static), plus the
+        dynamic tables' shapes/dtypes."""
+        def sig(t):
+            return None if t is None else (tuple(t.shape), str(t.dtype))
+        return (self.plan,) + tuple(sig(t) for t in self.tables())
+
+    def explain(self, measured_device_s: float | None = None,
+                rate_s_per_byte: float | None = None) -> dict:
+        """The compiled plan tree for ?explain=1: per node the op, its
+        parameters, estimated cost, and — when a measured kernel total
+        is given — its apportioned share of the real device-seconds
+        (cost-model-weighted: one fused kernel cannot be timed
+        per-node, so the conserved split follows the same per-byte
+        model the planner calibrates)."""
+        from . import planner
+
+        total_bytes = max(1, sum(self.node_bytes.values()))
+        out: dict = {"nodes": []}
+        for nid, op, detail in self.node_info:
+            nb = self.node_bytes.get(nid, 0)
+            rate = (rate_s_per_byte if rate_s_per_byte is not None
+                    else planner.PLANNER.rate("scan", nb))
+            node = {"id": nid, "op": op, "est_bytes": int(nb),
+                    "est_ms": round(nb * rate * 1e3, 6)}
+            if detail:
+                node["detail"] = detail
+            if measured_device_s is not None:
+                node["device_ms"] = round(
+                    measured_device_s * (nb / total_bytes) * 1e3, 6)
+            out["nodes"].append(node)
+        return out
+
+
+class StructuralCompileError(ValueError):
+    """Internal compile failure — the API layer maps it to 400 like a
+    parse error (it is always rooted in the query, never the corpus)."""
+
+
+def compile_structural(expr: "ir.TraceExpr", blocks: list,
+                       cache_on=None, staged_dicts: dict | None = None,
+                       host_only: bool = False,
+                       entry_kv_slots: int = 1) -> CompiledStructural:
+    """Lower an IR tree against a batch's blocks: collect leaves, probe
+    every distinct dictionary ONCE per leaf set (reusing the host memmem
+    / device packed-probe paths with the exhaustive contract — leaves
+    must never block-prune, an unmatched leaf is simply False for that
+    block), and assemble block-indexed tables exactly like
+    compile_multi does for the legacy terms. ``host_only`` is the
+    breaker/host-route contract: no staged dictionary is consulted and
+    the product carries host range tables only."""
+    leaves = _LeafCollector()
+    plan = leaves.lower_trace(expr)
+    B = max(1, len(blocks))
+
+    term_keys = val_ranges = val_hits = block_group = None
+    if leaves.terms:
+        term_keys, val_ranges, val_hits, block_group = _assemble_terms(
+            leaves.terms, blocks, cache_on=cache_on,
+            staged_dicts=staged_dicts, host_only=host_only)
+    dur_params = (np.asarray(leaves.durs, dtype=np.uint32)
+                  if leaves.durs else None)
+    kind_params = (np.asarray(leaves.kinds, dtype=np.int32)
+                   if leaves.kinds else None)
+    agg_params = (np.asarray(leaves.aggs, dtype=np.uint32)
+                  if leaves.aggs else None)
+
+    cs = CompiledStructural(
+        plan=plan, term_keys=term_keys, val_ranges=val_ranges,
+        val_hits=val_hits, block_group=block_group,
+        dur_params=dur_params, kind_params=kind_params,
+        agg_params=agg_params, node_info=leaves.node_info)
+    # cost-model registration happens against batch-independent proxies
+    # here; the engines refresh with real staged sizes at dispatch
+    cs.node_bytes = plan_node_bytes(
+        plan, n_spans=sum(getattr(b, "n_spans", 0) for b in blocks),
+        n_entries=sum(
+            getattr(b, "n_pages", 1)
+            * getattr(getattr(b, "geometry", None), "entries_per_page",
+                      1024)
+            for b in blocks),
+        span_kv_slots=max(
+            [b.span_kv_key.shape[1] for b in blocks
+             if getattr(b, "has_spans", False)] or [1]),
+        entry_kv_slots=entry_kv_slots)
+    _ = B
+    return cs
+
+
+class _LeafCollector:
+    """IR walk: dedupe leaves into parameter tables and emit the static
+    plan descriptor. Node ids are preorder positions (stable across
+    host and device, and across sub-requests of one query — the
+    frontend merges explain nodes by id)."""
+
+    def __init__(self) -> None:
+        self.terms: list[tuple[str, str]] = []
+        self._term_idx: dict[tuple[str, str], int] = {}
+        self.durs: list[tuple[int, int]] = []
+        self._dur_idx: dict[tuple[int, int], int] = {}
+        self.kinds: list[int] = []
+        self._kind_idx: dict[int, int] = {}
+        self.aggs: list[tuple[int, int, int]] = []
+        self.node_info: list[tuple[int, str, str]] = []
+        self._next_id = 0
+
+    def _nid(self, op: str, detail: str = "") -> int:
+        nid = self._next_id
+        self._next_id += 1
+        self.node_info.append((nid, op, detail))
+        return nid
+
+    def _term(self, key: str, value: str) -> int:
+        t = (key, value)
+        i = self._term_idx.get(t)
+        if i is None:
+            i = self._term_idx[t] = len(self.terms)
+            self.terms.append(t)
+        return i
+
+    def _dur(self, lo: int, hi: int) -> int:
+        d = (lo, hi)
+        i = self._dur_idx.get(d)
+        if i is None:
+            i = self._dur_idx[d] = len(self.durs)
+            self.durs.append(d)
+        return i
+
+    def _kind(self, k: int) -> int:
+        i = self._kind_idx.get(k)
+        if i is None:
+            i = self._kind_idx[k] = len(self.kinds)
+            self.kinds.append(k)
+        return i
+
+    def lower_span(self, e: "ir.SpanExpr") -> tuple:
+        if isinstance(e, ir.SpanTag):
+            nid = self._nid("span.tag", f"{e.key}~{e.value}")
+            return ("tag", nid, self._term(e.key, e.value))
+        if isinstance(e, ir.SpanDur):
+            nid = self._nid("span.dur", f"[{e.lo_ms},{e.hi_ms}]ms")
+            return ("dur", nid, self._dur(e.lo_ms, e.hi_ms))
+        if isinstance(e, ir.SpanKind):
+            nid = self._nid("span.kind", str(e.kind))
+            return ("kind", nid, self._kind(e.kind))
+        if isinstance(e, ir.SpanAnd):
+            nid = self._nid("span.and")
+            return ("and", nid, tuple(self.lower_span(a) for a in e.args))
+        if isinstance(e, ir.SpanOr):
+            nid = self._nid("span.or")
+            return ("or", nid, tuple(self.lower_span(a) for a in e.args))
+        if isinstance(e, ir.SpanNot):
+            nid = self._nid("span.not")
+            return ("not", nid, self.lower_span(e.arg))
+        if isinstance(e, ir.ChildOf):
+            nid = self._nid("child", "parent-pointer join")
+            return ("child", nid, self.lower_span(e.parent),
+                    self.lower_span(e.child))
+        if isinstance(e, ir.DescOf):
+            nid = self._nid("desc", "pointer-doubling ancestor join")
+            return ("desc", nid, self.lower_span(e.anc),
+                    self.lower_span(e.span))
+        raise StructuralCompileError(
+            f"unknown span node {type(e).__name__}")
+
+    def lower_trace(self, e: "ir.TraceExpr") -> tuple:
+        if isinstance(e, ir.TraceTag):
+            nid = self._nid("trace.tag", f"{e.key}~{e.value}")
+            return ("ttag", nid, self._term(e.key, e.value))
+        if isinstance(e, ir.TraceDur):
+            nid = self._nid("trace.dur", f"[{e.lo_ms},{e.hi_ms}]ms")
+            return ("tdur", nid, self._dur(e.lo_ms, e.hi_ms))
+        if isinstance(e, ir.Exists):
+            nid = self._nid("exists", "segment reduce")
+            return ("exists", nid, self.lower_span(e.of))
+        if isinstance(e, ir.Count):
+            nid = self._nid("count", f"{e.op} {e.n}")
+            ai = len(self.aggs)
+            self.aggs.append((e.n, 0, 0))
+            return ("count", nid, e.op, ai, self.lower_span(e.of))
+        if isinstance(e, ir.Quantile):
+            nid = self._nid(
+                "quantile",
+                f"p{e.q_num}/{e.q_den} {e.op} {e.x_ms}ms (rank counts)")
+            ai = len(self.aggs)
+            self.aggs.append((e.q_num, e.q_den, e.x_ms))
+            return ("q", nid, e.op, ai, self.lower_span(e.of))
+        if isinstance(e, ir.TraceAnd):
+            nid = self._nid("and")
+            return ("and", nid, tuple(self.lower_trace(a) for a in e.args))
+        if isinstance(e, ir.TraceOr):
+            nid = self._nid("or")
+            return ("or", nid, tuple(self.lower_trace(a) for a in e.args))
+        if isinstance(e, ir.TraceNot):
+            nid = self._nid("not")
+            return ("not", nid, self.lower_trace(e.arg))
+        raise StructuralCompileError(
+            f"unknown trace node {type(e).__name__}")
+
+
+def _assemble_terms(terms: list, blocks: list, cache_on=None,
+                    staged_dicts: dict | None = None,
+                    host_only: bool = False):
+    """Per-block leaf term tables, one dictionary probe per DISTINCT
+    dictionary (the compile_multi economics): [B, T] key ids,
+    [B, T, R, 2] ranges, and — when a staged dictionary's device probe
+    answered — [G, T, Vm] hit masks with the block -> group map.
+    Reuses pipeline's probe internals so the host memmem path, the
+    device packed-probe kernel, bit-packed masks, breaker fallback and
+    watchdog bounds are all the SAME code the legacy terms run."""
+    from . import packing
+    from .multiblock import _dict_groups
+    from .pipeline import _host_probe_tags
+
+    import jax.numpy as jnp
+
+    staged_dicts = staged_dicts or {}
+    fp_of, rep_idx, rows_of = _dict_groups(blocks, cache_on=cache_on)
+    T = len(terms)
+    compiled: dict[bytes, tuple] = {}
+    for fp, i in rep_idx.items():
+        b = blocks[i]
+        compiled[fp] = _probe_leaf_terms(
+            b, terms, None if host_only else staged_dicts.get(fp),
+            host_only=host_only)
+
+    B = len(blocks)
+    rmax = 1
+    for tk, tv, vr, vh in compiled.values():
+        if vr is not None:
+            rmax = max(rmax, vr.shape[1])
+    R = _pow2(rmax)
+    term_keys = np.full((B, T), -1, dtype=np.int32)
+    val_ranges = np.tile(np.array([1, 0], dtype=np.int32), (B, T, R, 1))
+    for fp, (tk, _tv, vr, _vh) in compiled.items():
+        rows = np.asarray(rows_of[fp], dtype=np.int64)
+        term_keys[rows[:, None], np.arange(T)] = tk
+        r_n = vr.shape[1]
+        val_ranges[rows[:, None, None], np.arange(T)[:, None],
+                   np.arange(r_n)] = vr[:, :r_n]
+
+    probe_fps = [fp for fp, c in compiled.items() if c[3] is not None]
+    val_hits = block_group = None
+    if probe_fps:
+        hs = {fp: compiled[fp][3] for fp in probe_fps}
+        if any(packing.is_packed_mask(h) for h in hs.values()):
+            hs = {fp: packing.pack_mask_words(h) for fp, h in hs.items()}
+        Vm = max(int(h.shape[1]) for h in hs.values())
+        padded = [jnp.pad(hs[fp], ((0, 0), (0, Vm - hs[fp].shape[1])))
+                  for fp in probe_fps]
+        val_hits = jnp.stack(padded)                     # [G, T, Vm]
+        block_group = np.full(B, -1, dtype=np.int32)
+        for g, fp in enumerate(probe_fps):
+            block_group[np.asarray(rows_of[fp], dtype=np.int64)] = g
+    _ = _host_probe_tags  # referenced via _probe_leaf_terms
+    return term_keys, val_ranges, val_hits, block_group
+
+
+_LEAF_CACHE_MAX = 8
+# one lock for every block's leaf-probe LRU (the _compile_cache_lock
+# idiom): concurrent structural searches over one block must not race
+# the OrderedDict get/move/evict protocol
+_leaf_cache_lock = threading.Lock()
+
+
+def _probe_leaf_terms(block, terms: list, staged_dict, host_only: bool):
+    """One dictionary's leaf-term probe, memoized on the immutable
+    container: (term_keys [T], term_vals, val_ranges [T,R,2], val_hits)
+    — the exhaustive contract (missing key -> -1 row, empty value set
+    -> empty ranges) because a structural leaf must evaluate False, not
+    prune the block. Device products cache separately from host ones so
+    the host route never touches a wedged device's arrays."""
+    from .pipeline import (_device_probe_tags, _host_probe_tags,
+                           NATIVE_SCAN_THRESHOLD)
+
+    sig = (tuple(terms), bool(staged_dict is not None and not host_only))
+    with _leaf_cache_lock:
+        cache = getattr(block, "_structural_leaf_cache", None)
+        if cache is None:
+            cache = block._structural_leaf_cache = OrderedDict()
+        hit = cache.get(sig)
+        if hit is not None:
+            cache.move_to_end(sig)
+            return hit
+    out = None
+    if staged_dict is not None and not host_only:
+        from tempo_tpu.robustness import BREAKER, GUARD, DeviceFault
+
+        if not BREAKER.blocking():
+            try:
+                out = GUARD.run(
+                    "dict_probe",
+                    lambda: _device_probe_tags(
+                        terms, block.key_dict, staged_dict,
+                        exhaustive=True))
+            except (ValueError, DeviceFault):
+                out = None  # oversized needle / wedged probe: host path
+    if out is None:
+        from tempo_tpu.ops import native
+
+        packed = (block.packed_val_dict()
+                  if native.available()
+                  and len(block.val_dict) >= NATIVE_SCAN_THRESHOLD
+                  else None)
+        out = _host_probe_tags(terms, block.key_dict, block.val_dict,
+                               packed, True)
+    with _leaf_cache_lock:
+        cache[sig] = out
+        while len(cache) > _LEAF_CACHE_MAX:
+            cache.popitem(last=False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device lowering: the kernel-side mask (called INSIDE the jitted scan
+# kernels; `plan` is static at every call site — the jit-purity lint's
+# descriptor rule pins that, like the packed-residency `widths`)
+
+
+def structural_entry_mask(kv_key, kv_val, entry_dur, entry_valid,
+                          page_block, entry_dur_res, span_cols, tables,
+                          *, plan, widths):
+    """[P, E] bool trace verdicts for a compiled structural plan.
+    Recursion over the STATIC plan runs at trace time and emits one
+    fused computation — compiled, never interpreted per row. Span-level
+    sub-plans evaluate to [S] masks over the padded span axis;
+    aggregates reduce them to [P, E] through the per-entry span-range
+    columns; trace-level leaves evaluate on the entry columns with the
+    same unpack/membership code paths the legacy kernel uses. ``plan``
+    (like the packed-residency ``widths``) is a static descriptor at
+    every call site — the jit-purity lint's descriptor rule pins it."""
+    import jax.numpy as jnp
+
+    safe_pb = jnp.maximum(page_block, 0)
+    valid = entry_valid & (page_block >= 0)[:, None]
+    (term_keys, val_ranges, val_hits, block_group,
+     dur_params, kind_params, agg_params) = tables
+    bg_page = None
+    if val_hits is not None and block_group is not None:
+        bg_page = block_group[safe_pb]                   # [P]
+    sctx = None
+    if span_cols is not None:
+        s_block = jnp.maximum(span_cols["span_block"], 0)
+        bg_span = None
+        if val_hits is not None and block_group is not None:
+            bg_span = block_group[s_block]               # [S]
+        sctx = (span_cols["span_trace"] >= 0,            # s_valid
+                s_block,
+                span_cols["span_parent"],
+                span_cols["span_dur"],
+                span_cols["span_kind"],
+                span_cols["span_kv_key"],
+                span_cols["span_kv_val"],
+                span_cols["entry_span_begin"],
+                span_cols["entry_span_count"],
+                bg_span)
+    ectx = (kv_key, kv_val, entry_dur, entry_dur_res, valid, safe_pb,
+            bg_page)
+    return _trace_mask(plan, ectx, sctx, tables, widths) & valid
+
+
+def _seg_count(m, seg_b, seg_n):
+    """Matched spans per entry: exclusive cumsum + two gathers — a
+    segment reduction with no scatter (the VPU lesson)."""
+    import jax.numpy as jnp
+
+    c = jnp.cumsum(m.astype(jnp.int32))
+    exc = jnp.concatenate([jnp.zeros(1, jnp.int32), c])
+    return exc[seg_b + seg_n] - exc[seg_b]
+
+
+def _cmp_dev(a, b, op):
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == "==":
+        return a == b
+    return a != b
+
+
+def _span_mask(plan, sctx, tables, widths):
+    """[S] bool mask for a span-level plan node. This is a DESCRIPTOR
+    DISPATCHER over `plan` (branch structure decided at trace time):
+    callers must pass the static plan, never traced data — the
+    jit-purity lint's descriptor rule pins that contract."""
+    import jax.numpy as jnp
+
+    from .packing import mask_select_grouped
+
+    if plan is None:
+        raise StructuralCompileError("span plan must not be None")
+    (s_valid, s_block, s_par, s_dur, s_kind, s_kk, s_vv,
+     _seg_b, _seg_n, bg_span) = sctx
+    (term_keys, val_ranges, val_hits, _bg, dur_params, kind_params,
+     _agg) = tables
+    op = plan[0]
+    if op == "tag":
+        i = plan[2]
+        k_per = term_keys[s_block, i]                    # [S]
+        keym = s_kk == k_per[:, None]                    # [S,Cs]
+        lo = val_ranges[s_block, i, :, 0]                # [S,R]
+        hi = val_ranges[s_block, i, :, 1]
+        v = s_vv[..., None]                              # [S,Cs,1]
+        valm = ((v >= lo[:, None, :]) &
+                (v <= hi[:, None, :])).any(-1)           # [S,Cs]
+        if bg_span is not None:
+            safe_g = jnp.maximum(bg_span, 0)
+            safe_v = jnp.maximum(s_vv, 0).astype(jnp.int32)
+            mh = (mask_select_grouped(val_hits, safe_g[:, None], i,
+                                      safe_v)
+                  & (s_vv >= 0))
+            valm = jnp.where((bg_span >= 0)[:, None], mh, valm)
+        return jnp.any(keym & valm, axis=-1) & s_valid
+    if op == "dur":
+        i = plan[2]
+        return ((s_dur >= dur_params[i, 0]) &
+                (s_dur <= dur_params[i, 1]) & s_valid)
+    if op == "kind":
+        i = plan[2]
+        return (s_kind.astype(jnp.int32) == kind_params[i]) & s_valid
+    if op == "and":
+        m = _span_mask(plan[2][0], sctx, tables, widths)
+        for sub in plan[2][1:]:
+            m = m & _span_mask(sub, sctx, tables, widths)
+        return m
+    if op == "or":
+        m = _span_mask(plan[2][0], sctx, tables, widths)
+        for sub in plan[2][1:]:
+            m = m | _span_mask(sub, sctx, tables, widths)
+        return m
+    if op == "not":
+        return ~_span_mask(plan[2], sctx, tables, widths) & s_valid
+    if op == "child":
+        pm = _span_mask(plan[2], sctx, tables, widths)
+        cm = _span_mask(plan[3], sctx, tables, widths)
+        safe_par = jnp.maximum(s_par, 0)
+        return cm & (s_par >= 0) & pm[safe_par]
+    if op == "desc":
+        import jax
+
+        am = _span_mask(plan[2], sctx, tables, widths)
+        sm = _span_mask(plan[3], sctx, tables, widths)
+        safe_par = jnp.maximum(s_par, 0)
+        # pointer doubling: after k steps acc covers the first 2^k
+        # proper ancestors; the trip count is log2 of the PADDED span
+        # axis — static, so the jit key stays shape-only. fori_loop, not
+        # a Python unroll: the unrolled gather chain sends XLA's CPU
+        # fusion passes into minutes-long optimization on batch-sized
+        # span axes (measured), while the rolled loop compiles once.
+        def _dbl(_i, carry):
+            acc, jump = carry
+            safe_j = jnp.maximum(jump, 0)
+            acc2 = acc | ((jump >= 0) & acc[safe_j])
+            jump2 = jnp.where(jump >= 0, jump[safe_j], -1)
+            return acc2, jump2
+
+        S = int(s_par.shape[0])
+        acc, _ = jax.lax.fori_loop(
+            0, max(1, (S - 1).bit_length()), _dbl,
+            ((s_par >= 0) & am[safe_par], s_par))
+        return sm & acc
+    raise StructuralCompileError(f"bad span plan op {op!r}")
+
+
+def _trace_mask(plan, ectx, sctx, tables, widths):
+    """[P, E] bool mask for a trace-level plan node (plan/widths
+    static; a span-less batch evaluates aggregates over zero counts).
+    A descriptor dispatcher over `plan`, like _span_mask."""
+    import jax.numpy as jnp
+
+    from .packing import duration_ok, mask_select_grouped, unpack_ids
+
+    if plan is None:
+        raise StructuralCompileError("trace plan must not be None")
+    (kv_key, kv_val, entry_dur, entry_dur_res, valid, safe_pb,
+     bg_page) = ectx
+    (term_keys, val_ranges, val_hits, _bg, dur_params, _kind,
+     agg_params) = tables
+    kw, vw, dw = widths if widths is not None else (None, None, None)
+    op = plan[0]
+    if op == "ttag":
+        i = plan[2]
+        kk = unpack_ids(kv_key, kw)
+        vv = unpack_ids(kv_val, vw)
+        k_per_page = term_keys[safe_pb, i]               # [P]
+        keym = kk == k_per_page[:, None, None]           # [P,E,C]
+        lo = val_ranges[safe_pb, i, :, 0]                # [P,R]
+        hi = val_ranges[safe_pb, i, :, 1]
+        v = vv[..., None]
+        valm = ((v >= lo[:, None, None, :]) &
+                (v <= hi[:, None, None, :])).any(-1)
+        if bg_page is not None:
+            safe_g = jnp.maximum(bg_page, 0)
+            safe_v = jnp.maximum(vv, 0).astype(jnp.int32)
+            mh = (mask_select_grouped(
+                val_hits, safe_g[:, None, None], i, safe_v)
+                & (vv >= 0))
+            valm = jnp.where((bg_page >= 0)[:, None, None], mh, valm)
+        return jnp.any(keym & valm, axis=-1) & valid
+    if op == "tdur":
+        i = plan[2]
+        return duration_ok(entry_dur, entry_dur_res,
+                           dur_params[i, 0], dur_params[i, 1], dw) & valid
+    if op == "exists":
+        if sctx is None:
+            return jnp.zeros_like(valid)
+        m = _span_mask(plan[2], sctx, tables, widths)
+        return (_seg_count(m, sctx[7], sctx[8]) > 0) & valid
+    if op == "count":
+        cop, ai, sub = plan[2], plan[3], plan[4]
+        if sctx is None:
+            n = jnp.zeros(valid.shape, dtype=jnp.uint32)
+        else:
+            m = _span_mask(sub, sctx, tables, widths)
+            n = _seg_count(m, sctx[7], sctx[8]).astype(jnp.uint32)
+        return _cmp_dev(n, agg_params[ai, 0], cop) & valid
+    if op == "q":
+        qop, ai, sub = plan[2], plan[3], plan[4]
+        if sctx is None:
+            return jnp.zeros_like(valid)
+        seg_b, seg_n = sctx[7], sctx[8]
+        s_dur = sctx[3]
+        m = _span_mask(sub, sctx, tables, widths)
+        n = _seg_count(m, seg_b, seg_n).astype(jnp.uint32)
+        qn = agg_params[ai, 0]
+        qd = agg_params[ai, 1]
+        x = agg_params[ai, 2]
+        # nearest-rank r = ceil(q*n) in pure uint32 math — identical on
+        # host (eval_host) so quantiles are bit-exact: no sort, no float
+        r = (qn * n + qd - jnp.uint32(1)) // qd
+        if qop in (">", ">="):
+            inner = (s_dur > x) if qop == ">" else (s_dur >= x)
+            ci = _seg_count(m & inner, seg_b, seg_n).astype(jnp.uint32)
+            ok = ci >= n - r + jnp.uint32(1)
+        elif qop in ("<", "<="):
+            inner = (s_dur < x) if qop == "<" else (s_dur <= x)
+            ci = _seg_count(m & inner, seg_b, seg_n).astype(jnp.uint32)
+            ok = ci >= r
+        else:  # == / != via the two one-sided rank tests
+            chi = _seg_count(m & (s_dur >= x), seg_b,
+                             seg_n).astype(jnp.uint32)
+            clo = _seg_count(m & (s_dur <= x), seg_b,
+                             seg_n).astype(jnp.uint32)
+            eq = (chi >= n - r + jnp.uint32(1)) & (clo >= r)
+            ok = eq if qop == "==" else ~eq
+        return ok & (n > 0) & valid
+    if op == "and":
+        m = _trace_mask(plan[2][0], ectx, sctx, tables, widths)
+        for sub in plan[2][1:]:
+            m = m & _trace_mask(sub, ectx, sctx, tables, widths)
+        return m
+    if op == "or":
+        m = _trace_mask(plan[2][0], ectx, sctx, tables, widths)
+        for sub in plan[2][1:]:
+            m = m | _trace_mask(sub, ectx, sctx, tables, widths)
+        return m
+    if op == "not":
+        return ~_trace_mask(plan[2], ectx, sctx, tables, widths) & valid
+    raise StructuralCompileError(f"bad trace plan op {op!r}")
+
+
+# ---------------------------------------------------------------------------
+# host reference evaluator (the differential-fuzz oracle and the
+# live/WAL + proto-fallback execution path)
+
+
+_CMP = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+
+def eval_host(expr: "ir.TraceExpr", sd) -> bool:
+    """Reference semantics over a SearchData (with its span rows):
+    byte-for-byte what the compiled kernels answer — substring tag
+    terms, inclusive ranges, pointer joins, and the SAME integer
+    rank-count quantile formula (never a sort, never a float)."""
+    spans = list(getattr(sd, "spans", ()) or ())
+    n_spans = len(spans)
+
+    def sev(e) -> list:
+        if isinstance(e, ir.SpanTag):
+            out = []
+            for sp in spans:
+                vs = sp.kvs.get(e.key)
+                out.append(bool(vs) and (not e.value or
+                                         any(e.value in x for x in vs)))
+            return out
+        if isinstance(e, ir.SpanDur):
+            return [e.lo_ms <= sp.dur_ms <= e.hi_ms for sp in spans]
+        if isinstance(e, ir.SpanKind):
+            return [sp.kind == e.kind for sp in spans]
+        if isinstance(e, ir.SpanAnd):
+            ms = [sev(a) for a in e.args]
+            return [all(m[i] for m in ms) for i in range(n_spans)]
+        if isinstance(e, ir.SpanOr):
+            ms = [sev(a) for a in e.args]
+            return [any(m[i] for m in ms) for i in range(n_spans)]
+        if isinstance(e, ir.SpanNot):
+            return [not v for v in sev(e.arg)]
+        if isinstance(e, ir.ChildOf):
+            pm, cm = sev(e.parent), sev(e.child)
+            return [cm[i] and 0 <= spans[i].parent < n_spans
+                    and pm[spans[i].parent] for i in range(n_spans)]
+        if isinstance(e, ir.DescOf):
+            am, sm = sev(e.anc), sev(e.span)
+            out = []
+            for i in range(n_spans):
+                ok = False
+                if sm[i]:
+                    p = spans[i].parent
+                    # bounded walk: malformed parent cycles terminate
+                    # after n_spans hops (the device doubling covers the
+                    # same reachable set)
+                    for _ in range(n_spans):
+                        if not 0 <= p < n_spans:
+                            break
+                        if am[p]:
+                            ok = True
+                            break
+                        p = spans[p].parent
+                out.append(ok)
+            return out
+        raise StructuralCompileError(
+            f"unknown span node {type(e).__name__}")
+
+    def tev(e) -> bool:
+        if isinstance(e, ir.TraceTag):
+            vs = sd.kvs.get(e.key)
+            return bool(vs) and (not e.value
+                                 or any(e.value in x for x in vs))
+        if isinstance(e, ir.TraceDur):
+            return e.lo_ms <= sd.dur_ms <= e.hi_ms
+        if isinstance(e, ir.Exists):
+            return any(sev(e.of))
+        if isinstance(e, ir.Count):
+            return _CMP[e.op](sum(sev(e.of)), e.n)
+        if isinstance(e, ir.Quantile):
+            m = sev(e.of)
+            n = sum(m)
+            if n == 0:
+                return False
+            r = (e.q_num * n + e.q_den - 1) // e.q_den
+            if e.op in (">", ">="):
+                ci = sum(1 for i, v in enumerate(m) if v and
+                         (spans[i].dur_ms > e.x_ms if e.op == ">"
+                          else spans[i].dur_ms >= e.x_ms))
+                return ci >= n - r + 1
+            if e.op in ("<", "<="):
+                ci = sum(1 for i, v in enumerate(m) if v and
+                         (spans[i].dur_ms < e.x_ms if e.op == "<"
+                          else spans[i].dur_ms <= e.x_ms))
+                return ci >= r
+            chi = sum(1 for i, v in enumerate(m)
+                      if v and spans[i].dur_ms >= e.x_ms)
+            clo = sum(1 for i, v in enumerate(m)
+                      if v and spans[i].dur_ms <= e.x_ms)
+            eq = (chi >= n - r + 1) and (clo >= r)
+            return eq if e.op == "==" else not eq
+        if isinstance(e, ir.TraceAnd):
+            return all(tev(a) for a in e.args)
+        if isinstance(e, ir.TraceOr):
+            return any(tev(a) for a in e.args)
+        if isinstance(e, ir.TraceNot):
+            return not tev(e.arg)
+        raise StructuralCompileError(
+            f"unknown trace node {type(e).__name__}")
+
+    return tev(expr)
+
+
+# ---------------------------------------------------------------------------
+# cost model + explain attribution
+
+
+def plan_node_bytes(plan: tuple, n_spans: int, n_entries: int,
+                    span_kv_slots: int = 1,
+                    entry_kv_slots: int = 1) -> dict:
+    """Per-node device-byte estimates — the unit the planner's
+    calibrated scan rate (seconds/byte) turns into predicted seconds,
+    and the conserved weights measured kernel time apportions over for
+    the explain tree. Deliberately simple: bytes touched per op,
+    including the log-factor of the doubling join."""
+    S = max(1, n_spans)
+    PE = max(1, n_entries)
+    out: dict[int, int] = {}
+
+    def w_span(p) -> None:
+        op, nid = p[0], p[1]
+        if op == "tag":
+            out[nid] = S * span_kv_slots * 8
+        elif op == "dur":
+            out[nid] = S * 4
+        elif op == "kind":
+            out[nid] = S
+        elif op in ("and", "or"):
+            out[nid] = S * len(p[2])
+            for sub in p[2]:
+                w_span(sub)
+        elif op == "not":
+            out[nid] = S
+            w_span(p[2])
+        elif op == "child":
+            out[nid] = S * 12
+            w_span(p[2])
+            w_span(p[3])
+        elif op == "desc":
+            out[nid] = S * 12 * max(1, (S - 1).bit_length())
+            w_span(p[2])
+            w_span(p[3])
+
+    def w_trace(p) -> None:
+        op, nid = p[0], p[1]
+        if op == "ttag":
+            out[nid] = PE * entry_kv_slots * 8
+        elif op == "tdur":
+            out[nid] = PE * 4
+        elif op == "exists":
+            out[nid] = S * 4 + PE * 8
+            w_span(p[2])
+        elif op in ("count", "q"):
+            out[nid] = (S * 4 + PE * 8) * (2 if op == "q" else 1)
+            w_span(p[4])
+        elif op in ("and", "or"):
+            out[nid] = PE * len(p[2])
+            for sub in p[2]:
+                w_trace(sub)
+        elif op == "not":
+            out[nid] = PE
+            w_trace(p[2])
+
+    w_trace(plan)
+    return out
+
+
+def span_device_bytes(span_cols) -> int:
+    """Physical bytes of a staged span segment (budget accounting)."""
+    if not span_cols:
+        return 0
+    return int(sum(int(getattr(a, "nbytes", 0))
+                   for a in span_cols.values()))

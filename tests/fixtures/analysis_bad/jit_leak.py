@@ -50,6 +50,30 @@ def descriptor_clean_kernel(arr, *, widths):
     return _unpack_fixture(arr, widths[0])
 
 
+def _lower_fixture(arr, plan):
+    """Plan-descriptor-shaped helper (structural-engine idiom): the
+    lowering recurses/branches on its plan at trace time, so a tracer
+    reaching `plan` is a trace-time leak."""
+    if plan is None:
+        return arr
+    if plan[0] == "and":
+        return arr & 1
+    return arr
+
+
+@functools.partial(jax.jit, static_argnames=("plan",))
+def plan_taint_kernel(arr, sel, *, plan):
+    # VIOLATION: tracer data passed as a structural plan descriptor —
+    # the lowering branches on it at trace time
+    return _lower_fixture(arr, sel)
+
+
+@functools.partial(jax.jit, static_argnames=("plan",))
+def plan_clean_kernel(arr, *, plan):
+    # the good twin: the descriptor comes from the static `plan`
+    return _lower_fixture(arr, plan)
+
+
 @functools.partial(jax.jit, static_argnames=("top_k",))
 def clean_kernel(scores, mask, extra=None, *, top_k):
     n = scores.shape[0]            # shape reads are static: fine

@@ -210,6 +210,20 @@ def test_jit_purity_flags_tainted_width_descriptor(bad_pkg):
         [f.message for f in findings]
 
 
+def test_jit_purity_flags_tainted_plan_descriptor(bad_pkg):
+    """The structural engine's static plan descriptors are covered by
+    the same rule as the packed-residency widths: tracer data reaching
+    a plan-dispatching helper is flagged; the static twin stays
+    silent."""
+    findings = JitPurityChecker().check(bad_pkg)
+    taint = [f for f in findings if f.key.startswith("descriptor-taint:")
+             and "plan_taint_kernel" in f.key]
+    assert taint and "'plan'" in taint[0].message, \
+        [f.message for f in findings]
+    assert not [f for f in findings if "plan_clean_kernel" in f.key], \
+        [f.message for f in findings]
+
+
 def test_jit_purity_clean_on_real_kernels(real_pkg):
     assert JitPurityChecker().check(real_pkg) == []
 
