@@ -2210,6 +2210,9 @@ def phase_structural():
         nodes = stats["structural"]["nodes"]
         assert nodes and all("device_ms" in n for n in nodes)
 
+        concurrency = _structural_concurrency_subphase(td, mk_entries)
+        sharded_leg = _structural_sharded_span_leg(mk_entries)
+
         return {
             "blocks": n_blocks,
             "entries_per_block": entries_per_block,
@@ -2221,7 +2224,163 @@ def phase_structural():
                 total * len(queries) / max(base_total, 1e-9)),
             "queries": results,
             "explain_plan_nodes": nodes,
+            "structural_concurrency": concurrency,
+            "mesh_sharded_spans": sharded_leg,
         }
+
+
+def _structural_concurrency_subphase(td, mk_entries):
+    """`structural_concurrency` sub-phase (ISSUE 15): a barrier-synced
+    8-way SAME-PLAN-SHAPE structural load against the serving path with
+    plan-shape stacking on. Asserts the fused dispatches per request
+    land well below 1 (>= 2x fewer kernel launches than the solo-flush
+    behavior) and that every concurrent response is byte-identical to
+    the same query run serially."""
+    import threading
+
+    from tempo_tpu import tempopb
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.search import ir, structural
+    from tempo_tpu.search.columnar import PageGeometry
+    from tempo_tpu.search.data import encode_search_data
+
+    be = LocalBackend(td + "/blocks-conc")
+    db = TempoDB(be, td + "/wal-conc", TempoDBConfig(
+        auto_mesh=False, search_structural_enabled=True,
+        search_structural_stack_enabled=True,
+        search_coalesce_window_s=0.05,
+        search_geometry=PageGeometry(256, 8)))
+    corpus = []
+    for s in range(2):
+        entries = sorted(mk_entries(s), key=lambda sd: sd.trace_id)
+        corpus.extend(entries)
+        db.write_block_direct(
+            "bench",
+            [(sd.trace_id, encode_search_data(sd), sd.start_s, sd.end_s)
+             for sd in entries],
+            search_entries=entries)
+    N = 8
+    exprs = [ir.parse(
+        '{"child": {"parent": {"tag": {"k": "service.name",'
+        ' "v": "svc-%02d"}}, "child": {"dur": {"min_ms": %d}}}}'
+        % (i % 12, 100 * (i + 1))) for i in range(N)]
+
+    def search_one(expr):
+        req = tempopb.SearchRequest()
+        req.limit = len(corpus)
+        structural.attach_query(req, expr)
+        resp = db.search("bench", req).response()
+        return sorted(m.trace_id for m in resp.traces), \
+            int(resp.metrics.inspected_traces)
+
+    serial = [search_one(e) for e in exprs]   # also warms stage+compile
+    co = db.batcher.coalescer
+    d0, q0 = co.dispatches, co.queries
+    out = [None] * N
+    barrier = threading.Barrier(N)
+
+    def one(i):
+        barrier.wait()
+        out[i] = search_one(exprs[i])
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    for i in range(N):
+        assert out[i] == serial[i], f"query {i} diverged under stacking"
+    dispatches = co.dispatches - d0
+    served = co.queries - q0
+    assert served == N
+    per_request = dispatches / N
+    # the acceptance floor: >= 2x fewer launches than solo (which costs
+    # one dispatch per request)
+    assert per_request <= 0.5, (
+        f"stacking fused too little: {dispatches} dispatches for {N} "
+        "same-plan requests")
+    return {
+        "requests": N,
+        "dispatches": dispatches,
+        "dispatches_per_request": round(per_request, 3),
+        "stacked_queries": co.structural_stacked,
+        "stack_ratio": co.stats()["structural_stack_ratio"],
+        "byte_identical_vs_serial": True,
+        "wall_ms": round(wall * 1e3, 3),
+    }
+
+
+def _structural_sharded_span_leg(mk_entries):
+    """Mesh-sharded-span leg of the `structural` phase (ISSUE 15):
+    stage one span-bearing batch over the mesh with the replicated vs
+    the segment-aligned sharded layout, report per-shard span bytes
+    (sharded ~ 1/P of replicated), and assert byte-identical answers
+    through the dist kernel both ways."""
+    import jax
+
+    from tempo_tpu import tempopb
+    from tempo_tpu.search import ir, structural
+    from tempo_tpu.search.columnar import ColumnarPages, PageGeometry
+    from tempo_tpu.search.multiblock import MultiBlockEngine, compile_multi
+    from tempo_tpu.search.structural import STRUCTURAL, compile_structural
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "single device — no mesh to shard over"}
+    from tempo_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    n_sh = int(mesh.devices.size)
+    geo = PageGeometry(256, 8)
+    blocks = [ColumnarPages.build(
+        sorted(mk_entries(s), key=lambda sd: sd.trace_id), geo)
+        for s in range(2)]
+    expr = ir.parse(
+        '{"child": {"parent": {"tag": {"k": "service.name",'
+        ' "v": "svc-03"}}, "child": {"dur": {"min_ms": 500}}}}')
+
+    def run(shard: bool):
+        prev = STRUCTURAL.shard_spans
+        STRUCTURAL.shard_spans = shard
+        try:
+            eng = MultiBlockEngine(top_k=4096, mesh=mesh)
+            batch = eng.stage(blocks)
+            req = tempopb.SearchRequest()
+            req.limit = 4096
+            structural.attach_query(req, expr)
+            mq = compile_multi(blocks, req, cache_on=batch)
+            mq.structural = compile_structural(
+                expr, blocks, cache_on=batch,
+                staged_dicts=batch.staged_dicts)
+            count, _ins, scores, idx = eng.scan(batch, mq)
+            got = frozenset(
+                (int(s), int(i))
+                for s, i in zip(scores.tolist(), idx.tolist()) if s >= 0)
+            span_total = sum(int(a.nbytes)
+                             for a in batch.span_device.values())
+            # replicated layout pins the FULL segment on every shard;
+            # the sharded layout splits its global arrays 1/P each
+            per_shard = (span_total // n_sh) if batch.span_sharded \
+                else span_total
+            assert batch.span_sharded == shard
+            return count, got, per_shard
+        finally:
+            STRUCTURAL.shard_spans = prev
+
+    rep_count, rep_got, rep_bytes = run(False)
+    sh_count, sh_got, sh_bytes = run(True)
+    assert (rep_count, rep_got) == (sh_count, sh_got), \
+        "sharded span layout diverged from replicated"
+    return {
+        "shards": n_sh,
+        "replicated_span_bytes_per_shard": rep_bytes,
+        "sharded_span_bytes_per_shard": sh_bytes,
+        "span_hbm_ratio": round(sh_bytes / max(1, rep_bytes), 3),
+        "byte_identical": True,
+        "matches": int(rep_count),
+    }
 
 
 def phase_scale_10k():

@@ -407,6 +407,35 @@ static bool anyvalue_str(const uint8_t* p, Range r, std::string& out) {
   return true;
 }
 
+// AnyValue → its string_value field ONLY (python `kv.value.string_value`
+// semantics — collect_span_rows derives the per-span service name this
+// way, so an int-typed service.name yields "" here while the trace-level
+// rollup stringifies it). Last occurrence wins; only a RECOGNIZED later
+// oneof arm (fields 2-7 at their declared wire types) clears a set
+// string_value — protobuf parsers treat unknown fields and wire-type
+// mismatches as unknown, which never clear a oneof, and the Python
+// fallback path must read the same value.
+static bool anyvalue_string_only(const uint8_t* p, Range r,
+                                 std::string& out) {
+  size_t off = r.off, end = r.off + r.len;
+  out.clear();
+  while (off < end) {
+    uint64_t tag;
+    if (!rd_varint(p, end, off, tag)) return false;
+    uint32_t f = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    Range pay{0, 0};
+    if (!rd_skip(p, end, off, wt, &pay)) return false;
+    if (f == 1 && wt == 2)
+      out.assign((const char*)p + pay.off, pay.len);
+    else if ((f == 2 && wt == 0) ||   // bool_value
+             (f == 3 && wt == 0) ||   // int_value
+             (f == 4 && wt == 1) ||   // double_value
+             (f >= 5 && f <= 7 && wt == 2))  // array/kvlist/bytes
+      out.clear();
+  }
+  return true;
+}
+
 // utf-8 character count (python len(str)) — budget accounting must match
 static size_t u8len(const std::string& s) {
   size_t n = 0;
@@ -430,15 +459,32 @@ struct RowTmp {
   uint8_t span_id[8], parent_id[8];
 };
 
+// per-span summary captured for the search-data SPAN SECTION (the
+// structural engine's ingest substrate, data.py collect_span_rows) —
+// only populated when the caller asked for span rows (flags bit 0), so
+// the legacy path allocates nothing extra
+struct SpanSum {
+  uint64_t start_ns = 0, end_ns = 0;
+  uint32_t kind = 0, status = 0;
+  std::string name;
+  std::string span_id, parent_id;  // RAW bytes (python keys idx_of raw)
+  std::vector<std::pair<std::string, std::string>> attrs;
+};
+
 struct ScopeOut {
   std::vector<Range> passthrough;  // scope + schema_url fields, verbatim
   std::vector<Range> spans;        // span payloads (field 2 LEN values)
+  std::vector<SpanSum> sums;       // parallel to `spans` (span section)
   size_t body_size = 0;            // computed at emit
 };
 
 struct BatchOut {
   std::vector<Range> passthrough;  // resource + schema_url, verbatim
   std::vector<ScopeOut> scopes;
+  // resource service.name with STRING_VALUE-only semantics (python
+  // collect_span_rows reads kv.value.string_value, not the any-value
+  // stringification the trace-level rollup uses) — last key wins
+  std::string svc_str;
   size_t body_size = 0;
 };
 
@@ -480,11 +526,16 @@ static void put_u16s(std::string& out, const std::string& s) {
 
 }  // namespace
 
-extern "C" {
-
-long long tt_ingest_regroup(const char* src_c, size_t src_len,
-                            long long max_search_bytes,
-                            char* dst, size_t dst_cap) {
+// full regroup implementation; `flags` bit 0 asks for the search-data
+// SPAN SECTION (data.py optional trailing section) capped at
+// `max_spans` rows / `max_span_kvs` kv pairs per span — byte-identical
+// to the Python walk (collect_span_rows + encode_search_data)
+static long long ingest_regroup_impl(const char* src_c, size_t src_len,
+                                     long long max_search_bytes,
+                                     long long flags, long long max_spans,
+                                     long long max_span_kvs,
+                                     char* dst, size_t dst_cap) {
+  const bool want_spans = (flags & 1) != 0;
   const uint8_t* p = (const uint8_t*)src_c;
   std::vector<TraceOut> traces;
   std::unordered_map<std::string, int> tid_idx;  // padded tid → index
@@ -513,6 +564,7 @@ long long tt_ingest_regroup(const char* src_c, size_t src_len,
     // ---- one ResourceSpans ----
     std::vector<Range> rs_passthrough;
     std::string svc;                       // resource service.name
+    std::string svc_sv;                    // ...string_value-only form
     std::vector<std::pair<std::string, std::string>> res_kvs;
     std::vector<Range> scope_payloads;
     {
@@ -538,6 +590,7 @@ long long tt_ingest_regroup(const char* src_c, size_t src_len,
               if ((rtag >> 3) == 1 && (rtag & 7) == 2) {  // KeyValue
                 size_t ko = rpay.off, kend = rpay.off + rpay.len;
                 std::string key, val;
+                Range val_r{0, 0};
                 while (ko < kend) {
                   uint64_t ktag;
                   if (!rd_varint(p, kend, ko, ktag)) return -2;
@@ -548,10 +601,16 @@ long long tt_ingest_regroup(const char* src_c, size_t src_len,
                     key.assign((const char*)p + kpay.off, kpay.len);
                   else if ((ktag >> 3) == 2 && (ktag & 7) == 2) {
                     if (!anyvalue_str(p, kpay, val)) return -2;
+                    val_r = kpay;
                   }
                 }
                 res_kvs.emplace_back(key, val);
-                if (key == "service.name") svc = val;  // last wins (py parity)
+                if (key == "service.name") {
+                  svc = val;  // last wins (py parity)
+                  // span rows read string_value ONLY (py parity:
+                  // collect_span_rows vs extract_search_data)
+                  if (!anyvalue_string_only(p, val_r, svc_sv)) return -2;
+                }
               }
             }
           }
@@ -681,6 +740,7 @@ long long tt_ingest_regroup(const char* src_c, size_t src_len,
             bi = (int)T.batches.size();
             T.batches.emplace_back();
             T.batches[bi].passthrough = rs_passthrough;
+            T.batches[bi].svc_str = svc_sv;
             batch_dest.emplace(ti, bi);
             for (auto& kv : res_kvs) kv_add(T, kv.first, kv.second);
           } else {
@@ -713,6 +773,30 @@ long long tt_ingest_regroup(const char* src_c, size_t src_len,
           if (s.insert("true").second) T.budget -= 9;
         }
         for (auto& kv : span_kvs) kv_add(T, kv.first, kv.second);
+
+        if (want_spans) {
+          // span-section capture (parallel to SO->spans): raw ids for
+          // the parent resolve, attrs MOVED (kv_add above was their
+          // last reader) so capture adds only the short name/id copies
+          // per span — the legacy path (flags=0) stores nothing. The
+          // max_spans cap applies at emit, in REGROUPED order: parse
+          // order can differ from the regrouped walk order when one
+          // trace's spans interleave across scopes, so an early
+          // capture cap would truncate a different row set than the
+          // Python walk.
+          SpanSum sum;
+          sum.start_ns = start_ns;
+          sum.end_ns = end_ns;
+          sum.kind = (uint32_t)kind;
+          sum.status = status_code;
+          sum.name = name;
+          sum.span_id.assign((const char*)p + span_id_r.off, span_id_r.len);
+          if (have_parent)
+            sum.parent_id.assign((const char*)p + parent_r.off,
+                                 parent_r.len);
+          sum.attrs = std::move(span_kvs);
+          SO->sums.push_back(std::move(sum));
+        }
 
         if (!have_parent) {
           if (!T.have_root || start_ns < T.root_start) {
@@ -837,6 +921,100 @@ long long tt_ingest_regroup(const char* src_c, size_t src_len,
         put_u16s(sd, v);
       }
     }
+
+    if (want_spans) {
+      // ---- optional trailing SPAN SECTION (data.py collect_span_rows
+      // + encode_search_data parity): rows in REGROUPED walk order
+      // (batches → scopes → spans — the exact order the Python walk
+      // sees on the regrouped trace), parents resolved by raw span id
+      // within this trace's captured rows (first id occurrence wins,
+      // never self), caps applied like the Python walk. A trace with
+      // zero captured rows emits NO section — byte-identical to the
+      // legacy wire form.
+      struct SpanRow {
+        int parent = -1;
+        uint32_t dur_ms = 0, kind = 0;
+        std::map<std::string, std::set<std::string>> kvs;
+      };
+      std::vector<SpanRow> srows;
+      std::unordered_map<std::string, int> idx_of;  // raw span id → row
+      std::vector<std::string> parent_ids;
+      for (auto& B : T.batches) {
+        const std::string& ssvc = B.svc_str;
+        for (auto& S : B.scopes) {
+          for (auto& sum : S.sums) {
+            if ((long long)srows.size() >= max_spans) break;
+            SpanRow r;
+            uint64_t d = (sum.end_ns > sum.start_ns)
+                             ? (sum.end_ns - sum.start_ns) / 1000000ull
+                             : 0;
+            if (d > 0xFFFFFFFFull) d = 0xFFFFFFFFull;
+            r.dur_ms = sum.end_ns ? (uint32_t)d : 0;
+            r.kind = sum.kind;
+            long long n_kv = 0;
+            if (!ssvc.empty()) {
+              r.kvs["service.name"].insert(ssvc);
+              n_kv++;
+            }
+            if (!sum.name.empty() && n_kv < max_span_kvs) {
+              r.kvs["name"].insert(sum.name);
+              n_kv++;
+            }
+            if (sum.status == 2 && n_kv < max_span_kvs) {
+              r.kvs["error"].insert("true");
+              n_kv++;
+            }
+            for (auto& kv : sum.attrs) {
+              if (n_kv >= max_span_kvs) break;
+              if (kv.second.empty()) continue;  // unindexed value type
+              r.kvs[kv.first].insert(kv.second);
+              n_kv++;  // counts per attribute, dupes included (py parity)
+            }
+            if (!sum.span_id.empty())
+              idx_of.emplace(sum.span_id, (int)srows.size());
+            parent_ids.push_back(sum.parent_id);
+            srows.push_back(std::move(r));
+          }
+        }
+      }
+      for (size_t i = 0; i < srows.size(); i++) {
+        const std::string& pid = parent_ids[i];
+        if (pid.empty()) continue;
+        auto it = idx_of.find(pid);
+        if (it != idx_of.end() && it->second != (int)i)
+          srows[i].parent = it->second;  // self-parent stays -1
+      }
+      if (!srows.empty()) {
+        uint16_t ns = (uint16_t)std::min(srows.size(), (size_t)0xFFFF);
+        sd.append((const char*)&ns, 2);
+        size_t ri = 0;
+        for (auto& r : srows) {
+          if (ri++ >= ns) break;
+          uint16_t par = (r.parent >= 0 && r.parent < 0xFFFF)
+                             ? (uint16_t)r.parent
+                             : 0xFFFF;
+          sd.append((const char*)&par, 2);
+          put_u32(sd, r.dur_ms);
+          sd.push_back((char)(r.kind & 0xFF));
+          uint16_t nsk = (uint16_t)std::min(r.kvs.size(), (size_t)0xFFFF);
+          sd.append((const char*)&nsk, 2);
+          size_t ski = 0;
+          for (auto& kv : r.kvs) {             // std::map: sorted keys
+            if (ski++ >= nsk) break;
+            put_u16s(sd, kv.first);
+            uint16_t nsv =
+                (uint16_t)std::min(kv.second.size(), (size_t)0xFFFF);
+            sd.append((const char*)&nsv, 2);
+            size_t svi = 0;
+            for (auto& v : kv.second) {        // std::set: sorted values
+              if (svi++ >= nsv) break;
+              put_u16s(sd, v);
+            }
+          }
+        }
+      }
+    }
+
     put_u32(out, (uint32_t)sd.size());
     out += sd;
   }
@@ -851,6 +1029,26 @@ long long tt_ingest_regroup(const char* src_c, size_t src_len,
   if (out.size() > dst_cap) return -3;
   memcpy(dst, out.data(), out.size());
   return (long long)out.size();
+}
+
+extern "C" {
+
+long long tt_ingest_regroup(const char* src_c, size_t src_len,
+                            long long max_search_bytes,
+                            char* dst, size_t dst_cap) {
+  // legacy entry point: no span section — byte-identical to the
+  // pre-span builds (stale-binding safety: callers probe for the new
+  // symbol and fall back to the Python walk when it is absent)
+  return ingest_regroup_impl(src_c, src_len, max_search_bytes, 0, 0, 0,
+                             dst, dst_cap);
+}
+
+long long tt_ingest_regroup2(const char* src_c, size_t src_len,
+                             long long max_search_bytes, long long flags,
+                             long long max_spans, long long max_span_kvs,
+                             char* dst, size_t dst_cap) {
+  return ingest_regroup_impl(src_c, src_len, max_search_bytes, flags,
+                             max_spans, max_span_kvs, dst, dst_cap);
 }
 
 }  // extern "C"

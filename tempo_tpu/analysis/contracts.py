@@ -108,6 +108,18 @@ GATED_FUNCTIONS = (
     # parse, or cache touch
     GatedFunction("tempo_tpu.search.structural", "structural_query",
                   ("enabled",), "search_structural_enabled"),
+    # plan-shape query stacking: the coalescer's grouping gate — with
+    # stacking off, a structural submit reads one attribute and takes
+    # the solo-flush path, never computing a group key
+    GatedFunction("tempo_tpu.search.structural",
+                  "StructuralGate.stack_group_key", ("stack_enabled",),
+                  "search_structural_stack_enabled"),
+    # segment-aligned span sharding: the placement-time reshard gate —
+    # off means one attribute read and the byte-identical replicated
+    # span layout at every staging site
+    GatedFunction("tempo_tpu.search.structural",
+                  "StructuralGate.shard_span_segment", ("shard_spans",),
+                  "search_structural_shard_spans"),
 )
 
 GUARDED_CALLS = (
@@ -129,6 +141,15 @@ GUARDED_CALLS = (
     # blocks for span segments, let alone stack/pad/upload them
     GuardedCall("STRUCTURAL", ("stack_spans", "stage_single"), (),
                 "enabled", "STRUCTURAL", "search_structural_enabled"),
+    # plan-shape stacking: group-key computation only behind the
+    # stacking gate — a disabled coalescer submit stays on the exact
+    # solo-flush path
+    GuardedCall("STRUCTURAL", ("stack_group_key",), (), "stack_enabled",
+                "STRUCTURAL", "search_structural_stack_enabled"),
+    # span-sharding: the reshard (an O(spans) numpy pass) only behind
+    # its gate — disabled staging keeps the replicated layout untouched
+    GuardedCall("STRUCTURAL", ("shard_span_segment",), (), "shard_spans",
+                "STRUCTURAL", "search_structural_shard_spans"),
 )
 
 

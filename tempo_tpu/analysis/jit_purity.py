@@ -53,8 +53,14 @@ _NP_HOST_FNS = {"asarray", "array", "frombuffer", "copy"}
 # The rule only fires for helpers that actually BRANCH on the parameter
 # (descriptor dispatchers) — a numeric parameter that merely shares a
 # name (`def weighted(x, w)`) is ordinary traced data, not a
-# descriptor.
-_DESCRIPTOR_PARAMS = {"w", "dw", "widths", "plan"}
+# descriptor. `span_sharded` is the span-layout descriptor (segment-
+# aligned span sharding): the dist kernels and any helper that selects
+# the replicated-vs-sharded evaluation placement branch on it at trace
+# time — a tracer reaching it would pick a layout per VALUE, exactly
+# the retrace/concretization failure the widths rule exists for. The
+# stacked plan descriptor (plan-shape stacking) rides the existing
+# `plan` entry: the coalesced kernels thread the same static plan.
+_DESCRIPTOR_PARAMS = {"w", "dw", "widths", "plan", "span_sharded"}
 
 
 def _branches_on_param(helper: ast.AST, param: str) -> bool:

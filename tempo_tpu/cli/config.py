@@ -130,6 +130,10 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "search_structural_max_spans", 512),
         search_structural_max_span_kvs=storage.get(
             "search_structural_max_span_kvs", 16),
+        search_structural_stack_enabled=storage.get(
+            "search_structural_stack_enabled", False),
+        search_structural_shard_spans=storage.get(
+            "search_structural_shard_spans", False),
         # persistent XLA compile cache for the search kernels
         # (docs/search-packed-residency.md#persistent-compile-cache);
         # empty = off, hits surface as jit_cache_events{result=persisted}

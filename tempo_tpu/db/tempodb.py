@@ -139,6 +139,25 @@ class TempoDBConfig:
     search_structural_max_spans: int = 512
     # kv pairs captured per span at ingest
     search_structural_max_span_kvs: int = 16
+    # plan-shape query stacking: concurrent structural queries that
+    # lowered to the SAME static plan descriptor stack along the
+    # coalescer's query axis (parameter tables pad to the group max)
+    # and execute as ONE fused dispatch — N dashboards running the
+    # same saved query cost ~1 kernel launch per coalescing window.
+    # Unstackable shapes flush solo and surface in
+    # tempo_search_structural_stack_events_total. False (default) is a
+    # true noop: structural queries keep the solo-flush behavior
+    # exactly (one attribute read at the coalescer).
+    search_structural_stack_enabled: bool = False
+    # segment-aligned span sharding on mesh/dist staging: the span
+    # segment reshards so each trace's contiguous span run lands whole
+    # on its page's shard (parent pointers and segment ranges rebased
+    # shard-local), making the child gather and desc pointer-doubling
+    # shard-local — parent joins scale with the mesh and per-shard span
+    # HBM drops to ~1/P of the replicated layout. False (default) is a
+    # true noop: span columns replicate exactly as before (one
+    # attribute read at the placement sites).
+    search_structural_shard_spans: bool = False
     # packed HBM residency (search/packing.py,
     # docs/search-packed-residency.md): staged value-id columns narrow
     # to the width the per-block dictionary cardinality allows (4-bit/
@@ -345,7 +364,9 @@ class TempoDB:
         _structural.configure(
             enabled=self.cfg.search_structural_enabled,
             max_spans=self.cfg.search_structural_max_spans,
-            max_span_kvs=self.cfg.search_structural_max_span_kvs)
+            max_span_kvs=self.cfg.search_structural_max_span_kvs,
+            stack_enabled=self.cfg.search_structural_stack_enabled,
+            shard_spans=self.cfg.search_structural_shard_spans)
         # owner-routed HBM placement: process-wide like the layers above
         # (docs/search-hbm-ownership.md)
         from tempo_tpu.search import ownership as _ownership

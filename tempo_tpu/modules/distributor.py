@@ -131,17 +131,22 @@ class Distributor:
         from tempo_tpu.search.structural import STRUCTURAL
 
         if blobs is not None:
-            if STRUCTURAL.enabled:
-                # the native walker emits no span rows yet: with the
-                # structural gate on, ingest takes the python walk so
-                # every flushed block carries the span segment
-                native_out = None
-            else:
-                try:
+            try:
+                if STRUCTURAL.enabled:
+                    # structural gate on: the native walker emits the
+                    # span section too (tt_ingest_regroup2, byte-
+                    # identical to the Python walk) — a stale .so
+                    # without the symbol returns None and the Python
+                    # walk below keeps every flushed block span-bearing
+                    native_out = self._native.ingest_regroup(
+                        blobs, lim.max_search_bytes_per_trace,
+                        spans=True, max_spans=STRUCTURAL.max_spans,
+                        max_span_kvs=STRUCTURAL.max_span_kvs)
+                else:
                     native_out = self._native.ingest_regroup(
                         blobs, lim.max_search_bytes_per_trace)
-                except self._native.InvalidTraceId:
-                    native_out = None  # python path raises canonical error
+            except self._native.InvalidTraceId:
+                native_out = None  # python path raises canonical error
             if native_out is not None:
                 n_spans, items, summaries = native_out
         if items is None:

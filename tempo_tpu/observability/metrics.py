@@ -368,6 +368,13 @@ host_logical_bytes = Gauge("tempo_search_host_logical_bytes",
 coalesce_pending = Gauge("tempo_search_coalesce_pending_queries",
                          "queries parked in coalescing windows right now "
                          "(the coalescer queue depth)")
+structural_stack_events = Counter(
+    "tempo_search_structural_stack_events_total",
+    "structural-query stacking outcomes at coalescer flush: "
+    "result=stacked (member of a fused same-plan dispatch), solo_shape "
+    "(no peer shared the plan shape within the window), solo_disabled "
+    "(search_structural_stack_enabled off) — unstackable plan shapes "
+    "are visible here instead of silently flushing solo")
 
 # ---- owner-routed HBM (search/ownership.py) ----
 hbm_owner_generation = Gauge(

@@ -106,6 +106,18 @@ def _bind(lib):
         ]
     except AttributeError:
         pass
+    # OPTIONAL symbol (span-section variant): a stale .so without it
+    # still binds — structural-gated ingest then falls back to the
+    # Python walk, everything else keeps the native fast path
+    try:
+        lib.tt_ingest_regroup2.restype = ctypes.c_longlong
+        lib.tt_ingest_regroup2.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
+    except AttributeError:
+        pass
     lib.tt_substr_scan.restype = ctypes.c_longlong
     lib.tt_substr_scan.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
@@ -206,7 +218,9 @@ class InvalidTraceId(ValueError):
     caller re-runs the Python path so the user-visible error matches."""
 
 
-def ingest_regroup(batch_blobs: list, max_search_bytes: int):
+def ingest_regroup(batch_blobs: list, max_search_bytes: int,
+                   spans: bool = False, max_spans: int = 512,
+                   max_span_kvs: int = 16):
     """Native single-pass regroup + search-data extraction over
     SERIALIZED ResourceSpans (tt_ingest_regroup). Returns
     (n_spans, [(padded_tid, start_s, end_s, segment, search_data)],
@@ -214,16 +228,30 @@ def ingest_regroup(batch_blobs: list, max_search_bytes: int):
     metrics generator (string table + 56B rows; decoded off the ack
     path by generator.push_summary_blob). None when the loaded .so
     predates the symbol (stale build) — callers fall back to the
-    Python walk."""
+    Python walk.
+
+    ``spans=True`` (the structural-engine ingest path) additionally
+    emits the per-trace SPAN SECTION into each search_data payload
+    (tt_ingest_regroup2, byte-identical to the Python
+    collect_span_rows walk, capped at max_spans/max_span_kvs); when
+    the loaded .so predates that symbol, returns None so the caller
+    keeps the Python walk."""
     lib = _load()
     if lib is None or not hasattr(lib, "tt_ingest_regroup"):
+        return None
+    if spans and not hasattr(lib, "tt_ingest_regroup2"):
         return None
     src = b"".join(_LEN32.pack(len(b)) + b for b in batch_blobs)
     cap = max(4096, len(src) * 2 + 1024)
     while True:
         dst = ctypes.create_string_buffer(cap)
-        got = lib.tt_ingest_regroup(src, len(src), max_search_bytes,
-                                    dst, cap)
+        if spans:
+            got = lib.tt_ingest_regroup2(
+                src, len(src), max_search_bytes, 1,
+                int(max_spans), int(max_span_kvs), dst, cap)
+        else:
+            got = lib.tt_ingest_regroup(src, len(src), max_search_bytes,
+                                        dst, cap)
         if got == -3:
             cap *= 2
             continue

@@ -48,10 +48,14 @@ class ShardedPages:
     # packed-residency width descriptor (search/packing.py): static per
     # staged block, part of the dist kernel's jit shape key
     widths: tuple | None = None
-    # structural span columns (search/structural.py): REPLICATED — the
-    # parent joins index the global span axis; the structural verdict
-    # computes outside shard_map and enters the scan page-sharded
+    # structural span columns (search/structural.py): REPLICATED by
+    # default — the parent joins index the global span axis; the
+    # structural verdict computes outside shard_map and enters the scan
+    # page-sharded. With search_structural_shard_spans the segment
+    # reshards trace-whole per page shard (span_sharded=True) and the
+    # verdict evaluates INSIDE the shard over the local chunk.
     span_device: dict | None = None
+    span_sharded: bool = False
 
 
 class DistributedScanEngine:
@@ -114,34 +118,49 @@ class DistributedScanEngine:
         from tempo_tpu.search.structural import STRUCTURAL
 
         span_dev = None
+        span_sharded = False
         if STRUCTURAL.enabled:
             span_host = STRUCTURAL.stage_single(pages, B)
             if span_host is not None:
-                # replicate (P()): parent pointers index the global span
-                # axis, which a page shard cannot see locally
-                rep = NamedSharding(self.mesh, P())
-                span_dev = {k: jax.device_put(v, rep)
-                            for k, v in span_host.items()}
+                if STRUCTURAL.shard_spans:
+                    sh = STRUCTURAL.shard_span_segment(
+                        span_host, self.n_shards, B,
+                        pages.geometry.entries_per_page)
+                    if sh is not None:
+                        # segment-aligned sharding: every span array
+                        # splits on its leading axis, aligned with the
+                        # page sharding — per-shard span HBM ~1/P
+                        span_dev = {k: jax.device_put(v, spec)
+                                    for k, v in sh.items()}
+                        span_sharded = True
+                if span_dev is None:
+                    # replicate (P()): parent pointers index the global
+                    # span axis, which a page shard cannot see locally
+                    rep = NamedSharding(self.mesh, P())
+                    span_dev = {k: jax.device_put(v, rep)
+                                for k, v in span_host.items()}
         return ShardedPages(device=dev, n_pages=pages.n_pages, pages=pages,
                             staged_dict=sd, widths=widths,
-                            span_device=span_dev)
+                            span_device=span_dev,
+                            span_sharded=span_sharded)
 
     # ---- kernel ----
 
     @functools.partial(jax.jit, static_argnames=("self", "n_terms",
                                                  "top_k", "widths",
-                                                 "plan"))
+                                                 "plan", "span_sharded"))
     def _dist_kernel(self, kv_key, kv_val, entry_start, entry_end,
                      entry_dur, entry_valid, term_keys, val_ranges,
                      dur_lo, dur_hi, win_start, win_end, val_hits=None,
                      entry_dur_res=None, span_cols=None, s_tables=None,
                      *, n_terms: int, top_k: int, widths=None,
-                     plan=None):
+                     plan=None, span_sharded=False):
         E = entry_valid.shape[1]
         local_flat = kv_key.shape[0] // self.n_shards * E
 
         struct_mask = None
-        if plan is not None:
+        sh_span_cols = sh_s_tables = None
+        if plan is not None and not span_sharded:
             # structural verdicts evaluate over the REPLICATED span
             # columns outside shard_map (the parent joins index the
             # global span axis), then shard with the page axis below
@@ -152,11 +171,16 @@ class DistributedScanEngine:
                 kv_key, kv_val, entry_dur, entry_valid, page_block,
                 entry_dur_res, span_cols, s_tables, plan=plan,
                 widths=widths)
+        elif plan is not None:
+            # segment-aligned sharded spans: the chunk-local columns go
+            # INTO the shard region and the joins stay shard-local
+            sh_span_cols, sh_s_tables = span_cols, s_tables
 
         def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                      entry_valid, term_keys, val_ranges,
                      dur_lo, dur_hi, win_start, win_end, val_hits,
-                     entry_dur_res, struct_mask):
+                     entry_dur_res, struct_mask, sh_span_cols,
+                     sh_s_tables):
             mask = entry_match_mask(
                 kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, term_keys, val_ranges, dur_lo, dur_hi,
@@ -165,6 +189,16 @@ class DistributedScanEngine:
             )
             if struct_mask is not None:
                 mask = mask & struct_mask
+            if plan is not None and span_sharded:
+                from tempo_tpu.search.structural import \
+                    structural_entry_mask
+
+                page_block = jnp.zeros(entry_valid.shape[0],
+                                       dtype=jnp.int32)
+                mask = mask & structural_entry_mask(
+                    kv_key, kv_val, entry_dur, entry_valid, page_block,
+                    entry_dur_res, sh_span_cols, sh_s_tables, plan=plan,
+                    widths=widths)
             local_count = jnp.sum(mask, dtype=jnp.int32)
             local_inspected = jnp.sum(entry_valid, dtype=jnp.int32)
             scores, idx = masked_topk(mask, entry_start, top_k)
@@ -186,18 +220,22 @@ class DistributedScanEngine:
             shard_fn, mesh=self.mesh,
             # val_hits (the device-probe hit mask) replicates like the
             # other predicate tables; a None leaf makes its spec a no-op;
-            # the packed-duration residual shards with the page axis
+            # the packed-duration residual shards with the page axis.
+            # Sharded span columns split on their leading axis (chunk-
+            # per-shard span axis / page axis); structural parameter
+            # tables replicate.
             in_specs=(P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS),
                       P(SCAN_AXIS), P(SCAN_AXIS),
                       P(), P(), P(), P(), P(), P(), P(), P(SCAN_AXIS),
-                      P(SCAN_AXIS)),
+                      P(SCAN_AXIS), P(SCAN_AXIS), P()),
             out_specs=(P(), P(), P(), P()),
             # all_gather+top_k yields identical values on every shard, but
             # the replication checker can't infer it through the gather
             check=False,
         )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
           term_keys, val_ranges, dur_lo, dur_hi, win_start, win_end,
-          val_hits, entry_dur_res, struct_mask)
+          val_hits, entry_dur_res, struct_mask, sh_span_cols,
+          sh_s_tables)
 
     # ---- public API ----
 
@@ -225,12 +263,14 @@ class DistributedScanEngine:
             s_tables = None if st is None else st.device_tables()
             span_cols = (getattr(sp, "span_device", None)
                          if st is not None else None)
+            span_sharded = bool(st is not None
+                                and getattr(sp, "span_sharded", False))
             miss = rec.compile_check(
                 ("dist", d["kv_key"].shape, str(d["kv_key"].dtype),
                  str(d["kv_val"].dtype), vr.shape,
                  None if vh is None else (tuple(vh.shape), str(vh.dtype)),
                  widths, cq.n_terms, k,
-                 None if st is None else st.shape_sig()))
+                 None if st is None else st.shape_sig(), span_sharded))
             from tempo_tpu.parallel.mesh import locked_collective
 
             # process-wide collective-ordering lock (parallel.mesh):
@@ -247,7 +287,7 @@ class DistributedScanEngine:
                         tk, vr, dlo, dhi, ws, we, vh,
                         d.get("entry_dur_res"), span_cols, s_tables,
                         n_terms=cq.n_terms, top_k=k, widths=widths,
-                        plan=plan,
+                        plan=plan, span_sharded=span_sharded,
                     )
             # fence after releasing the collective lock: a fenced wait
             # under dispatch_lock would stall every other mesh dispatch

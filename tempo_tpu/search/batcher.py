@@ -305,18 +305,26 @@ class QueryCoalescer:
         self._active_fn = active_fn or (lambda: 2)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._pending: dict[int, _PendingCoalesce] = {}
+        # pending-group key: (id(batch), None) for legacy queries, the
+        # stack_group_key tuple (id(batch), plan) for structural ones —
+        # same-plan structural peers share a group, different plans
+        # wait out disjoint windows and flush solo
+        self._pending: dict[tuple, _PendingCoalesce] = {}
         # window deadlines served by ONE long-lived scheduler thread
         # (lazily started): a threading.Timer per armed window would
         # create an OS thread per batch per window on the serving hot
-        # path — pure churn at thousands of windows/sec
-        self._deadlines: list[tuple[float, int, int]] = []  # (t, key, gen)
+        # path — pure churn at thousands of windows/sec. Heap entries
+        # carry gen SECOND so equal deadlines tie-break on the unique
+        # int and group keys (which hold plan tuples) never compare.
+        self._deadlines: list[tuple[float, int, tuple]] = []  # (t, gen, key)
         self._sched: threading.Thread | None = None
         self._flush_pool = None  # lazily built with the scheduler
         self._gen = 0
         self.dispatches = 0   # fused + solo kernel launches issued here
         self.fused = 0        # launches that served >1 query
         self.queries = 0      # queries served
+        self.structural_queries = 0  # structural queries served here
+        self.structural_stacked = 0  # ...that shared a fused dispatch
 
     def submit(self, batch, mq, top_k: int, peers: int | None = None):
         """Queue one compiled query against `batch`; returns a Future
@@ -324,6 +332,12 @@ class QueryCoalescer:
         same host types drain code gets from a direct dispatch. `peers`
         is the caller's count of in-flight searches that could target
         THIS batch (self included); <=1 flushes immediately.
+
+        Structural queries group by PLAN SHAPE (stack_group_key): with
+        search_structural_stack_enabled, same-plan concurrent queries
+        stack along the fused query axis like any other coalesced
+        member; with it off (or for a plan no peer shares) they flush
+        solo, and the stack_events counter says which.
 
         The submitter's active QueryStats is captured WITH the item
         (the contextvar does not survive into the window-timer flush
@@ -334,19 +348,26 @@ class QueryCoalescer:
         import time as _time
 
         fut = concurrent.futures.Future()
-        if getattr(mq, "structural", None) is not None:
-            # structural plans are static kernel descriptors: they can
-            # neither stack along the fused query axis nor share a
-            # window with stackable peers — dispatch solo NOW (the solo
-            # flush path reuses this plan's compiled executable)
-            grp = _PendingCoalesce(batch, 0)
-            grp.items.append((mq, top_k, fut, _time.perf_counter(),
-                              query_stats.current()))
-            self._run(grp)
-            return fut
+        st = getattr(mq, "structural", None)
+        key = (id(batch), None)
+        if st is not None:
+            skey = None
+            if _structural.STRUCTURAL.stack_enabled:
+                skey = _structural.STRUCTURAL.stack_group_key(batch, st)
+            if skey is None:
+                # stacking disabled: dispatch solo NOW (the pre-stacking
+                # behavior — the solo flush reuses this plan's compiled
+                # executable). gen=-1 marks the metric as already
+                # recorded here, so _run won't double-book solo_shape.
+                obs.structural_stack_events.inc(result="solo_disabled")
+                grp = _PendingCoalesce(batch, -1)
+                grp.items.append((mq, top_k, fut, _time.perf_counter(),
+                                  query_stats.current()))
+                self._run(grp)
+                return fut
+            key = skey
         flush_now = None
         with self._lock:
-            key = id(batch)
             grp = self._pending.get(key)
             if grp is None:
                 self._gen += 1
@@ -366,8 +387,8 @@ class QueryCoalescer:
                 else:
                     heapq.heappush(
                         self._deadlines,
-                        (_time.perf_counter() + self.window_s, key,
-                         grp.gen))
+                        (_time.perf_counter() + self.window_s, grp.gen,
+                         key))
                     if self._sched is None:
                         self._flush_pool = \
                             concurrent.futures.ThreadPoolExecutor(
@@ -402,7 +423,7 @@ class QueryCoalescer:
             with self._cv:
                 while not self._deadlines:
                     self._cv.wait()
-                deadline, key, gen = self._deadlines[0]
+                deadline, gen, key = self._deadlines[0]
                 wait = deadline - _time.perf_counter()
                 if wait > 0:
                     self._cv.wait(wait)
@@ -438,7 +459,19 @@ class QueryCoalescer:
             h2d += rd.get("h2d_bytes", 0)
         if not totals:
             totals = {"execute": wall_s}
-        weights = [max(1, int(it[0].term_keys.size)) for it in items]
+
+        def table_rows(mq) -> int:
+            # stacked structural members weigh their plan's parameter
+            # tables alongside the legacy term tables — a member whose
+            # probe masks dominated the fused kernel's reads gets the
+            # proportional share (conservation via apportion as before)
+            w = max(1, int(mq.term_keys.size))
+            st = getattr(mq, "structural", None)
+            if st is not None:
+                w += st.weight()
+            return w
+
+        weights = [table_rows(it[0]) for it in items]
         shares = query_stats.apportion(totals, weights)
         byte_shares = query_stats.apportion({"b": float(h2d)}, weights)
         for qs, share, bs in zip(stats, shares, byte_shares):
@@ -456,11 +489,28 @@ class QueryCoalescer:
             now = _time.perf_counter()
             for _mq, _k, _fut, t0, _qs in items:
                 obs.coalesce_wait_seconds.observe(now - t0)
+            structural = bool(
+                items and getattr(items[0][0], "structural", None)
+                is not None)
             with self._lock:  # _run races: window thread vs size flush
                 self.dispatches += 1
                 self.queries += len(items)
                 if len(items) > 1:
                     self.fused += 1
+                if structural:
+                    self.structural_queries += len(items)
+                    if len(items) > 1:
+                        self.structural_stacked += len(items)
+            if structural and grp.gen >= 0:
+                # gen=-1 groups booked solo_disabled at submit; here a
+                # fused flush books every member as stacked and a lone
+                # member as solo_shape — unstackable (peerless) plan
+                # shapes are visible, never a silent solo flush
+                if len(items) > 1:
+                    obs.structural_stack_events.inc(len(items),
+                                                    result="stacked")
+                else:
+                    obs.structural_stack_events.inc(result="solo_shape")
             if len(items) == 1:
                 mq, _k, fut, _t0, _qs = items[0]
                 t0d = _time.perf_counter()
@@ -503,6 +553,14 @@ class QueryCoalescer:
             "ratio": round(self.queries / max(1, self.dispatches), 3),
             "pending": pending,
             "window_ms": self.window_s * 1e3,
+            # plan-shape stacking visibility (/debug/scan): how many
+            # structural queries came through and what share of them
+            # actually shared a fused dispatch
+            "structural_queries": self.structural_queries,
+            "structural_stacked": self.structural_stacked,
+            "structural_stack_ratio": round(
+                self.structural_stacked
+                / max(1, self.structural_queries), 3),
         }
 
 
@@ -1577,9 +1635,10 @@ class BlockBatcher:
                     # with no possible same-batch peer (solo search, or
                     # a sibling sub-request over a disjoint batch) flushes
                     # immediately (no added latency). Structural queries
-                    # always flush solo — submit() itself short-circuits
-                    # them (their static plans cannot stack along the
-                    # vmap query axis).
+                    # group by PLAN SHAPE inside submit(): same-plan
+                    # peers stack along the fused query axis when
+                    # search_structural_stack_enabled, anything else
+                    # flushes solo (stack_events says which).
                     with self._lock:
                         peers = (self._interest.get(gkey, 1)
                                  + self._unplanned)

@@ -69,6 +69,12 @@ class BlockBatch:
     # staged with the batch only when search_structural_enabled AND some
     # block carries spans; None keeps the legacy kernel pytree exactly
     span_device: dict | None = None
+    # True = span columns are in the segment-aligned SHARDED layout
+    # (search_structural_shard_spans): chunk-per-shard span axis with
+    # shard-local coordinates, so the dist kernels evaluate the
+    # structural mask inside shard_map. Static at every consuming call
+    # site — part of the jit shape key like `widths`
+    span_sharded: bool = False
 
     @property
     def n_pages(self) -> int:
@@ -370,23 +376,57 @@ def place_batch(host: HostBatch, sharding=None, mesh=None) -> BlockBatch:
     profile.observe_stage("h2d", mode, time.perf_counter() - t0,
                           nbytes=sum(int(v.nbytes) for v in cat.values()))
     span_dev = None
+    span_sharded = False
     if host.span_cat is not None:
-        # span columns REPLICATE (never page-sharded): parent pointers
-        # and segment ranges index the GLOBAL span axis, and the dist
-        # kernels evaluate the structural mask outside shard_map then
-        # hand the [P,E] verdicts to the sharded scan
-        if sharding is not None and jax.process_count() > 1:
+        from .structural import STRUCTURAL
+
+        span_host = host.span_cat
+        if sharding is not None and STRUCTURAL.shard_spans:
+            # segment-aligned span sharding: each trace's contiguous
+            # span run lands whole on its page's shard, coordinates
+            # rebased shard-local — the host tier KEEPS the replicated
+            # layout (host_scan's byte-identical fallback), only the
+            # device placement reshards
+            E = host.blocks[0].geometry.entries_per_page
+            n_sh = int(sharding.mesh.devices.size)
+            sh = STRUCTURAL.shard_span_segment(
+                span_host, n_sh, int(host.page_block.shape[0]), E)
+            if sh is not None:
+                span_host = sh
+                span_sharded = True
+        if sharding is not None and span_sharded:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from tempo_tpu.parallel.mesh import SCAN_AXIS
+
+            # every sharded span array (span axis AND the [P, E] entry
+            # range columns) splits on its leading axis, aligned with
+            # the page sharding — per-shard span HBM ~1/P of replicated
+            sh_spec = NamedSharding(sharding.mesh, P(SCAN_AXIS))
+            if jax.process_count() > 1:
+                span_dev = {
+                    k: jax.make_array_from_callback(
+                        v.shape, sh_spec, lambda idx, v=v: v[idx])
+                    for k, v in span_host.items()
+                }
+            else:
+                span_dev = {k: jax.device_put(v, sh_spec)
+                            for k, v in span_host.items()}
+        elif sharding is not None and jax.process_count() > 1:
+            # span columns REPLICATE (the legacy layout): parent
+            # pointers and segment ranges index the GLOBAL span axis,
+            # and the dist kernels evaluate the structural mask outside
+            # shard_map then hand the [P,E] verdicts to the sharded scan
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             rep = NamedSharding(sharding.mesh, P())
             span_dev = {
                 k: jax.make_array_from_callback(
                     v.shape, rep, lambda idx, v=v: v[idx])
-                for k, v in host.span_cat.items()
+                for k, v in span_host.items()
             }
         else:
             span_dev = {k: jnp.asarray(v)
-                        for k, v in host.span_cat.items()}
+                        for k, v in span_host.items()}
     staged = {}
     for fp, pd in host.packed_dicts.items():
         dict_mesh = (mesh if mesh is not None and pd.n_shards > 1
@@ -396,7 +436,7 @@ def place_batch(host: HostBatch, sharding=None, mesh=None) -> BlockBatch:
                       blocks=host.blocks, page_offset=host.page_offset,
                       staged_dicts=staged, widths=host.widths,
                       logical_device_nbytes=host.cat_logical_nbytes,
-                      span_device=span_dev)
+                      span_device=span_dev, span_sharded=span_sharded)
 
 
 def stack_blocks(blocks: list[ColumnarPages], pad_to: int | None = None,
@@ -604,6 +644,10 @@ class CoalescedQuery:
     # row — its range tables apply). None when no member probed.
     val_hits: object = None
     block_group: np.ndarray | None = None
+    # plan-shape stacking (structural.StackedStructural): ONE shared
+    # static plan + [Q, ...]-stacked structural parameter tables. None
+    # = the legacy pytree and executables exactly.
+    structural: object = None
 
 
 def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
@@ -612,16 +656,32 @@ def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
     cache keys on predicate SHAPE buckets, never predicate values —
     different tag-sets share one compiled executable.
 
+    Structural queries stack too, when EVERY member carries one and all
+    plans are the identical static descriptor (the coalescer's
+    stack_group_key guarantees this grouping): their parameter tables
+    stack along the same query axis (structural.stack_structural) and
+    the shared plan stays one jit key. A mixed group — some structural,
+    some not, or differing plans — is a caller bug and raises rather
+    than silently dropping a predicate.
+
     Pad semantics: extra terms of a real query are inactive (neutral-TRUE
     in the AND); whole pad QUERIES get an empty duration window
     (dur_lo=1 > dur_hi=0) so their mask is all-false and their top-k is
-    all sentinel — dead lanes, not wrong results."""
+    all sentinel — dead lanes, not wrong results (structural pad lanes
+    replay member 0's tables behind that same all-false legacy mask)."""
     Qn = len(mqs)
-    if any(getattr(mq, "structural", None) is not None for mq in mqs):
-        # the coalescer routes structural queries to solo flushes (their
-        # static plans cannot stack along the vmap query axis); a mixed
-        # stack here would silently drop the structural predicate
-        raise ValueError("structural queries cannot be coalesced")
+    sts = [getattr(mq, "structural", None) for mq in mqs]
+    stacked_st = None
+    if any(st is not None for st in sts):
+        from .structural import stack_structural
+
+        if (any(st is None for st in sts)
+                or any(st.plan != sts[0].plan for st in sts[1:])):
+            # plan-shape grouping happens UPSTREAM (stack_group_key);
+            # a mixed stack here would silently drop a predicate
+            raise ValueError(
+                "coalesced structural queries must all share one plan")
+        stacked_st = stack_structural(sts, _pow2(Qn))
     B = mqs[0].term_keys.shape[0]
     Q = _pow2(Qn)
     T = _pow2(max(1, max(mq.n_terms for mq in mqs)))
@@ -677,7 +737,8 @@ def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
     return CoalescedQuery(
         term_keys=term_keys, val_ranges=val_ranges, term_active=term_active,
         dur_lo=dur_lo, dur_hi=dur_hi, win_start=win_start, win_end=win_end,
-        n_terms=T, n_queries=Qn, val_hits=val_hits, block_group=block_group)
+        n_terms=T, n_queries=Qn, val_hits=val_hits, block_group=block_group,
+        structural=stacked_st)
 
 
 def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
@@ -782,7 +843,7 @@ def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
 
 @functools.partial(jax.jit,
                    static_argnames=("mesh", "n_terms", "top_k", "widths",
-                                    "plan"))
+                                    "plan", "span_sharded"))
 def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                            entry_dur, entry_valid, page_block, term_keys,
                            val_ranges, dur_lo, dur_hi, win_start, win_end,
@@ -790,7 +851,7 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                            entry_dur_res=None,
                            span_cols=None, s_tables=None,
                            *, n_terms: int, top_k: int, widths=None,
-                           plan=None):
+                           plan=None, span_sharded=False):
     """Multi-block scan sharded over the mesh's scan axis: the stacked
     page axis (blocks × pages — the corpus 'sequence' axis, SURVEY.md §5)
     splits across devices; the [B,...] term tables replicate; counts
@@ -798,12 +859,21 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
     global top-k — one jit call, collectives riding ICI (the TPU-native
     Results funnel, reference results.go:38-141).
 
-    The structural predicate (plan + span_cols/s_tables) evaluates
-    OUTSIDE the shard_map over the replicated span columns — parent
-    pointers and segment ranges index the global span axis, which a
-    page-axis shard cannot see — and its [P, E] verdicts enter the
-    sharded region as one more page-sharded operand (GSPMD reshards
-    them; same jit, still one dispatch)."""
+    The structural predicate (plan + span_cols/s_tables) has two
+    placements, selected by the STATIC `span_sharded` flag (part of the
+    jit key, like `widths`):
+
+      - replicated span columns (legacy): the mask evaluates OUTSIDE
+        the shard_map — parent pointers index the global span axis,
+        which a page shard cannot see — and its [P, E] verdicts enter
+        the sharded region as one more page-sharded operand;
+      - segment-aligned sharded span columns
+        (search_structural_shard_spans): each trace's span run lives
+        whole on its page's shard in shard-local coordinates, so the
+        `child` gather and `desc` pointer-doubling evaluate INSIDE
+        shard_fn over the local chunk — parent joins scale with the
+        mesh, per-shard span HBM ~1/P, and only the per-trace verdict
+        feeds the existing collectives."""
     from jax.sharding import PartitionSpec as P
     from tempo_tpu.parallel.mesh import SCAN_AXIS
 
@@ -812,17 +882,21 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
     local_flat = kv_key.shape[0] // n_shards * E
 
     struct_mask = None
-    if plan is not None:
+    sh_span_cols = sh_s_tables = None
+    if plan is not None and not span_sharded:
         from .structural import structural_entry_mask
 
         struct_mask = structural_entry_mask(
             kv_key, kv_val, entry_dur, entry_valid, page_block,
             entry_dur_res, span_cols, s_tables, plan=plan, widths=widths)
+    elif plan is not None:
+        sh_span_cols, sh_s_tables = span_cols, s_tables
 
     def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                  entry_valid, page_block, term_keys, val_ranges,
                  dur_lo, dur_hi, win_start, win_end, val_hits,
-                 block_group, entry_dur_res, struct_mask):
+                 block_group, entry_dur_res, struct_mask,
+                 sh_span_cols, sh_s_tables):
         mask = multi_entry_mask(
             kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
             page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
@@ -832,6 +906,17 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
         )
         if struct_mask is not None:
             mask = mask & struct_mask
+        if plan is not None and span_sharded:
+            from .structural import structural_entry_mask
+
+            # shard-local evaluation: the local span chunk's
+            # parent/begin columns are already in local coordinates
+            # (shard_span_segment rebased them), so the joins and
+            # segment reductions never leave the shard
+            mask = mask & structural_entry_mask(
+                kv_key, kv_val, entry_dur, entry_valid, page_block,
+                entry_dur_res, sh_span_cols, sh_s_tables, plan=plan,
+                widths=widths)
         local_count = jnp.sum(mask, dtype=jnp.int32)
         local_inspected = jnp.sum(
             entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
@@ -853,25 +938,31 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
         # the probe hit mask + block->group map replicate like the other
         # predicate tables (a None leaf makes its spec a no-op); the
         # duration residual and the structural verdicts shard with the
-        # page axis
+        # page axis. Sharded span columns split on their leading axis
+        # (the chunk-per-shard span axis / the page axis of the entry
+        # range columns); the structural parameter tables replicate.
         in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 8
-        + (P(SCAN_AXIS), P(SCAN_AXIS)),
+        + (P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS), P()),
         out_specs=(P(), P(), P(), P()),
         # all_gather+top_k yields identical values on every shard, but the
         # replication checker can't infer it through the gather
         check=False,
     )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
       page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
-      win_end, val_hits, block_group, entry_dur_res, struct_mask)
+      win_end, val_hits, block_group, entry_dur_res, struct_mask,
+      sh_span_cols, sh_s_tables)
 
 
-@functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths"))
+@functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths",
+                                             "plan"))
 def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                           entry_valid, page_block, term_keys, val_ranges,
                           term_active, dur_lo, dur_hi, win_start, win_end,
                           val_hits=None, block_group=None,
-                          entry_dur_res=None,
-                          *, n_terms: int, top_k: int, widths=None):
+                          entry_dur_res=None, span_cols=None,
+                          s_tables=None,
+                          *, n_terms: int, top_k: int, widths=None,
+                          plan=None):
     """The query-axis variant of multi_scan_kernel: predicate tables are
     [Q, ...]-stacked and vmap lifts the per-query mask + top-k over the
     query axis — ONE dispatch serves Q concurrent requests over the same
@@ -879,40 +970,64 @@ def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
     of Q (the scan is bandwidth-bound; queries amortize the read).
     Returns (counts i32 [Q], inspected i32, scores i32 [Q,k],
     flat idx i32 [Q,k]). `inspected` is query-independent (every query
-    sees the same staged pages), so it stays scalar."""
+    sees the same staged pages), so it stays scalar.
+
+    `plan` (static) + `s_tables` ([Q, ...]-stacked structural parameter
+    tables) + `span_cols` (the batch's staged span columns, SHARED
+    across the query axis): plan-shape stacking — every member lowered
+    to the same plan descriptor, so vmap lifts one compiled structural
+    predicate over per-query tables, same as the legacy tables."""
     inspected = jnp.sum(entry_valid & (page_block >= 0)[:, None],
                         dtype=jnp.int32)
 
-    def one_query(tk, vr, ta, dlo, dhi, ws, we, vh, bg):
+    def one_query(tk, vr, ta, dlo, dhi, ws, we, vh, bg, st_t):
         mask = multi_entry_mask(
             kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
             page_block, tk, vr, dlo, dhi, ws, we,
             n_terms=n_terms, term_active=ta, val_hits=vh, block_group=bg,
             entry_dur_res=entry_dur_res, widths=widths)
+        if plan is not None:
+            from .structural import structural_entry_mask
+
+            # span_cols close over (query-invariant — vmap broadcasts);
+            # only the parameter tables map along the query axis
+            mask = mask & structural_entry_mask(
+                kv_key, kv_val, entry_dur, entry_valid, page_block,
+                entry_dur_res, span_cols, st_t, plan=plan, widths=widths)
         count = jnp.sum(mask, dtype=jnp.int32)
         scores, idx = masked_topk(mask, entry_start, top_k)
         return count, scores, idx
 
-    # val_hits/block_group are [Q,...]-stacked like the other predicate
-    # tables (None vmaps as an empty pytree — no leaves to map)
+    # val_hits/block_group/s_tables are [Q,...]-stacked like the other
+    # predicate tables (None vmaps as an empty pytree — no leaves)
     counts, scores, idx = jax.vmap(one_query)(
         term_keys, val_ranges, term_active, dur_lo, dur_hi,
-        win_start, win_end, val_hits, block_group)
+        win_start, win_end, val_hits, block_group, s_tables)
     return counts, inspected, scores, idx
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("mesh", "n_terms", "top_k", "widths"))
+                   static_argnames=("mesh", "n_terms", "top_k", "widths",
+                                    "plan", "span_sharded"))
 def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                                entry_dur, entry_valid, page_block, term_keys,
                                val_ranges, term_active, dur_lo, dur_hi,
                                win_start, win_end, val_hits=None,
                                block_group=None, entry_dur_res=None,
-                               *, n_terms: int, top_k: int, widths=None):
+                               span_cols=None, s_tables=None,
+                               *, n_terms: int, top_k: int, widths=None,
+                               plan=None, span_sharded=False):
     """Coalesced scan sharded over the mesh's scan axis: the page axis
     splits across devices, the [Q,...] query tables replicate, and the
     per-shard per-query top-k candidates all_gather into a per-query
-    global top-k (lax.top_k batches over the leading query axis)."""
+    global top-k (lax.top_k batches over the leading query axis).
+
+    Plan-shape stacking composes with both span layouts (the static
+    `span_sharded` flag, see dist_multi_scan_kernel): with replicated
+    spans the [Q, P, E] structural verdicts vmap OUTSIDE the shard_map
+    and enter page-sharded on their second axis; with segment-aligned
+    sharded spans the vmapped evaluation runs INSIDE shard_fn over the
+    local span chunk."""
     from jax.sharding import PartitionSpec as P
     from tempo_tpu.parallel.mesh import SCAN_AXIS
 
@@ -920,27 +1035,51 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
     E = entry_valid.shape[1]
     local_flat = kv_key.shape[0] // n_shards * E
 
+    struct_masks = None
+    sh_span_cols = sh_s_tables = None
+    if plan is not None and not span_sharded:
+        from .structural import structural_entry_mask
+
+        struct_masks = jax.vmap(
+            lambda st_t: structural_entry_mask(
+                kv_key, kv_val, entry_dur, entry_valid, page_block,
+                entry_dur_res, span_cols, st_t, plan=plan,
+                widths=widths))(s_tables)                # [Q, P, E]
+    elif plan is not None:
+        sh_span_cols, sh_s_tables = span_cols, s_tables
+
     def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                  entry_valid, page_block, term_keys, val_ranges,
                  term_active, dur_lo, dur_hi, win_start, win_end,
-                 val_hits, block_group, entry_dur_res):
+                 val_hits, block_group, entry_dur_res, struct_masks,
+                 sh_span_cols, sh_s_tables):
         local_inspected = jnp.sum(
             entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
 
-        def one_query(tk, vr, ta, dlo, dhi, ws, we, vh, bg):
+        def one_query(tk, vr, ta, dlo, dhi, ws, we, vh, bg, sm, st_t):
             mask = multi_entry_mask(
                 kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, page_block, tk, vr, dlo, dhi, ws, we,
                 n_terms=n_terms, term_active=ta, val_hits=vh,
                 block_group=bg, entry_dur_res=entry_dur_res,
                 widths=widths)
+            if sm is not None:
+                mask = mask & sm
+            if plan is not None and span_sharded:
+                from .structural import structural_entry_mask
+
+                mask = mask & structural_entry_mask(
+                    kv_key, kv_val, entry_dur, entry_valid, page_block,
+                    entry_dur_res, sh_span_cols, st_t, plan=plan,
+                    widths=widths)
             count = jnp.sum(mask, dtype=jnp.int32)
             scores, idx = masked_topk(mask, entry_start, top_k)
             return count, scores, idx
 
         counts, scores, idx = jax.vmap(one_query)(
             term_keys, val_ranges, term_active, dur_lo, dur_hi,
-            win_start, win_end, val_hits, block_group)
+            win_start, win_end, val_hits, block_group, struct_masks,
+            sh_s_tables)
         shard = jax.lax.axis_index(SCAN_AXIS).astype(jnp.int32)
         gidx = idx + shard * local_flat
         counts = jax.lax.psum(counts, SCAN_AXIS)
@@ -959,14 +1098,19 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
 
     return shard_map_compat(
         shard_fn, mesh=mesh,
-        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 9 + (P(SCAN_AXIS),),
+        # stacked structural verdicts [Q, P, E] shard on the PAGE axis
+        # (second); sharded span columns on their leading axis; the
+        # stacked parameter tables replicate like the query tables
+        in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 9
+        + (P(SCAN_AXIS), P(None, SCAN_AXIS), P(SCAN_AXIS), P()),
         out_specs=(P(), P(), P(), P()),
         # same stance as dist_multi_scan_kernel: the gather+top_k output
         # is replicated but the replication checker can't infer it
         check=False,
     )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
       page_block, term_keys, val_ranges, term_active, dur_lo, dur_hi,
-      win_start, win_end, val_hits, block_group, entry_dur_res)
+      win_start, win_end, val_hits, block_group, entry_dur_res,
+      struct_masks, sh_span_cols, sh_s_tables)
 
 
 class MultiBlockEngine:
@@ -1062,12 +1206,13 @@ class MultiBlockEngine:
                     d["entry_end"], d["entry_dur"], d["entry_valid"],
                     d["page_block"], tk, vr, dlo, dhi, ws, we, vh, bg,
                     d.get("entry_dur_res"), span_cols, s_tables)
+            span_sharded = bool(st is not None and batch.span_sharded)
             miss = rec.compile_check(
                 ("multi", self.mesh is not None, d["kv_key"].shape,
                  str(d["kv_key"].dtype), str(d["kv_val"].dtype), vr.shape,
                  None if vh is None else (tuple(vh.shape), str(vh.dtype)),
                  widths, mq.n_terms, k,
-                 None if st is None else st.shape_sig(),
+                 None if st is None else st.shape_sig(), span_sharded,
                  None if span_cols is None else
                  tuple(sorted((n, tuple(a.shape))
                               for n, a in span_cols.items()))))
@@ -1083,7 +1228,8 @@ class MultiBlockEngine:
                     with rec.stage(stage):
                         out = dist_multi_scan_kernel(
                             self.mesh, *args, n_terms=mq.n_terms, top_k=k,
-                            widths=widths, plan=plan)
+                            widths=widths, plan=plan,
+                            span_sharded=span_sharded)
                 # fence AFTER releasing the collective lock: a fenced
                 # wait under dispatch_lock would serialize every other
                 # mesh dispatch behind this kernel's completion (the
@@ -1133,19 +1279,35 @@ class MultiBlockEngine:
                     jnp.asarray(cq.term_active),
                     jnp.asarray(cq.dur_lo), jnp.asarray(cq.dur_hi),
                     jnp.asarray(cq.win_start), jnp.asarray(cq.win_end))
+                # plan-shape stacking (structural.StackedStructural):
+                # one shared static plan, [Q,...]-stacked parameter
+                # tables uploaded once per fused dispatch
+                st = getattr(cq, "structural", None)
+                plan = None if st is None else st.plan
+                s_tables = None if st is None else st.device_tables()
+                span_cols = batch.span_device if st is not None else None
+            st_bytes = 0 if st is None else sum(
+                int(getattr(t, "nbytes", 0)) for t in st.tables
+                if t is not None)
             rec.add_bytes(h2d=cq.term_keys.nbytes + cq.val_ranges.nbytes
-                          + cq.term_active.nbytes + 16 * len(cq.dur_lo))
+                          + cq.term_active.nbytes + 16 * len(cq.dur_lo)
+                          + st_bytes)
             widths = batch.widths
+            span_sharded = bool(st is not None and batch.span_sharded)
             args = (d["kv_key"], d["kv_val"], d["entry_start"],
                     d["entry_end"], d["entry_dur"], d["entry_valid"],
                     d["page_block"], *tables, vh, bg,
-                    d.get("entry_dur_res"))
+                    d.get("entry_dur_res"), span_cols, s_tables)
             miss = rec.compile_check(
                 ("coalesced", self.mesh is not None, d["kv_key"].shape,
                  str(d["kv_key"].dtype), str(d["kv_val"].dtype),
                  cq.term_keys.shape, cq.val_ranges.shape,
                  None if vh is None else (tuple(vh.shape), str(vh.dtype)),
-                 widths, cq.n_terms, top_k))
+                 widths, cq.n_terms, top_k,
+                 None if st is None else st.shape_sig(), span_sharded,
+                 None if span_cols is None else
+                 tuple(sorted((n, tuple(a.shape))
+                              for n, a in span_cols.items()))))
             stage = "compile" if miss else "execute"
             rec.set(kernel="coalesced", queries=cq.n_queries,
                     scan_bytes=batch.device_nbytes)
@@ -1156,7 +1318,8 @@ class MultiBlockEngine:
                     with rec.stage(stage):
                         out = dist_coalesced_scan_kernel(
                             self.mesh, *args, n_terms=cq.n_terms,
-                            top_k=top_k, widths=widths)
+                            top_k=top_k, widths=widths, plan=plan,
+                            span_sharded=span_sharded)
                 # fence outside the collective lock (see
                 # _scan_async_impl — same lock-order stance)
                 with rec.stage(stage):
@@ -1164,7 +1327,8 @@ class MultiBlockEngine:
                 return out
             with rec.stage(stage):
                 out = coalesced_scan_kernel(*args, n_terms=cq.n_terms,
-                                            top_k=top_k, widths=widths)
+                                            top_k=top_k, widths=widths,
+                                            plan=plan)
                 rec.fence(out)
             return out
 

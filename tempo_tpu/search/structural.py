@@ -70,6 +70,17 @@ class StructuralGate:
         self.max_spans = 512      # span rows captured per trace at ingest
         self.max_span_kvs = 16    # kv pairs captured per span at ingest
         self.max_nodes = ir.MAX_NODES  # parse-time IR size cap
+        # plan-shape query stacking (search_structural_stack_enabled):
+        # concurrent structural queries sharing one PLAN descriptor
+        # stack along the coalescer's query axis into one fused
+        # dispatch. Off (default) keeps the solo-flush behavior exactly
+        self.stack_enabled = False
+        # segment-aligned span sharding (search_structural_shard_spans):
+        # mesh staging reshards the span segment so each trace's span
+        # run lands whole on its page's shard — parent joins go
+        # shard-local and span HBM per shard drops ~1/P. Off (default)
+        # keeps the replicated layout exactly
+        self.shard_spans = False
         self._parse_cache: OrderedDict = OrderedDict()
         self._parse_lock = threading.Lock()
 
@@ -131,12 +142,106 @@ class StructuralGate:
         return self.stack_spans([pages], pages.geometry.entries_per_page,
                                 pad_pages)
 
+    def stack_group_key(self, batch, st) -> tuple | None:
+        """THE plan-shape stacking gate: the coalescer's pending-group
+        key for a structural query, or None — one attribute read when
+        search_structural_stack_enabled is off (the caller's solo-flush
+        path). Two structural queries share a key iff they target the
+        same staged batch AND lowered to the identical static plan
+        descriptor: the plan is the jit key, so same-plan members share
+        one compiled executable and only their parameter tables differ
+        — exactly the continuous-batching shape the legacy coalescer
+        exploits."""
+        if not self.stack_enabled:
+            return None
+        return (id(batch), st.plan)
+
+    def shard_span_segment(self, span_cat: dict, n_shards: int,
+                           pad_pages: int, E: int) -> dict | None:
+        """THE span-sharding gate: reshard a replicated-layout span
+        segment (stack_spans output) into the segment-aligned sharded
+        layout, or None — one attribute read when
+        search_structural_shard_spans is off, and None whenever the
+        page axis does not divide evenly over the mesh (the caller
+        keeps the replicated layout; still correct, just not sharded).
+
+        Layout: the span axis becomes ``n_shards`` consecutive chunks
+        of one uniform pow2 ``per_shard`` length, chunk ``s`` holding
+        exactly the spans of traces whose page lands on shard ``s``
+        (per-trace runs are contiguous and a trace lives on one page,
+        so segments never straddle chunks). Coordinates REBASE to the
+        shard-local frame shard_map hands each device: ``span_trace``
+        to the local entry flat index, ``span_parent`` and
+        ``entry_span_begin`` to chunk-local span positions — the
+        ``child`` gather and ``desc`` pointer-doubling then read only
+        local rows, and per-shard span HBM is ~1/P of the replicated
+        layout."""
+        if not self.shard_spans:
+            return None
+        if n_shards <= 1 or pad_pages % n_shards:
+            return None
+        S_old = int(span_cat["span_trace"].shape[0])
+        pp = pad_pages // n_shards          # pages per shard
+        trace = span_cat["span_trace"]
+        live = trace >= 0
+        shard_of = np.where(live, trace // (pp * E), -1)
+        per_shard = _pow2(max(
+            1, int(np.bincount(shard_of[live], minlength=n_shards).max()
+                   if live.any() else 1)))
+        S_new = n_shards * per_shard
+        Cs = span_cat["span_kv_key"].shape[1]
+        out = {
+            "span_trace": np.full(S_new, -1, dtype=np.int32),
+            "span_parent": np.full(S_new, -1, dtype=np.int32),
+            "span_block": np.zeros(S_new, dtype=np.int32),
+            "span_dur": np.zeros(S_new, dtype=np.uint32),
+            "span_kind": np.zeros(S_new, dtype=np.int8),
+            "span_kv_key": np.full((S_new, Cs), -1, dtype=np.int32),
+            "span_kv_val": np.full((S_new, Cs), -1, dtype=np.int32),
+        }
+        # old global span index -> chunk-LOCAL position (for the parent
+        # and entry_span_begin rebase); -1 = dropped padding row
+        local_of = np.full(S_old, -1, dtype=np.int64)
+        for s in range(n_shards):
+            idx = np.flatnonzero(shard_of == s)
+            n = len(idx)
+            if not n:
+                continue
+            local_of[idx] = np.arange(n)
+            dst = slice(s * per_shard, s * per_shard + n)
+            out["span_trace"][dst] = trace[idx] - s * pp * E
+            par = span_cat["span_parent"][idx]
+            safe = np.clip(par, 0, S_old - 1)
+            # a parent is always the same trace (collect_span_rows
+            # resolves within one trace), hence the same shard; a
+            # malformed cross-shard pointer maps to -1 (no parent) —
+            # the explicit shard check matters because local_of is one
+            # global map, so an already-processed OTHER shard's local
+            # index would otherwise rebase to a wrong in-chunk row
+            out["span_parent"][dst] = np.where(
+                (par >= 0) & (shard_of[safe] == s)
+                & (local_of[safe] >= 0),
+                local_of[safe], -1).astype(np.int32)
+            for name in ("span_block", "span_dur", "span_kind"):
+                out[name][dst] = span_cat[name][idx]
+            out["span_kv_key"][dst] = span_cat["span_kv_key"][idx]
+            out["span_kv_val"][dst] = span_cat["span_kv_val"][idx]
+        begin = span_cat["entry_span_begin"]
+        count = span_cat["entry_span_count"]
+        safe_b = np.clip(begin, 0, S_old - 1)
+        out["entry_span_begin"] = np.where(
+            count > 0, local_of[safe_b], 0).astype(np.int32)
+        out["entry_span_count"] = count
+        return out
+
 
 STRUCTURAL = StructuralGate()
 
 
 def configure(enabled: bool | None = None, max_spans: int | None = None,
-              max_span_kvs: int | None = None) -> StructuralGate:
+              max_span_kvs: int | None = None,
+              stack_enabled: bool | None = None,
+              shard_spans: bool | None = None) -> StructuralGate:
     """Apply TempoDBConfig.search_structural_* to the process gate (most
     recent TempoDB wins — the PACKING/OWNERSHIP idiom)."""
     if enabled is not None:
@@ -145,6 +250,10 @@ def configure(enabled: bool | None = None, max_spans: int | None = None,
         STRUCTURAL.max_spans = max(1, int(max_spans))
     if max_span_kvs is not None:
         STRUCTURAL.max_span_kvs = max(1, int(max_span_kvs))
+    if stack_enabled is not None:
+        STRUCTURAL.stack_enabled = bool(stack_enabled)
+    if shard_spans is not None:
+        STRUCTURAL.shard_spans = bool(shard_spans)
     return STRUCTURAL
 
 
@@ -241,15 +350,7 @@ class CompiledStructural:
         """Tables as device arrays, uploaded once per compiled query
         (the query_device_params idiom — re-putting per dispatch costs
         ~ms each through a relay)."""
-        import jax.numpy as jnp
-
-        cached = getattr(self, "_device_tables", None)
-        if cached is None:
-            cached = tuple(
-                (jnp.asarray(t) if isinstance(t, np.ndarray) else t)
-                for t in self.tables())
-            self._device_tables = cached
-        return cached
+        return _device_tables_cached(self, self.tables())
 
     def shape_sig(self) -> tuple:
         """Jit-cache contribution: the plan IS shape (static), plus the
@@ -257,6 +358,13 @@ class CompiledStructural:
         def sig(t):
             return None if t is None else (tuple(t.shape), str(t.dtype))
         return (self.plan,) + tuple(sig(t) for t in self.tables())
+
+    def weight(self) -> int:
+        """Apportionment weight of this predicate's dynamic tables —
+        added to the legacy table rows when a fused dispatch's measured
+        stage times split across members (query_stats.apportion)."""
+        return int(sum(int(t.size) for t in self.tables()
+                       if t is not None))
 
     def explain(self, measured_device_s: float | None = None,
                 rate_s_per_byte: float | None = None) -> dict:
@@ -283,6 +391,120 @@ class CompiledStructural:
                     measured_device_s * (nb / total_bytes) * 1e3, 6)
             out["nodes"].append(node)
         return out
+
+
+@dataclass
+class StackedStructural:
+    """Q same-plan compiled predicates stacked along the coalescer's
+    query axis: ONE static plan (shared jit key — plan equality is what
+    the stacking group is keyed on) and 7 dynamic tables with a leading
+    [Q] axis, padded to the group max where members may legitimately
+    differ (value-range width R; probe-mask G/Vm). Pad query lanes copy
+    member 0's tables — always-valid values on lanes the legacy pad
+    predicate (empty duration window) already forces all-false."""
+
+    plan: tuple
+    tables: tuple            # 7 leaves, each [Q, ...] or None
+    n_queries: int
+
+    def device_tables(self):
+        return _device_tables_cached(self, self.tables)
+
+    def shape_sig(self) -> tuple:
+        def sig(t):
+            return None if t is None else (tuple(t.shape), str(t.dtype))
+        return (self.plan,) + tuple(sig(t) for t in self.tables)
+
+
+def stack_structural(sts: list, pad_q: int) -> StackedStructural:
+    """Stack same-plan compiled predicates along a new leading query
+    axis (pad_q = the coalescer's pow2 query count). All members MUST
+    share one plan descriptor (the stack_group_key contract); value
+    ranges pad to the pow2 group max with the empty [1, 0] range, and
+    probe masks pad to the group (G, Vm) max with all-false rows behind
+    an all -1 block_group — members that compiled through the host
+    range path never read them, exactly like stack_queries' legacy
+    probe stacking."""
+    import jax.numpy as jnp
+
+    from . import packing
+
+    plan = sts[0].plan
+    for st in sts[1:]:
+        if st.plan != plan:
+            raise StructuralCompileError(
+                "stacked structural members must share one plan")
+    Qn = len(sts)
+
+    def lane(i: int):
+        # pad lanes replay member 0: valid parameters on dead lanes
+        return sts[i] if i < Qn else sts[0]
+
+    def stack_plain(name: str):
+        rows = [getattr(lane(i), name) for i in range(pad_q)]
+        if rows[0] is None:
+            return None
+        return np.stack(rows)
+
+    # val_ranges: same (B, T) under one plan, R pads to the pow2 max
+    vr0 = sts[0].val_ranges
+    val_ranges = None
+    if vr0 is not None:
+        R = _pow2(max(st.val_ranges.shape[2] for st in sts))
+        B, T = vr0.shape[0], vr0.shape[1]
+        val_ranges = np.tile(np.array([1, 0], dtype=np.int32),
+                             (pad_q, B, T, R, 1))
+        for qi in range(pad_q):
+            vr = lane(qi).val_ranges
+            val_ranges[qi, :, :, :vr.shape[2]] = vr
+    # probe product: mixed device/host members stack like stack_queries
+    # — zero masks + all -1 group rows for host-path lanes
+    val_hits = block_group = None
+    if any(st.val_hits is not None for st in sts):
+        hits = {id(st): st.val_hits for st in sts
+                if st.val_hits is not None}
+        if any(packing.is_packed_mask(h) for h in hits.values()):
+            hits = {k: packing.pack_mask_words(h)
+                    for k, h in hits.items()}
+        Gm = max(int(h.shape[0]) for h in hits.values())
+        Tm = max(int(h.shape[1]) for h in hits.values())
+        Vm = max(int(h.shape[2]) for h in hits.values())
+        dt = next(iter(hits.values())).dtype
+        zero = jnp.zeros((Gm, Tm, Vm), dtype=dt)
+        B = sts[0].term_keys.shape[0]
+        block_group = np.full((pad_q, B), -1, dtype=np.int32)
+        rows = []
+        for qi in range(pad_q):
+            st = lane(qi)
+            if st.val_hits is None or qi >= Qn:
+                rows.append(zero)
+                continue
+            h = hits[id(st)]
+            rows.append(jnp.pad(h, ((0, Gm - h.shape[0]),
+                                    (0, Tm - h.shape[1]),
+                                    (0, Vm - h.shape[2]))))
+            block_group[qi] = st.block_group
+        val_hits = jnp.stack(rows)                 # [Q, Gm, Tm, Vm]
+    return StackedStructural(
+        plan=plan,
+        tables=(stack_plain("term_keys"), val_ranges, val_hits,
+                block_group, stack_plain("dur_params"),
+                stack_plain("kind_params"), stack_plain("agg_params")),
+        n_queries=Qn)
+
+
+def _device_tables_cached(owner, tables: tuple) -> tuple:
+    """One upload per compiled/stacked predicate, memoized on the owner
+    (shared by CompiledStructural and StackedStructural so the upload
+    path has exactly one implementation)."""
+    import jax.numpy as jnp
+
+    cached = getattr(owner, "_device_tables", None)
+    if cached is None:
+        cached = owner._device_tables = tuple(
+            (jnp.asarray(t) if isinstance(t, np.ndarray) else t)
+            for t in tables)
+    return cached
 
 
 class StructuralCompileError(ValueError):

@@ -74,6 +74,29 @@ def plan_clean_kernel(arr, *, plan):
     return _lower_fixture(arr, plan)
 
 
+def _layout_fixture(arr, span_sharded):
+    """Span-layout-descriptor-shaped helper (segment-aligned span
+    sharding idiom): selects the replicated-vs-sharded evaluation
+    placement by branching on its descriptor at trace time, so a
+    tracer reaching `span_sharded` is a trace-time leak."""
+    if span_sharded:
+        return arr[: arr.shape[0] // 2]
+    return arr
+
+
+@functools.partial(jax.jit, static_argnames=("span_sharded",))
+def span_layout_taint_kernel(arr, sel, *, span_sharded):
+    # VIOLATION: tracer data passed as the span-layout descriptor —
+    # the helper picks the layout branch at trace time
+    return _layout_fixture(arr, sel[0])
+
+
+@functools.partial(jax.jit, static_argnames=("span_sharded",))
+def span_layout_clean_kernel(arr, *, span_sharded):
+    # the good twin: the descriptor comes from the static `span_sharded`
+    return _layout_fixture(arr, span_sharded)
+
+
 @functools.partial(jax.jit, static_argnames=("top_k",))
 def clean_kernel(scores, mask, extra=None, *, top_k):
     n = scores.shape[0]            # shape reads are static: fine

@@ -63,6 +63,101 @@ def test_differential_regroup_extract():
             assert seg[:8] == want[:8]
 
 
+def test_differential_span_section_vs_python_walk():
+    """ISSUE 15 native span ingest: with the structural gate asking for
+    span rows, tt_ingest_regroup2 emits search-data payloads whose SPAN
+    SECTION is byte-identical to the Python walk (regroup + per-trace
+    collect_span_rows + encode_search_data) over the differential
+    corpus — parent resolution by raw span id, string_value-only
+    service names, walk-order caps, kv-per-span caps, all of it.
+    Skip-not-fail: a stale .so without the symbol skips."""
+    from tempo_tpu.search.data import collect_span_rows
+
+    if native.ingest_regroup([], 0, spans=True) is None:
+        pytest.skip("native .so predates tt_ingest_regroup2")
+    rng = random.Random(15)
+    for it in range(40):
+        batches = _interleaved_batches(rng)
+        budget = rng.choice([64, 256, 1024, 1 << 30])
+        max_spans = rng.choice([1, 3, 512])
+        max_kvs = rng.choice([1, 2, 16])
+        blobs = [b.SerializeToString() for b in batches]
+        n_n, items, _ = native.ingest_regroup(
+            blobs, budget, spans=True, max_spans=max_spans,
+            max_span_kvs=max_kvs)
+        by_trace, n_p, sds = Distributor._regroup_extract(batches, budget)
+        for tid, trace in by_trace.items():
+            sds[tid].spans = collect_span_rows(
+                trace, max_spans=max_spans, max_kvs=max_kvs)
+        assert n_n == n_p and len(items) == len(by_trace)
+        for tid, _s, _e, _seg, sd_b in items:
+            assert sd_b == encode_search_data(sds[tid]), \
+                (it, budget, max_spans, max_kvs, tid.hex())
+
+
+def test_span_section_gate_off_byte_identical_to_legacy():
+    """flags=0 (and the legacy symbol) emit NO span section — the wire
+    form with the structural gate off is byte-identical to pre-span
+    builds."""
+    rng = random.Random(7)
+    batches = _interleaved_batches(rng)
+    blobs = [b.SerializeToString() for b in batches]
+    _, legacy_items, _ = native.ingest_regroup(blobs, 1024)
+    _, flag0_items, _ = native.ingest_regroup(blobs, 1024, spans=False)
+    assert [it[4] for it in legacy_items] == [it[4] for it in flag0_items]
+
+
+def test_distributor_native_span_path_end_to_end(tmp_path):
+    """With search_structural_enabled, the distributor keeps the native
+    fast path (no Python walk) and the ingested blocks answer
+    structural queries — proving the span rows actually flowed."""
+    from tempo_tpu.db import TempoDBConfig
+    from tempo_tpu.modules import App, AppConfig
+    from tempo_tpu.search import ir, structural
+    from tempo_tpu.search.structural import STRUCTURAL
+
+    if native.ingest_regroup([], 0, spans=True) is None:
+        pytest.skip("native .so predates tt_ingest_regroup2")
+    app = App(AppConfig(
+        wal_dir=str(tmp_path / "wal"),
+        db=TempoDBConfig(search_structural_enabled=True,
+                         auto_mesh=False)))
+    try:
+        assert STRUCTURAL.enabled
+        tid = b"\x03" * 16
+        tr = tempopb.Trace()
+        rs = tr.batches.add()
+        kv = rs.resource.attributes.add()
+        kv.key = "service.name"
+        kv.value.string_value = "api"
+        ss = rs.scope_spans.add()
+        root = ss.spans.add()
+        root.trace_id = tid
+        root.span_id = b"\x0a" * 8
+        root.name = "root-op"
+        root.kind = 2
+        root.start_time_unix_nano = 1_600_000_000_000_000_000
+        root.end_time_unix_nano = root.start_time_unix_nano + 500_000_000
+        child = ss.spans.add()
+        child.trace_id = tid
+        child.span_id = b"\x0b" * 8
+        child.parent_span_id = root.span_id
+        child.name = "child-op"
+        child.start_time_unix_nano = root.start_time_unix_nano
+        child.end_time_unix_nano = child.start_time_unix_nano + 400_000_000
+        app.push("t1", [rs])
+        expr = ir.parse(
+            '{"child": {"parent": {"tag": {"k": "service.name",'
+            ' "v": "api"}}, "child": {"dur": {"min_ms": 300}}}}')
+        req = tempopb.SearchRequest()
+        req.limit = 10
+        structural.attach_query(req, expr)
+        res = app.search("t1", req)
+        assert [m.trace_id for m in res.traces] == [tid.hex()]
+    finally:
+        app.shutdown()
+
+
 def test_differential_generator_series():
     """Summary-row feed produces byte-identical exposition output to the
     proto-walk feed (spanmetrics + service graphs) — including for

@@ -184,6 +184,110 @@ def test_dist_engine_attributes_to_active_stats():
 # conservation under fused dispatch
 
 
+def test_conservation_8way_stacked_structural():
+    """A fused plan-shape-STACKED structural dispatch (ISSUE 15)
+    apportions its stage seconds and h2d bytes across the member
+    queries through the same conservation invariant as the legacy
+    coalescer — structural table sizes join the weights — and each
+    member's ?explain structural tree carries per-node device-seconds
+    that conserve to that member's own execute share."""
+    import random
+
+    from tempo_tpu.search import ir, structural as structural_mod
+    from tempo_tpu.search.columnar import ColumnarPages, PageGeometry
+    from tempo_tpu.search.data import SearchData, SpanData
+    from tempo_tpu.search.structural import (STRUCTURAL,
+                                             compile_structural)
+
+    rng = random.Random(7)
+    entries = []
+    for i in range(128):
+        sd = SearchData(trace_id=i.to_bytes(16, "big"), start_s=1,
+                        end_s=5, dur_ms=rng.randint(1, 2000),
+                        kvs={"service.name": {f"svc-{i % 6}"}})
+        for j in range(rng.randint(1, 6)):
+            sd.spans.append(SpanData(
+                parent=(-1 if j == 0 else rng.randrange(j)),
+                dur_ms=rng.randint(1, 900), kind=rng.randint(0, 5),
+                kvs={"service.name": {f"svc-{rng.randint(0, 5)}"}}))
+        entries.append(sd)
+    prev = STRUCTURAL.enabled
+    prev_stack = STRUCTURAL.stack_enabled
+    STRUCTURAL.enabled = True
+    STRUCTURAL.stack_enabled = True
+    try:
+        blocks = [ColumnarPages.build(entries, PageGeometry(64, 8))]
+        eng = MultiBlockEngine(top_k=64)
+        batch = eng.stage(blocks)
+        co = QueryCoalescer(eng, window_s=60.0, max_queries=8,
+                            active_fn=lambda: 8)
+        caught: list[dict] = []
+        listener = caught.append
+        PROFILER.add_listener(listener)
+        try:
+            mqs, stats, futs = [], [], []
+            for i in range(8):
+                expr = ir.parse(
+                    '{"child": {"parent": {"tag": {"k": "service.name",'
+                    ' "v": "svc-%d"}}, "child": {"dur": {"min_ms": %d}}}}'
+                    % (i % 6, 50 * (i + 1)))
+                req = tempopb.SearchRequest()
+                req.limit = 64
+                structural_mod.attach_query(req, expr)
+                mq = compile_multi(blocks, req, cache_on=batch)
+                mq.structural = compile_structural(expr, blocks,
+                                                   cache_on=batch)
+                mqs.append(mq)
+                stats.append(query_stats.QueryStats(f"t{i % 3}"))
+
+            def submit(i):
+                with query_stats.activate(stats[i]):
+                    # the serving path registers the compiled plan at
+                    # prepare time; mirror it for the explain tree
+                    stats[i].add_structural(mqs[i].structural)
+                    futs.append(co.submit(
+                        batch, mqs[i],
+                        resolve_top_k(eng.top_k, mqs[i].limit),
+                        peers=8))
+
+            threads = [threading.Thread(target=submit, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for f in futs:
+                f.result(timeout=60)
+        finally:
+            PROFILER._listeners.remove(listener)
+
+        # ONE fused launch served all 8 structural members
+        assert co.fused == 1 and co.queries == 8
+        assert co.structural_stacked == 8
+        fused = [rd for rd in caught if rd.get("mode") == "coalesced"]
+        assert len(fused) == 1
+        totals = {k: v / 1e3 for k, v in fused[0]["stages_ms"].items()}
+        for stage, total in totals.items():
+            attributed = sum(qs.device_stages.get(stage, 0.0)
+                             for qs in stats)
+            assert attributed == pytest.approx(total, rel=1e-9), stage
+        assert sum(qs.h2d_bytes for qs in stats) == pytest.approx(
+            fused[0].get("h2d_bytes", 0), rel=1e-9)
+        # per-member explain: each member's plan tree apportions its
+        # OWN execute share over its node weights, conserved
+        for qs in stats:
+            d = qs.to_dict()
+            nodes = d["structural"]["nodes"]
+            assert nodes and {n["op"] for n in nodes} >= {"child"}
+            exec_s = (qs.device_stages.get("execute")
+                      or sum(qs.device_stages.values()))
+            assert sum(n["device_ms"] for n in nodes) == pytest.approx(
+                exec_s * 1e3, abs=1e-3)
+    finally:
+        STRUCTURAL.enabled = prev
+        STRUCTURAL.stack_enabled = prev_stack
+
+
 def test_conservation_8way_coalesced():
     """8 concurrent queries fuse into ONE dispatch (max_queries=8, size
     flush); the per-query attributed stage seconds and h2d bytes must

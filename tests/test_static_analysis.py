@@ -224,6 +224,42 @@ def test_jit_purity_flags_tainted_plan_descriptor(bad_pkg):
         [f.message for f in findings]
 
 
+def test_jit_purity_flags_tainted_span_layout_descriptor(bad_pkg):
+    """The span-sharding layout flag is a descriptor like widths/plan:
+    tracer data reaching a layout-dispatching helper is flagged; the
+    static twin stays silent."""
+    findings = JitPurityChecker().check(bad_pkg)
+    taint = [f for f in findings if f.key.startswith("descriptor-taint:")
+             and "span_layout_taint_kernel" in f.key]
+    assert taint and "'span_sharded'" in taint[0].message, \
+        [f.message for f in findings]
+    assert not [f for f in findings
+                if "span_layout_clean_kernel" in f.key], \
+        [f.message for f in findings]
+
+
+def test_contract_new_structural_gates_registered():
+    """The stacking and sharding gates are pinned by BOTH registries:
+    the gate functions test their attribute first (GatedFunction) and
+    every call site is dominated by the gate read (GuardedCall) — the
+    checker run over the real package (test_suite_clean_over_package)
+    enforces them; this test pins that the entries exist so a refactor
+    cannot silently drop the contract."""
+    from tempo_tpu.analysis.contracts import (GATED_FUNCTIONS,
+                                              GUARDED_CALLS)
+
+    gated = {(g.qualname, g.knob) for g in GATED_FUNCTIONS}
+    assert ("StructuralGate.stack_group_key",
+            "search_structural_stack_enabled") in gated
+    assert ("StructuralGate.shard_span_segment",
+            "search_structural_shard_spans") in gated
+    guarded = {(m, g.knob) for g in GUARDED_CALLS for m in g.methods}
+    assert ("stack_group_key",
+            "search_structural_stack_enabled") in guarded
+    assert ("shard_span_segment",
+            "search_structural_shard_spans") in guarded
+
+
 def test_jit_purity_clean_on_real_kernels(real_pkg):
     assert JitPurityChecker().check(real_pkg) == []
 

@@ -47,10 +47,14 @@ def _structural_on():
     """Each test runs with the gate ON (the default-off contract has its
     own tests) and leaves the process gate as it found it."""
     prev = STRUCTURAL.enabled
+    prev_stack = STRUCTURAL.stack_enabled
+    prev_shard = STRUCTURAL.shard_spans
     STRUCTURAL.enabled = True
     packing_prev = packing_mod.PACKING.enabled
     yield
     STRUCTURAL.enabled = prev
+    STRUCTURAL.stack_enabled = prev_stack
+    STRUCTURAL.shard_spans = prev_shard
     packing_mod.PACKING.enabled = packing_prev
     robustness.BREAKER.reset()
 
@@ -133,6 +137,53 @@ def _rand_trace(rng: random.Random, depth: int = 2) -> ir.TraceExpr:
                      for _ in range(rng.randint(1, 3)))
         return ir.TraceAnd(args) if op == "and" else ir.TraceOr(args)
     return ir.TraceNot(_rand_trace(rng, depth - 1))
+
+
+def _reparam_span(e: ir.SpanExpr, rng: random.Random) -> ir.SpanExpr:
+    """Same tree SHAPE (ops, arity, comparison operators), fresh leaf
+    parameters — the 'N dashboards running the same saved query with
+    different filters' load plan-shape stacking exists for."""
+    if isinstance(e, ir.SpanTag):
+        return ir.SpanTag(rng.choice(["service.name", "name", "nope"]),
+                          rng.choice(["a", "p", "op", "db", ""]))
+    if isinstance(e, ir.SpanDur):
+        lo = rng.randint(0, 800)
+        return ir.SpanDur(lo, lo + rng.randint(0, 800))
+    if isinstance(e, ir.SpanKind):
+        return ir.SpanKind(rng.randint(0, 5))
+    if isinstance(e, ir.SpanAnd):
+        return ir.SpanAnd(tuple(_reparam_span(a, rng) for a in e.args))
+    if isinstance(e, ir.SpanOr):
+        return ir.SpanOr(tuple(_reparam_span(a, rng) for a in e.args))
+    if isinstance(e, ir.SpanNot):
+        return ir.SpanNot(_reparam_span(e.arg, rng))
+    if isinstance(e, ir.ChildOf):
+        return ir.ChildOf(_reparam_span(e.parent, rng),
+                          _reparam_span(e.child, rng))
+    return ir.DescOf(_reparam_span(e.anc, rng),
+                     _reparam_span(e.span, rng))
+
+
+def _reparam(e: ir.TraceExpr, rng: random.Random) -> ir.TraceExpr:
+    if isinstance(e, ir.TraceTag):
+        return ir.TraceTag(rng.choice(["service.name", "env", "nope"]),
+                           rng.choice(["a", "prod", "dev", ""]))
+    if isinstance(e, ir.TraceDur):
+        lo = rng.randint(0, 4000)
+        return ir.TraceDur(lo, lo + rng.randint(0, 4000))
+    if isinstance(e, ir.Exists):
+        return ir.Exists(_reparam_span(e.of, rng))
+    if isinstance(e, ir.Count):
+        return ir.Count(_reparam_span(e.of, rng), e.op, rng.randint(0, 4))
+    if isinstance(e, ir.Quantile):
+        qn, qd = rng.choice([(1, 2), (9, 10), (99, 100), (1, 4)])
+        return ir.Quantile(_reparam_span(e.of, rng), qn, qd, e.op,
+                           rng.randint(0, 900))
+    if isinstance(e, ir.TraceAnd):
+        return ir.TraceAnd(tuple(_reparam(a, rng) for a in e.args))
+    if isinstance(e, ir.TraceOr):
+        return ir.TraceOr(tuple(_reparam(a, rng) for a in e.args))
+    return ir.TraceNot(_reparam(e.arg, rng))
 
 
 def _expected_ids(expr, entries) -> set:
@@ -385,6 +436,224 @@ def test_differential_fuzz_compiled_vs_host(packed):
                      seed=round_i)
 
 
+def _check_stacked(entries, template, rng, packed: bool, mesh=None,
+                   n_variants: int = 5):
+    """Plan-shape stacking differential: a random same-shape query
+    group answers bit-for-bit identically fused (stack_queries +
+    coalesced kernel), solo (multi_scan_kernel), and on the host
+    reference evaluator. Returns the group size actually stacked."""
+    from tempo_tpu.search.engine import fetch_coalesced_out
+    from tempo_tpu.search.multiblock import stack_queries
+
+    packing_mod.PACKING.enabled = packed
+    half = len(entries) // 2
+    b1 = ColumnarPages.build(entries[:half], E_GEO)
+    b2 = ColumnarPages.build(entries[half:], E_GEO)
+    spanless = [SearchData(trace_id=(10_000 + i).to_bytes(16, "big"),
+                           start_s=1, end_s=2, dur_ms=100,
+                           kvs={"env": {"prod"}}) for i in range(5)]
+    blocks = [b1, b2, ColumnarPages.build(spanless, E_GEO)]
+    eng = MultiBlockEngine(top_k=512, mesh=mesh)
+    batch = eng.stage(blocks)
+    variants = [template] + [_reparam(template, rng)
+                             for _ in range(n_variants - 1)]
+    mqs = []
+    for expr in variants:
+        req = _mk_req(expr)
+        mq = compile_multi(blocks, req, cache_on=batch)
+        mq.structural = compile_structural(
+            expr, blocks, cache_on=batch,
+            staged_dicts=batch.staged_dicts)
+        mq._expr = expr
+        mqs.append(mq)
+    # leaf dedup can shift a variant's plan (two leaves collapsing to
+    # one term index): stack exactly the same-plan members — the same
+    # grouping stack_group_key enforces in the coalescer
+    base = mqs[0].structural.plan
+    group = [mq for mq in mqs if mq.structural.plan == base]
+    assert len(group) >= 2, "reparam produced no same-plan peer"
+    cq = stack_queries(group)
+    assert cq.structural is not None and cq.structural.plan == base
+    counts, _ins, scores, idx = fetch_coalesced_out(
+        eng.coalesced_scan_async(batch, cq, 512))
+    all_entries = entries + spanless
+    E = E_GEO.entries_per_page
+    for qi, mq in enumerate(group):
+        got = set()
+        for s, i in zip(scores[qi].tolist(), idx[qi].tolist()):
+            if s < 0:
+                break
+            p, e = divmod(i, E)
+            if p >= batch.n_pages:
+                continue
+            bi = int(batch.page_block[p])
+            if bi < 0:
+                continue
+            lp = p - batch.page_offset[bi]
+            got.add(bytes(batch.blocks[bi].trace_ids[lp, e]))
+        want = _expected_ids(mq._expr, all_entries)
+        scount, sgot = _scan_ids(batch, eng, mq, all_entries)
+        assert got == want == sgot, (ir.to_json(mq._expr), packed,
+                                     len(got), len(want), len(sgot))
+        assert int(counts[qi]) == len(want) == scount
+    return len(group)
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_differential_fuzz_stacked_plans(packed):
+    """The stacking property: ANY random same-shape structural query
+    group answers identically coalesced (one fused dispatch), solo, and
+    on the reference evaluator — packed residency on and off."""
+    rng = random.Random(60_000 + packed)
+    for round_i in range(4):
+        entries = _corpus(700 + round_i, n=80)
+        template = _rand_trace(rng)
+        _check_stacked(entries, template, rng, packed=packed)
+
+
+def test_stacked_plans_on_mesh_with_sharded_spans():
+    """Stacking composes with segment-aligned span sharding: the fused
+    dispatch over sharded span columns answers identically to solo
+    dispatches, the replicated layout, and the host evaluator."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple (forced host) devices")
+    from tempo_tpu.parallel import make_mesh
+
+    rng = random.Random(99)
+    entries = _corpus(801, n=160)
+    mesh = make_mesh()
+    for template in [ir.parse(s) for s in _ACCEPTANCE_TRIPLE[:2]] \
+            + [_rand_trace(rng)]:
+        STRUCTURAL.shard_spans = True
+        try:
+            _check_stacked(entries, template, rng, packed=False,
+                           mesh=mesh)
+        finally:
+            STRUCTURAL.shard_spans = False
+
+
+def test_sharded_span_segment_layout_and_identity():
+    """The reshard itself: trace-whole chunks, local coordinates, and
+    byte-identical answers sharded vs replicated vs host (the mesh path
+    runs it end to end when >1 device is available)."""
+    import jax
+
+    entries = _corpus(77, n=400)
+    blocks = [ColumnarPages.build(entries, E_GEO)]
+    eng = MultiBlockEngine(top_k=512)
+    host = eng.stage_host(blocks)
+    span_cat = host.span_cat
+    assert span_cat is not None
+    P_pages = int(host.page_block.shape[0])
+    E = E_GEO.entries_per_page
+    n_sh = 4
+    STRUCTURAL.shard_spans = True
+    try:
+        sh = STRUCTURAL.shard_span_segment(span_cat, n_sh, P_pages, E)
+    finally:
+        STRUCTURAL.shard_spans = False
+    assert sh is not None
+    per_shard = sh["span_trace"].shape[0] // n_sh
+    pp = P_pages // n_sh
+    # every live span sits in the chunk of its trace's page shard, with
+    # a local trace index and a parent inside the same chunk
+    for s in range(n_sh):
+        chunk = slice(s * per_shard, (s + 1) * per_shard)
+        tr = sh["span_trace"][chunk]
+        live = tr >= 0
+        assert (tr[live] < pp * E).all()
+        par = sh["span_parent"][chunk][live]
+        assert ((par >= -1) & (par < per_shard)).all()
+    # per-trace verdict identity vs the replicated layout: per-shard
+    # span bytes shrink to ~1/P of the replicated staging
+    rep_bytes = sum(int(v.nbytes) for k, v in span_cat.items()
+                    if k.startswith("span_"))
+    sh_bytes = sum(int(v.nbytes) for k, v in sh.items()
+                   if k.startswith("span_")) // n_sh
+    assert sh_bytes < rep_bytes
+    # disabled gate: one attribute read, None (replicated layout kept)
+    assert STRUCTURAL.shard_span_segment(span_cat, n_sh, P_pages, E) \
+        is None
+
+
+def test_serving_path_stacks_concurrent_same_plan_queries(tmp_path):
+    """8 concurrent same-plan-shape structural searches through the
+    FULL serving path fuse (dispatches/request well below 1 for the
+    structural leg), byte-identical to the same queries run serially,
+    and the stack metric + /debug ratio say so."""
+    import threading
+
+    from tempo_tpu.observability import metrics as obs
+
+    entries = _corpus(91, n=120)
+    db = _mkdb(tmp_path, entries,
+               search_structural_stack_enabled=True,
+               search_coalesce_window_s=0.05)
+    svcs = ["api", "db", "auth", "cache", "web", "api", "db", "auth"]
+    exprs = [ir.parse(
+        '{"child": {"parent": {"tag": {"k": "service.name", "v": "%s"}},'
+        ' "child": {"dur": {"min_ms": %d}}}}' % (svc, 50 + 50 * i))
+        for i, svc in enumerate(svcs)]
+    def canon(resp):
+        # device_seconds is a wall-clock measurement — legitimately
+        # different run to run; everything else must be byte-identical
+        resp.metrics.device_seconds = 0
+        return resp.SerializeToString()
+
+    serial = []
+    for e in exprs:
+        r = _mk_req(e, limit=1000)
+        serial.append(canon(db.search("t", r).response()))
+    co = db.batcher.coalescer
+    base_stacked = co.structural_stacked
+    stacked0 = obs.structural_stack_events.value(result="stacked")
+    out = [None] * len(exprs)
+    barrier = threading.Barrier(len(exprs))
+
+    def one(i):
+        r = _mk_req(exprs[i], limit=1000)
+        barrier.wait()
+        out[i] = canon(db.search("t", r).response())
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(len(exprs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(len(exprs)):
+        assert out[i] == serial[i], f"query {i} diverged under stacking"
+    assert co.structural_stacked > base_stacked, "no structural fusion"
+    assert obs.structural_stack_events.value(result="stacked") > stacked0
+    stats = co.stats()
+    assert stats["structural_stack_ratio"] > 0
+    # /debug/scan surfaces the same coalesce block
+    dbg = db.batcher.debug_stats()
+    assert dbg["coalesce"]["structural_stacked"] == co.structural_stacked
+
+
+def test_stacking_disabled_keeps_solo_flush_and_counts_it(tmp_path):
+    """The noop contract of the stacking gate: disabled keeps the exact
+    solo-flush behavior and books result=solo_disabled."""
+    from tempo_tpu.observability import metrics as obs
+
+    entries = _corpus(92, n=60)
+    db = _mkdb(tmp_path, entries)  # stack gate OFF
+    assert STRUCTURAL.stack_enabled is False
+    solo0 = obs.structural_stack_events.value(result="solo_disabled")
+    expr = ir.parse(_ACCEPTANCE_TRIPLE[2])
+    req = _mk_req(expr, limit=1000)
+    got = {bytes.fromhex(m.trace_id)
+           for m in db.search("t", req).response().traces}
+    assert got == _expected_ids(expr, entries)
+    assert obs.structural_stack_events.value(result="solo_disabled") \
+        > solo0
+    co = db.batcher.coalescer
+    assert co.structural_stacked == 0
+
+
 def test_mesh_dist_path_matches_host():
     import jax
 
@@ -431,6 +700,46 @@ def test_distributed_scan_engine_path():
             if p < pages.n_pages:
                 got.add(bytes(pages.trace_ids[p, e]))
         assert got == want and count == len(want), src
+
+
+def test_distributed_scan_engine_sharded_spans():
+    """The `dist` path with search_structural_shard_spans: span columns
+    stage chunk-per-shard (span_sharded=True) and the acceptance triple
+    answers byte-identically to the host reference."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple (forced host) devices")
+    from tempo_tpu.parallel import DistributedScanEngine, make_mesh
+    from tempo_tpu.search.pipeline import compile_query
+
+    entries = _corpus(46, n=600)
+    pages = ColumnarPages.build(entries, E_GEO)
+    STRUCTURAL.shard_spans = True
+    try:
+        eng = DistributedScanEngine(make_mesh(), top_k=1024)
+        sp = eng.stage(pages)
+        assert sp.span_device is not None and sp.span_sharded
+        for src in _ACCEPTANCE_TRIPLE:
+            expr = ir.parse(src)
+            req = _mk_req(expr)
+            cq = compile_query(pages.key_dict, pages.val_dict, req,
+                               cache_on=pages)
+            cq.structural = compile_structural(expr, [pages],
+                                               cache_on=pages)
+            count, _ins, scores, idx = eng.scan_staged(sp, cq)
+            want = _expected_ids(expr, entries)
+            E = E_GEO.entries_per_page
+            got = set()
+            for s, i in zip(scores.tolist(), idx.tolist()):
+                if s < 0:
+                    break
+                p, e = divmod(i, E)
+                if p < pages.n_pages:
+                    got.add(bytes(pages.trace_ids[p, e]))
+            assert got == want and count == len(want), src
+    finally:
+        STRUCTURAL.shard_spans = False
 
 
 def test_single_block_engine_path():
