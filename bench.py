@@ -2211,7 +2211,9 @@ def phase_structural():
         assert nodes and all("device_ms" in n for n in nodes)
 
         concurrency = _structural_concurrency_subphase(td, mk_entries)
+        mixed = _structural_mixed_subphase(td, mk_entries)
         sharded_leg = _structural_sharded_span_leg(mk_entries)
+        remainder_leg = _structural_remainder_leg(mk_entries)
 
         return {
             "blocks": n_blocks,
@@ -2225,7 +2227,9 @@ def phase_structural():
             "queries": results,
             "explain_plan_nodes": nodes,
             "structural_concurrency": concurrency,
+            "structural_mixed": mixed,
             "mesh_sharded_spans": sharded_leg,
+            "mesh_remainder_pages": remainder_leg,
         }
 
 
@@ -2310,6 +2314,288 @@ def _structural_concurrency_subphase(td, mk_entries):
         "stack_ratio": co.stats()["structural_stack_ratio"],
         "byte_identical_vs_serial": True,
         "wall_ms": round(wall * 1e3, 3),
+    }
+
+
+def _structural_mixed_subphase(td, mk_entries):
+    """`structural_mixed` sub-phase (ISSUE 16): a barrier-synced 8-way
+    MIXED-plan structural load (>= 3 distinct plan shapes that
+    canonicalize into one bucket) against the serving path with
+    shape-bucketed stacking on. Asserts the bucketed dispatches per
+    request land at or below 0.5 (>= 2x fewer launches than the
+    per-plan flush the exact-plan grouping costs), byte-identity vs the
+    same queries run serially, and cost-apportionment conservation —
+    the members' attributed device seconds sum to the fused dispatch
+    records' totals."""
+    import threading
+
+    from tempo_tpu import tempopb
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.observability.profile import PROFILER
+    from tempo_tpu.search import ir, structural
+    from tempo_tpu.search.columnar import PageGeometry
+    from tempo_tpu.search.data import encode_search_data
+
+    be = LocalBackend(td + "/blocks-mixed")
+    db = TempoDB(be, td + "/wal-mixed", TempoDBConfig(
+        auto_mesh=False, search_structural_enabled=True,
+        search_structural_stack_enabled=True,
+        search_structural_bucket_enabled=True,
+        search_coalesce_window_s=0.05,
+        search_geometry=PageGeometry(256, 8)))
+    corpus = []
+    for s in range(2):
+        entries = sorted(mk_entries(s), key=lambda sd: sd.trace_id)
+        corpus.extend(entries)
+        db.write_block_direct(
+            "bench",
+            [(sd.trace_id, encode_search_data(sd), sd.start_s, sd.end_s)
+             for sd in entries],
+            search_entries=entries)
+    # three DISTINCT plan shapes, one canonical bucket (3 span slots +
+    # exists+root -> NS 4 / NT 2 / relational): the mixed dashboard
+    # traffic exact-plan grouping cannot fuse
+    shapes = [
+        lambda i: (
+            '{"child": {"parent": {"tag": {"k": "service.name",'
+            ' "v": "svc-%02d"}}, "child": {"dur": {"min_ms": %d}}}}'
+            % (i % 12, 100 * (i + 1))),
+        lambda i: (
+            '{"child": {"parent": {"tag": {"k": "service.name",'
+            ' "v": "svc-%02d"}}, "child": {"kind": "server"}}}'
+            % (i % 12)),
+        lambda i: (
+            '{"child": {"parent": {"dur": {"min_ms": %d}},'
+            ' "child": {"tag": {"k": "name", "v": "op1"}}}}'
+            % (100 * (i + 1))),
+    ]
+    N = 8
+    exprs = [ir.parse(shapes[i % 3](i)) for i in range(N)]
+    n_plans = len({str(e) for e in exprs})
+    assert n_plans >= 3
+
+    def search_one(expr):
+        req = tempopb.SearchRequest()
+        req.limit = len(corpus)
+        structural.attach_query(req, expr)
+        resp = db.search("bench", req).response()
+        return sorted(m.trace_id for m in resp.traces), \
+            int(resp.metrics.inspected_traces)
+
+    serial = [search_one(e) for e in exprs]   # also warms stage+compile
+    co = db.batcher.coalescer
+    d0, q0, b0 = co.dispatches, co.queries, co.structural_bucketed
+    out = [None] * N
+    barrier = threading.Barrier(N)
+
+    def one(i):
+        barrier.wait()
+        out[i] = search_one(exprs[i])
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    for i in range(N):
+        assert out[i] == serial[i], \
+            f"query {i} diverged under bucketed stacking"
+    dispatches = co.dispatches - d0
+    served = co.queries - q0
+    assert served == N
+    per_request = dispatches / N
+    # the acceptance floor: >= 2x fewer launches than the per-plan
+    # flush (which costs one dispatch per request here — every window
+    # holds mixed plans)
+    assert per_request <= 0.5, (
+        f"bucketing fused too little: {dispatches} dispatches for {N} "
+        f"mixed-plan requests across {n_plans} shapes")
+    assert co.structural_bucketed - b0 > 0, "no bucketed fusion booked"
+    conserved = _mixed_conservation_leg(mk_entries, exprs)
+    stats = co.stats()
+    return {
+        "requests": N,
+        "plan_shapes": n_plans,
+        "dispatches": dispatches,
+        "dispatches_per_request": round(per_request, 3),
+        "bucketed_queries": co.structural_bucketed - b0,
+        "bucket_occupancy": {
+            bk: row["occupancy"]
+            for bk, row in stats.get("buckets", {}).items()},
+        "byte_identical_vs_serial": True,
+        "cost_conserved": conserved,
+        "wall_ms": round(wall * 1e3, 3),
+    }
+
+
+def _mixed_conservation_leg(mk_entries, exprs):
+    """Cost-apportionment conservation for a bucketed MIXED-plan fused
+    dispatch: exactly one size-flushed group through the coalescer, and
+    per dispatch stage the members' attributed shares sum to the fused
+    record's totals to the float bit (query_stats.apportion weights by
+    each member's ACTIVE node tables — pad slots are never billed)."""
+    import threading
+
+    from tempo_tpu import tempopb
+    from tempo_tpu.observability.profile import PROFILER
+    from tempo_tpu.search import query_stats, structural
+    from tempo_tpu.search.batcher import QueryCoalescer
+    from tempo_tpu.search.columnar import ColumnarPages, PageGeometry
+    from tempo_tpu.search.engine import resolve_top_k
+    from tempo_tpu.search.multiblock import MultiBlockEngine, compile_multi
+    from tempo_tpu.search.structural import compile_structural
+
+    N = len(exprs)
+    blocks = [ColumnarPages.build(
+        sorted(mk_entries(9), key=lambda sd: sd.trace_id),
+        PageGeometry(256, 8))]
+    eng = MultiBlockEngine(top_k=256)
+    batch = eng.stage(blocks)
+    co = QueryCoalescer(eng, window_s=60.0, max_queries=N,
+                        active_fn=lambda: N)
+    mqs = []
+    for e in exprs:
+        req = tempopb.SearchRequest()
+        req.limit = 256
+        structural.attach_query(req, e)
+        mq = compile_multi(blocks, req, cache_on=batch)
+        mq.structural = compile_structural(
+            e, blocks, cache_on=batch, staged_dicts=batch.staged_dicts)
+        mqs.append(mq)
+    stats = [query_stats.QueryStats("bench") for _ in range(N)]
+    futs = [None] * N
+    caught: list[dict] = []
+    listener = caught.append
+
+    def submit(i):
+        with query_stats.activate(stats[i]):
+            futs[i] = co.submit(batch, mqs[i],
+                                resolve_top_k(eng.top_k, mqs[i].limit),
+                                peers=N)
+
+    PROFILER.add_listener(listener)
+    try:
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        PROFILER._listeners.remove(listener)
+    assert co.queries == N and co.dispatches == 1, (
+        f"mixed group did not size-flush as ONE bucketed dispatch "
+        f"({co.dispatches} dispatches)")
+    fused = [rd for rd in caught if rd.get("mode") == "coalesced"]
+    assert len(fused) == 1
+    totals = {k: v / 1e3 for k, v in fused[0]["stages_ms"].items()}
+    for stage, total in totals.items():
+        attributed = sum(qs.device_stages.get(stage, 0.0)
+                         for qs in stats)
+        assert abs(attributed - total) <= 1e-12 * max(1.0, total), (
+            f"stage {stage!r}: apportioned {attributed!r}s does not "
+            f"conserve the dispatch total {total!r}s")
+    return True
+
+
+def _structural_remainder_leg(mk_entries):
+    """Mesh remainder-shard leg of the `structural` phase (ISSUE 16):
+    stage a NON-multiple page count over the mesh with the pow2 vs the
+    minimal-multiple (remainder-shard) layout, report the staged-byte
+    reduction, and assert byte-identical answers through the dist
+    kernels both ways."""
+    import jax
+
+    from tempo_tpu import tempopb
+    from tempo_tpu.search import ir, structural
+    from tempo_tpu.search.columnar import ColumnarPages, PageGeometry
+    from tempo_tpu.search.multiblock import MultiBlockEngine, compile_multi
+    from tempo_tpu.search.structural import STRUCTURAL, compile_structural
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "single device — no mesh to shard over"}
+    from tempo_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    n_sh = int(mesh.devices.size)
+    geo = PageGeometry(256, 8)
+    blocks = [ColumnarPages.build(
+        sorted(mk_entries(s), key=lambda sd: sd.trace_id), geo)
+        for s in range(2)]
+    # append one-page blocks until the page total is ragged enough that
+    # the minimal shard multiple actually beats the pow2 layout (the
+    # measured-saving contract must hold at any corpus-size override)
+    pool: list = []
+    pool_seed = 2
+
+    def minimal_vs_pow2(total):
+        m = max(n_sh, -(-total // n_sh) * n_sh)
+        p = max(n_sh, 1)
+        while p < total:
+            p *= 2
+        return m, p
+
+    while True:
+        total_pages = sum(b.n_pages for b in blocks)
+        m, p = minimal_vs_pow2(total_pages)
+        if m < p:
+            break
+        while len(pool) < geo.entries_per_page:
+            pool.extend(sorted(mk_entries(pool_seed),
+                               key=lambda sd: sd.trace_id))
+            pool_seed += 1
+        blocks.append(ColumnarPages.build(
+            pool[:geo.entries_per_page], geo))
+        del pool[:geo.entries_per_page]
+    expr = ir.parse(
+        '{"child": {"parent": {"tag": {"k": "service.name",'
+        ' "v": "svc-03"}}, "child": {"dur": {"min_ms": 500}}}}')
+
+    def run(remainder: bool):
+        prev = STRUCTURAL.remainder_pages
+        STRUCTURAL.remainder_pages = remainder
+        try:
+            eng = MultiBlockEngine(top_k=4096, mesh=mesh)
+            batch = eng.stage(blocks)
+            req = tempopb.SearchRequest()
+            req.limit = 4096
+            structural.attach_query(req, expr)
+            mq = compile_multi(blocks, req, cache_on=batch)
+            mq.structural = compile_structural(
+                expr, blocks, cache_on=batch,
+                staged_dicts=batch.staged_dicts)
+            count, _ins, scores, idx = eng.scan(batch, mq)
+            got = frozenset(
+                (int(s), int(i))
+                for s, i in zip(scores.tolist(), idx.tolist()) if s >= 0)
+            pages = int(batch.device["kv_key"].shape[0])
+            return count, got, pages, int(batch.device_nbytes)
+        finally:
+            STRUCTURAL.remainder_pages = prev
+
+    p_count, p_got, p_pages, p_bytes = run(False)
+    r_count, r_got, r_pages, r_bytes = run(True)
+    assert (p_count, p_got) == (r_count, r_got), \
+        "remainder-shard layout diverged from the pow2 layout"
+    assert r_pages < p_pages, (
+        f"remainder layout saved nothing: {r_pages} vs {p_pages} staged "
+        f"pages for {total_pages} real pages on {n_sh} shards")
+    return {
+        "shards": n_sh,
+        "real_pages": total_pages,
+        "pow2_staged_pages": p_pages,
+        "remainder_staged_pages": r_pages,
+        "pow2_staged_bytes": p_bytes,
+        "remainder_staged_bytes": r_bytes,
+        "staged_byte_ratio": round(r_bytes / max(1, p_bytes), 3),
+        "byte_identical": True,
+        "matches": int(p_count),
     }
 
 
@@ -2438,7 +2724,7 @@ PHASE_TIMEOUTS = {
     "chaos": 420.0,
     "ownership": 420.0,
     "packing": 420.0,
-    "structural": 420.0,
+    "structural": 600.0,
     "scale_10k": 900.0,
     "scale_large_blocks": 1200.0,
 }

@@ -120,6 +120,17 @@ GATED_FUNCTIONS = (
     GatedFunction("tempo_tpu.search.structural",
                   "StructuralGate.shard_span_segment", ("shard_spans",),
                   "search_structural_shard_spans"),
+    # shape-bucketed cross-plan stacking: the canonicalization gate —
+    # off means one attribute read and stack_group_key keeps the
+    # byte-identical exact-plan grouping
+    GatedFunction("tempo_tpu.search.structural",
+                  "StructuralGate.bucket_group_key", ("bucket_enabled",),
+                  "search_structural_bucket_enabled"),
+    # remainder-shard mesh layout: the staging pad gate — off means one
+    # attribute read and the pow2 page-axis layout exactly as before
+    GatedFunction("tempo_tpu.search.structural",
+                  "StructuralGate.remainder_pad", ("remainder_pages",),
+                  "search_structural_remainder_pages"),
 )
 
 GUARDED_CALLS = (
@@ -150,6 +161,11 @@ GUARDED_CALLS = (
     # its gate — disabled staging keeps the replicated layout untouched
     GuardedCall("STRUCTURAL", ("shard_span_segment",), (), "shard_spans",
                 "STRUCTURAL", "search_structural_shard_spans"),
+    # remainder-shard staging: the minimal-multiple pad computation
+    # only behind its gate — disabled staging keeps the pow2 layout
+    # without even calling the pad helper
+    GuardedCall("STRUCTURAL", ("remainder_pad",), (), "remainder_pages",
+                "STRUCTURAL", "search_structural_remainder_pages"),
 )
 
 

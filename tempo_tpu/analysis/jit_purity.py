@@ -60,7 +60,14 @@ _NP_HOST_FNS = {"asarray", "array", "frombuffer", "copy"}
 # the retrace/concretization failure the widths rule exists for. The
 # stacked plan descriptor (plan-shape stacking) rides the existing
 # `plan` entry: the coalesced kernels thread the same static plan.
-_DESCRIPTOR_PARAMS = {"w", "dw", "widths", "plan", "span_sharded"}
+# `bucket` is the shape-bucket descriptor (shape-bucketed cross-plan
+# stacking): the bucketed evaluator unpacks slot tiers and the has-
+# relations arm from it at trace time. `shard_tail` is the ragged-tail
+# layout descriptor (remainder-shard staging): the dist kernels select
+# the tail-masking arm on it — both decide branch structure exactly
+# like `span_sharded` and must stay in the static jit key.
+_DESCRIPTOR_PARAMS = {"w", "dw", "widths", "plan", "span_sharded",
+                      "bucket", "shard_tail"}
 
 
 def _branches_on_param(helper: ast.AST, param: str) -> bool:

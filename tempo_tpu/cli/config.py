@@ -134,6 +134,15 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "search_structural_stack_enabled", False),
         search_structural_shard_spans=storage.get(
             "search_structural_shard_spans", False),
+        # shape-bucketed cross-plan stacking + remainder-shard staging
+        # (docs/search-structural-queries.md#shape-bucketed-stacking):
+        # both false (default) are true noops and byte-identical on
+        search_structural_bucket_enabled=storage.get(
+            "search_structural_bucket_enabled", False),
+        search_structural_bucket_max_nodes=storage.get(
+            "search_structural_bucket_max_nodes", 16),
+        search_structural_remainder_pages=storage.get(
+            "search_structural_remainder_pages", False),
         # persistent XLA compile cache for the search kernels
         # (docs/search-packed-residency.md#persistent-compile-cache);
         # empty = off, hits surface as jit_cache_events{result=persisted}

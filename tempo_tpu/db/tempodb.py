@@ -158,6 +158,25 @@ class TempoDBConfig:
     # true noop: span columns replicate exactly as before (one
     # attribute read at the placement sites).
     search_structural_shard_spans: bool = False
+    # shape-bucketed cross-plan stacking: concurrent structural queries
+    # whose DIFFERENT plans canonicalize into the same bucket shape
+    # (node count rounded to a pow2 tier, relation/aggregate slots
+    # masked per member) stack into ONE coalesced dispatch — mixed
+    # dashboard traffic fuses instead of flushing one short dispatch
+    # per plan. Inactive slots evaluate as identity, so results stay
+    # byte-identical to solo execution. False (default) is a true noop:
+    # stack_group_key keeps exact-plan grouping (one attribute read).
+    search_structural_bucket_enabled: bool = False
+    # largest flattened slot count (span + trace nodes) a plan may
+    # occupy and still bucket; bigger plans keep exact-plan grouping
+    search_structural_bucket_max_nodes: int = 16
+    # remainder-shard mesh layout: stage to the smallest multiple of
+    # n_shards instead of the next pow2, with the ragged tail recorded
+    # as a static per-shard valid length in the jit key — a 9-page
+    # block on 8 shards stages 16 pages today, 2x the bytes it needs.
+    # False (default) is a true noop: pow2 staging exactly as before
+    # (one attribute read at the staging site).
+    search_structural_remainder_pages: bool = False
     # packed HBM residency (search/packing.py,
     # docs/search-packed-residency.md): staged value-id columns narrow
     # to the width the per-block dictionary cardinality allows (4-bit/
@@ -366,7 +385,10 @@ class TempoDB:
             max_spans=self.cfg.search_structural_max_spans,
             max_span_kvs=self.cfg.search_structural_max_span_kvs,
             stack_enabled=self.cfg.search_structural_stack_enabled,
-            shard_spans=self.cfg.search_structural_shard_spans)
+            shard_spans=self.cfg.search_structural_shard_spans,
+            bucket_enabled=self.cfg.search_structural_bucket_enabled,
+            bucket_max_nodes=self.cfg.search_structural_bucket_max_nodes,
+            remainder_pages=self.cfg.search_structural_remainder_pages)
         # owner-routed HBM placement: process-wide like the layers above
         # (docs/search-hbm-ownership.md)
         from tempo_tpu.search import ownership as _ownership

@@ -371,8 +371,11 @@ coalesce_pending = Gauge("tempo_search_coalesce_pending_queries",
 structural_stack_events = Counter(
     "tempo_search_structural_stack_events_total",
     "structural-query stacking outcomes at coalescer flush: "
-    "result=stacked (member of a fused same-plan dispatch), solo_shape "
-    "(no peer shared the plan shape within the window), solo_disabled "
+    "result=stacked (member of a fused same-plan dispatch), "
+    "stacked_bucketed (member of a fused MIXED-plan dispatch whose "
+    "plans canonicalized into one bucket shape — "
+    "search_structural_bucket_enabled), solo_shape (no peer shared "
+    "the plan shape within the window), solo_disabled "
     "(search_structural_stack_enabled off) — unstackable plan shapes "
     "are visible here instead of silently flushing solo")
 

@@ -148,15 +148,17 @@ class DistributedScanEngine:
 
     @functools.partial(jax.jit, static_argnames=("self", "n_terms",
                                                  "top_k", "widths",
-                                                 "plan", "span_sharded"))
+                                                 "plan", "span_sharded",
+                                                 "shard_tail"))
     def _dist_kernel(self, kv_key, kv_val, entry_start, entry_end,
                      entry_dur, entry_valid, term_keys, val_ranges,
                      dur_lo, dur_hi, win_start, win_end, val_hits=None,
                      entry_dur_res=None, span_cols=None, s_tables=None,
                      *, n_terms: int, top_k: int, widths=None,
-                     plan=None, span_sharded=False):
+                     plan=None, span_sharded=False, shard_tail: int = 0):
         E = entry_valid.shape[1]
         local_flat = kv_key.shape[0] // self.n_shards * E
+        pages_total = int(kv_key.shape[0])
 
         struct_mask = None
         sh_span_cols = sh_s_tables = None
@@ -181,6 +183,17 @@ class DistributedScanEngine:
                      dur_lo, dur_hi, win_start, win_end, val_hits,
                      entry_dur_res, struct_mask, sh_span_cols,
                      sh_s_tables):
+            if shard_tail:
+                # remainder-shard ragged tail (static layout
+                # descriptor, search_structural_remainder_pages): the
+                # trailing pad pages live on the last shard(s); their
+                # entries are already invalid, so this mask is
+                # byte-identical — it records the layout in the jit key
+                pp = entry_valid.shape[0]
+                gpage = (jax.lax.axis_index(SCAN_AXIS).astype(jnp.int32)
+                         * pp + jnp.arange(pp, dtype=jnp.int32))
+                entry_valid = entry_valid & (
+                    gpage < jnp.int32(pages_total - shard_tail))[:, None]
             mask = entry_match_mask(
                 kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_valid, term_keys, val_ranges, dur_lo, dur_hi,
@@ -265,12 +278,21 @@ class DistributedScanEngine:
                          if st is not None else None)
             span_sharded = bool(st is not None
                                 and getattr(sp, "span_sharded", False))
+            from tempo_tpu.search.structural import STRUCTURAL
+
+            # this engine's staging always pads minimally, but the
+            # ragged-tail descriptor only enters the jit key under the
+            # remainder-shard gate (off = the historical key exactly)
+            shard_tail = 0
+            if STRUCTURAL.remainder_pages:
+                shard_tail = int(d["kv_key"].shape[0]) - int(sp.n_pages)
             miss = rec.compile_check(
                 ("dist", d["kv_key"].shape, str(d["kv_key"].dtype),
                  str(d["kv_val"].dtype), vr.shape,
                  None if vh is None else (tuple(vh.shape), str(vh.dtype)),
                  widths, cq.n_terms, k,
-                 None if st is None else st.shape_sig(), span_sharded))
+                 None if st is None else st.shape_sig(), span_sharded,
+                 shard_tail))
             from tempo_tpu.parallel.mesh import locked_collective
 
             # process-wide collective-ordering lock (parallel.mesh):
@@ -288,6 +310,7 @@ class DistributedScanEngine:
                         d.get("entry_dur_res"), span_cols, s_tables,
                         n_terms=cq.n_terms, top_k=k, widths=widths,
                         plan=plan, span_sharded=span_sharded,
+                        shard_tail=shard_tail,
                     )
             # fence after releasing the collective lock: a fenced wait
             # under dispatch_lock would stall every other mesh dispatch

@@ -325,6 +325,11 @@ class QueryCoalescer:
         self.queries = 0      # queries served
         self.structural_queries = 0  # structural queries served here
         self.structural_stacked = 0  # ...that shared a fused dispatch
+        self.structural_bucketed = 0  # ...whose fused group mixed plans
+        # per-bucket occupancy (/debug/scan): str(bucket descriptor) ->
+        # {queries, dispatches, active_nodes, slot_nodes} — over-padded
+        # buckets show up as a low active/slot ratio
+        self._bucket_stats: dict[str, dict] = {}
 
     def submit(self, batch, mq, top_k: int, peers: int | None = None):
         """Queue one compiled query against `batch`; returns a Future
@@ -464,7 +469,10 @@ class QueryCoalescer:
             # stacked structural members weigh their plan's parameter
             # tables alongside the legacy term tables — a member whose
             # probe masks dominated the fused kernel's reads gets the
-            # proportional share (conservation via apportion as before)
+            # proportional share (conservation via apportion as before).
+            # st is each member's OWN CompiledStructural, so under
+            # shape-bucketed stacking the weight counts the member's
+            # ACTIVE node tables, never the bucket's pad slots
             w = max(1, int(mq.term_keys.size))
             st = getattr(mq, "structural", None)
             if st is not None:
@@ -492,6 +500,12 @@ class QueryCoalescer:
             structural = bool(
                 items and getattr(items[0][0], "structural", None)
                 is not None)
+            # a fused structural group whose member plans DIFFER fused
+            # through the bucket canonicalization (bucket_group_key) —
+            # booked separately so mixed-traffic fusion is observable
+            bucketed = structural and len(items) > 1 and any(
+                getattr(it[0], "structural").plan
+                != items[0][0].structural.plan for it in items[1:])
             with self._lock:  # _run races: window thread vs size flush
                 self.dispatches += 1
                 self.queries += len(items)
@@ -501,12 +515,18 @@ class QueryCoalescer:
                     self.structural_queries += len(items)
                     if len(items) > 1:
                         self.structural_stacked += len(items)
+                    if bucketed:
+                        self.structural_bucketed += len(items)
             if structural and grp.gen >= 0:
                 # gen=-1 groups booked solo_disabled at submit; here a
-                # fused flush books every member as stacked and a lone
-                # member as solo_shape — unstackable (peerless) plan
-                # shapes are visible, never a silent solo flush
-                if len(items) > 1:
+                # fused flush books every member as stacked (bucketed
+                # when plans differ) and a lone member as solo_shape —
+                # unstackable (peerless) plan shapes are visible, never
+                # a silent solo flush
+                if bucketed:
+                    obs.structural_stack_events.inc(
+                        len(items), result="stacked_bucketed")
+                elif len(items) > 1:
                     obs.structural_stack_events.inc(len(items),
                                                     result="stacked")
                 else:
@@ -523,6 +543,20 @@ class QueryCoalescer:
                 return
             mqs = [mq for mq, _k, _f, _t, _qs in items]
             cq = stack_queries(mqs)
+            st = getattr(cq, "structural", None)
+            if st is not None and getattr(st, "slot_nodes", 0):
+                # bucket occupancy: active (real) vs slot (padded)
+                # nodes per bucket descriptor — /debug/scan surfaces
+                # over-padded buckets
+                bkey = str(st.plan)
+                with self._lock:
+                    row = self._bucket_stats.setdefault(
+                        bkey, {"queries": 0, "dispatches": 0,
+                               "active_nodes": 0, "slot_nodes": 0})
+                    row["queries"] += st.n_queries
+                    row["dispatches"] += 1
+                    row["active_nodes"] += st.active_nodes
+                    row["slot_nodes"] += st.slot_nodes
             k = max(k for _mq, k, _f, _t, _qs in items)
             t0d = _time.perf_counter()
             with profile.collect_records() as recs:
@@ -546,6 +580,8 @@ class QueryCoalescer:
     def stats(self) -> dict:
         with self._lock:
             pending = sum(len(g.items) for g in self._pending.values())
+            bucket_rows = {bk: dict(row)
+                           for bk, row in self._bucket_stats.items()}
         return {
             "dispatches": self.dispatches,
             "fused_dispatches": self.fused,
@@ -561,6 +597,22 @@ class QueryCoalescer:
             "structural_stack_ratio": round(
                 self.structural_stacked
                 / max(1, self.structural_queries), 3),
+            # shape-bucketed fusion visibility: mixed-plan queries that
+            # shared a dispatch, plus per-bucket stack ratios and node
+            # occupancy (active = real slots, the rest is bucket pad)
+            "structural_bucketed": self.structural_bucketed,
+            "buckets": {
+                bk: {
+                    "queries": row["queries"],
+                    "dispatches": row["dispatches"],
+                    "stack_ratio": round(
+                        row["queries"] / max(1, row["dispatches"]), 3),
+                    "occupancy": round(
+                        row["active_nodes"]
+                        / max(1, row["slot_nodes"]), 3),
+                }
+                for bk, row in bucket_rows.items()
+            },
         }
 
 

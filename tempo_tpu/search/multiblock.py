@@ -673,15 +673,30 @@ def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
     sts = [getattr(mq, "structural", None) for mq in mqs]
     stacked_st = None
     if any(st is not None for st in sts):
-        from .structural import stack_structural
+        from .structural import (STRUCTURAL, canonical_bucket,
+                                 stack_bucketed, stack_structural)
 
-        if (any(st is None for st in sts)
-                or any(st.plan != sts[0].plan for st in sts[1:])):
+        if any(st is None for st in sts):
             # plan-shape grouping happens UPSTREAM (stack_group_key);
             # a mixed stack here would silently drop a predicate
             raise ValueError(
                 "coalesced structural queries must all share one plan")
-        stacked_st = stack_structural(sts, _pow2(Qn))
+        if all(st.plan == sts[0].plan for st in sts[1:]):
+            # same exact plan: the exact-descriptor stack (bucketing
+            # adds nothing when the plans already share one jit key)
+            stacked_st = stack_structural(sts, _pow2(Qn))
+        else:
+            # mixed plans fuse ONLY through the bucket canonicalization
+            # (the bucket_group_key grouping contract): every member
+            # must land in the same bucket descriptor
+            buckets = {canonical_bucket(st.plan,
+                                        STRUCTURAL.bucket_max_nodes)
+                       for st in sts}
+            if len(buckets) != 1 or None in buckets:
+                raise ValueError(
+                    "coalesced structural queries must share one plan "
+                    "or canonicalize into one bucket shape")
+            stacked_st = stack_bucketed(sts, _pow2(Qn), buckets.pop())
     B = mqs[0].term_keys.shape[0]
     Q = _pow2(Qn)
     T = _pow2(max(1, max(mq.n_terms for mq in mqs)))
@@ -843,7 +858,7 @@ def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
 
 @functools.partial(jax.jit,
                    static_argnames=("mesh", "n_terms", "top_k", "widths",
-                                    "plan", "span_sharded"))
+                                    "plan", "span_sharded", "shard_tail"))
 def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                            entry_dur, entry_valid, page_block, term_keys,
                            val_ranges, dur_lo, dur_hi, win_start, win_end,
@@ -851,7 +866,8 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                            entry_dur_res=None,
                            span_cols=None, s_tables=None,
                            *, n_terms: int, top_k: int, widths=None,
-                           plan=None, span_sharded=False):
+                           plan=None, span_sharded=False,
+                           shard_tail: int = 0):
     """Multi-block scan sharded over the mesh's scan axis: the stacked
     page axis (blocks × pages — the corpus 'sequence' axis, SURVEY.md §5)
     splits across devices; the [B,...] term tables replicate; counts
@@ -892,11 +908,24 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
     elif plan is not None:
         sh_span_cols, sh_s_tables = span_cols, s_tables
 
+    pages_total = int(kv_key.shape[0])
+
     def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                  entry_valid, page_block, term_keys, val_ranges,
                  dur_lo, dur_hi, win_start, win_end, val_hits,
                  block_group, entry_dur_res, struct_mask,
                  sh_span_cols, sh_s_tables):
+        if shard_tail:
+            # remainder-shard layout descriptor (static, part of the
+            # jit key like `widths`): the trailing `shard_tail` pad
+            # pages live on the last shard(s); their entries are
+            # already invalid, so this mask is byte-identical — it
+            # RECORDS the ragged tail in the compiled layout
+            pp = page_block.shape[0]
+            gpage = (jax.lax.axis_index(SCAN_AXIS).astype(jnp.int32)
+                     * pp + jnp.arange(pp, dtype=jnp.int32))
+            entry_valid = entry_valid & (
+                gpage < jnp.int32(pages_total - shard_tail))[:, None]
         mask = multi_entry_mask(
             kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
             page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
@@ -1008,7 +1037,7 @@ def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
 
 @functools.partial(jax.jit,
                    static_argnames=("mesh", "n_terms", "top_k", "widths",
-                                    "plan", "span_sharded"))
+                                    "plan", "span_sharded", "shard_tail"))
 def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                                entry_dur, entry_valid, page_block, term_keys,
                                val_ranges, term_active, dur_lo, dur_hi,
@@ -1016,7 +1045,8 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                                block_group=None, entry_dur_res=None,
                                span_cols=None, s_tables=None,
                                *, n_terms: int, top_k: int, widths=None,
-                               plan=None, span_sharded=False):
+                               plan=None, span_sharded=False,
+                               shard_tail: int = 0):
     """Coalesced scan sharded over the mesh's scan axis: the page axis
     splits across devices, the [Q,...] query tables replicate, and the
     per-shard per-query top-k candidates all_gather into a per-query
@@ -1048,11 +1078,20 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
     elif plan is not None:
         sh_span_cols, sh_s_tables = span_cols, s_tables
 
+    pages_total = int(kv_key.shape[0])
+
     def shard_fn(kv_key, kv_val, entry_start, entry_end, entry_dur,
                  entry_valid, page_block, term_keys, val_ranges,
                  term_active, dur_lo, dur_hi, win_start, win_end,
                  val_hits, block_group, entry_dur_res, struct_masks,
                  sh_span_cols, sh_s_tables):
+        if shard_tail:
+            # remainder-shard ragged tail (see dist_multi_scan_kernel)
+            pp = page_block.shape[0]
+            gpage = (jax.lax.axis_index(SCAN_AXIS).astype(jnp.int32)
+                     * pp + jnp.arange(pp, dtype=jnp.int32))
+            entry_valid = entry_valid & (
+                gpage < jnp.int32(pages_total - shard_tail))[:, None]
         local_inspected = jnp.sum(
             entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
 
@@ -1143,14 +1182,40 @@ class MultiBlockEngine:
         The padded page count buckets to a power of two (shard-aligned):
         group sizes vary freely with the blocklist, and each distinct
         page count is a separate XLA compile (~20-40s on TPU) — pow2
-        bucketing caps the shape count at log2 for <2x masked waste."""
+        bucketing caps the shape count at log2 for <2x masked waste.
+
+        Under the remainder-shard layout
+        (search_structural_remainder_pages) the page axis pads only to
+        the minimal multiple of the shard count instead: the last shard
+        owns the ragged tail (the trailing pad pages), described to the
+        dist kernels by the static `shard_tail` jit key — a 9-page
+        batch on 8 shards stages 9 pages, not 16."""
+        from .structural import STRUCTURAL
+
         total = sum(b.n_pages for b in blocks)
-        pad_to = max(1, self.n_shards)
-        while pad_to < total:
-            pad_to *= 2
+        pad_to = None
+        if STRUCTURAL.remainder_pages:
+            pad_to = STRUCTURAL.remainder_pad(total, self.n_shards)
+        if pad_to is None:
+            pad_to = max(1, self.n_shards)
+            while pad_to < total:
+                pad_to *= 2
         return stack_host(blocks, pad_to=pad_to,
                           probe_min_vals=self.device_probe_min_vals,
                           n_shards=self.n_shards)
+
+    def _shard_tail(self, batch: BlockBatch, d: dict) -> int:
+        """Static ragged-tail descriptor for the dist kernels: the
+        count of trailing pad pages, nonzero ONLY under the
+        remainder-shard gate. The pow2 layout keeps shard_tail=0 even
+        though it pads too — keying the jit cache on every distinct
+        tail would reintroduce exactly the per-page-count compiles the
+        pow2 bucketing exists to cap."""
+        from .structural import STRUCTURAL
+
+        if self.mesh is None or not STRUCTURAL.remainder_pages:
+            return 0
+        return int(d["kv_key"].shape[0]) - int(batch.n_pages)
 
     def place(self, host: HostBatch) -> BlockBatch:
         """H2D of a host-stacked batch (sharded over the mesh if any)."""
@@ -1207,12 +1272,14 @@ class MultiBlockEngine:
                     d["page_block"], tk, vr, dlo, dhi, ws, we, vh, bg,
                     d.get("entry_dur_res"), span_cols, s_tables)
             span_sharded = bool(st is not None and batch.span_sharded)
+            shard_tail = self._shard_tail(batch, d)
             miss = rec.compile_check(
                 ("multi", self.mesh is not None, d["kv_key"].shape,
                  str(d["kv_key"].dtype), str(d["kv_val"].dtype), vr.shape,
                  None if vh is None else (tuple(vh.shape), str(vh.dtype)),
                  widths, mq.n_terms, k,
                  None if st is None else st.shape_sig(), span_sharded,
+                 shard_tail,
                  None if span_cols is None else
                  tuple(sorted((n, tuple(a.shape))
                               for n, a in span_cols.items()))))
@@ -1229,7 +1296,8 @@ class MultiBlockEngine:
                         out = dist_multi_scan_kernel(
                             self.mesh, *args, n_terms=mq.n_terms, top_k=k,
                             widths=widths, plan=plan,
-                            span_sharded=span_sharded)
+                            span_sharded=span_sharded,
+                            shard_tail=shard_tail)
                 # fence AFTER releasing the collective lock: a fenced
                 # wait under dispatch_lock would serialize every other
                 # mesh dispatch behind this kernel's completion (the
@@ -1294,6 +1362,7 @@ class MultiBlockEngine:
                           + st_bytes)
             widths = batch.widths
             span_sharded = bool(st is not None and batch.span_sharded)
+            shard_tail = self._shard_tail(batch, d)
             args = (d["kv_key"], d["kv_val"], d["entry_start"],
                     d["entry_end"], d["entry_dur"], d["entry_valid"],
                     d["page_block"], *tables, vh, bg,
@@ -1305,6 +1374,7 @@ class MultiBlockEngine:
                  None if vh is None else (tuple(vh.shape), str(vh.dtype)),
                  widths, cq.n_terms, top_k,
                  None if st is None else st.shape_sig(), span_sharded,
+                 shard_tail,
                  None if span_cols is None else
                  tuple(sorted((n, tuple(a.shape))
                               for n, a in span_cols.items()))))
@@ -1319,7 +1389,8 @@ class MultiBlockEngine:
                         out = dist_coalesced_scan_kernel(
                             self.mesh, *args, n_terms=cq.n_terms,
                             top_k=top_k, widths=widths, plan=plan,
-                            span_sharded=span_sharded)
+                            span_sharded=span_sharded,
+                            shard_tail=shard_tail)
                 # fence outside the collective lock (see
                 # _scan_async_impl — same lock-order stance)
                 with rec.stage(stage):

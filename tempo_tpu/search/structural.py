@@ -81,6 +81,25 @@ class StructuralGate:
         # shard-local and span HBM per shard drops ~1/P. Off (default)
         # keeps the replicated layout exactly
         self.shard_spans = False
+        # shape-bucketed cross-plan stacking
+        # (search_structural_bucket_enabled): concurrent structural
+        # queries whose plans canonicalize into the SAME bucket shape
+        # (canonical_bucket) fuse into one dispatch even when their
+        # exact plan descriptors differ — the slot-program tables carry
+        # each member's active nodes, padded slots evaluate as masked
+        # no-ops. Off (default) keeps exact-plan grouping only
+        self.bucket_enabled = False
+        # bucket tier cap: a plan whose flattened slot count (span +
+        # trace slots, incl. the root-copy slot) exceeds this goes back
+        # to exact-plan grouping ("still goes solo" in the docs)
+        self.bucket_max_nodes = 16
+        # remainder-shard mesh layout
+        # (search_structural_remainder_pages): mesh staging pads the
+        # page axis to the MINIMAL multiple of the shard count instead
+        # of the pow2 bucket — the last shard owns the ragged tail
+        # behind the static shard_tail jit descriptor. Off (default)
+        # keeps the pow2 bucketing exactly
+        self.remainder_pages = False
         self._parse_cache: OrderedDict = OrderedDict()
         self._parse_lock = threading.Lock()
 
@@ -154,7 +173,42 @@ class StructuralGate:
         exploits."""
         if not self.stack_enabled:
             return None
+        if self.bucket_enabled:
+            bk = self.bucket_group_key(batch, st)
+            if bk is not None:
+                return bk
         return (id(batch), st.plan)
+
+    def bucket_group_key(self, batch, st) -> tuple | None:
+        """THE shape-bucket gate: the coalescer's pending-group key for
+        a structural query under cross-plan bucketing, or None — one
+        attribute read when search_structural_bucket_enabled is off
+        (the caller falls back to exact-plan grouping), and None when
+        the plan exceeds the bucket tier cap (it still goes solo /
+        exact-plan, never a silently truncated program). Two queries
+        share a bucket key iff they target the same staged batch AND
+        their plans canonicalize to the identical bucket descriptor
+        (canonical_bucket): the DESCRIPTOR is the jit key, member plans
+        ride as dynamic per-query slot programs."""
+        if not self.bucket_enabled:
+            return None
+        bk = canonical_bucket(st.plan, self.bucket_max_nodes)
+        if bk is None:
+            return None
+        return (id(batch), bk)
+
+    def remainder_pad(self, total: int, n_shards: int) -> int | None:
+        """THE remainder-shard gate: the MINIMAL multiple-of-n_shards
+        padded page count for a mesh staging, or None — one attribute
+        read when search_structural_remainder_pages is off (the caller
+        keeps the pow2 page bucketing exactly). The last shard owns the
+        short chunk: the trailing pad pages all land there, described
+        by the static per-shard valid length (`shard_tail`) the dist
+        kernels carry in their jit key."""
+        if not self.remainder_pages:
+            return None
+        n = max(1, int(n_shards))
+        return max(n, -(-int(total) // n) * n)
 
     def shard_span_segment(self, span_cat: dict, n_shards: int,
                            pad_pages: int, E: int) -> dict | None:
@@ -241,7 +295,10 @@ STRUCTURAL = StructuralGate()
 def configure(enabled: bool | None = None, max_spans: int | None = None,
               max_span_kvs: int | None = None,
               stack_enabled: bool | None = None,
-              shard_spans: bool | None = None) -> StructuralGate:
+              shard_spans: bool | None = None,
+              bucket_enabled: bool | None = None,
+              bucket_max_nodes: int | None = None,
+              remainder_pages: bool | None = None) -> StructuralGate:
     """Apply TempoDBConfig.search_structural_* to the process gate (most
     recent TempoDB wins — the PACKING/OWNERSHIP idiom)."""
     if enabled is not None:
@@ -254,6 +311,12 @@ def configure(enabled: bool | None = None, max_spans: int | None = None,
         STRUCTURAL.stack_enabled = bool(stack_enabled)
     if shard_spans is not None:
         STRUCTURAL.shard_spans = bool(shard_spans)
+    if bucket_enabled is not None:
+        STRUCTURAL.bucket_enabled = bool(bucket_enabled)
+    if bucket_max_nodes is not None:
+        STRUCTURAL.bucket_max_nodes = max(2, int(bucket_max_nodes))
+    if remainder_pages is not None:
+        STRUCTURAL.remainder_pages = bool(remainder_pages)
     return STRUCTURAL
 
 
@@ -491,6 +554,257 @@ def stack_structural(sts: list, pad_q: int) -> StackedStructural:
                 block_group, stack_plain("dur_params"),
                 stack_plain("kind_params"), stack_plain("agg_params")),
         n_queries=Qn)
+
+
+# ---------------------------------------------------------------------------
+# shape-bucketed cross-plan stacking: canonicalize heterogeneous plans
+# into a small static family of bucket shapes so the coalescer fuses
+# mixed-plan concurrent queries into ONE dispatch. The bucket
+# descriptor ("bucket", NS, NT, has_rel) replaces the exact plan in the
+# jit key; each member's exact plan lowers to a per-query int32 slot
+# PROGRAM carried in the dynamic tables (the "active-node mask" — pad
+# slots are opcode 0 and unreachable from the result slot, so fused
+# results stay byte-identical to solo execution).
+
+# span-program opcodes (row = [opcode, a, b, 0]; a/b are table indices
+# for leaves, 1-based register indices for combinators — register 0 is
+# the dummy all-false register)
+_SOP = {"tag": 1, "dur": 2, "kind": 3, "and": 4, "or": 5, "not": 6,
+        "child": 7, "desc": 8}
+# trace-program opcodes (row = [opcode, a, b, c]; for aggregates a is
+# the 1-based SPAN register, b the agg_params row, c the compare code)
+_TOP = {"ttag": 1, "tdur": 2, "exists": 3, "count": 4, "q": 5,
+        "and": 6, "or": 7, "not": 8}
+_CMPC = {">": 0, ">=": 1, "<": 2, "<=": 3, "==": 4, "!=": 5}
+
+
+def _flatten_span(plan: tuple, rows: list) -> int:
+    """Postorder-flatten a span plan into program rows; returns the
+    node's 1-based result register. N-ary and/or binarize into chains
+    (bit-identical for booleans)."""
+    op = plan[0]
+    if op in ("tag", "dur", "kind"):
+        rows.append([_SOP[op], plan[2], 0, 0])
+        return len(rows)
+    if op in ("and", "or"):
+        r = _flatten_span(plan[2][0], rows)
+        for sub in plan[2][1:]:
+            r2 = _flatten_span(sub, rows)
+            rows.append([_SOP[op], r, r2, 0])
+            r = len(rows)
+        return r
+    if op == "not":
+        r = _flatten_span(plan[2], rows)
+        rows.append([_SOP["not"], r, 0, 0])
+        return len(rows)
+    if op in ("child", "desc"):
+        ra = _flatten_span(plan[2], rows)
+        rb = _flatten_span(plan[3], rows)
+        rows.append([_SOP[op], ra, rb, 0])
+        return len(rows)
+    raise StructuralCompileError(f"bad span plan op {op!r}")
+
+
+def _flatten_trace(plan: tuple, trows: list, srows: list) -> int:
+    op = plan[0]
+    if op in ("ttag", "tdur"):
+        trows.append([_TOP[op], plan[2], 0, 0])
+        return len(trows)
+    if op == "exists":
+        sr = _flatten_span(plan[2], srows)
+        trows.append([_TOP["exists"], sr, 0, 0])
+        return len(trows)
+    if op in ("count", "q"):
+        sr = _flatten_span(plan[4], srows)
+        trows.append([_TOP[op], sr, plan[3], _CMPC[plan[2]]])
+        return len(trows)
+    if op in ("and", "or"):
+        r = _flatten_trace(plan[2][0], trows, srows)
+        for sub in plan[2][1:]:
+            r2 = _flatten_trace(sub, trows, srows)
+            trows.append([_TOP[op], r, r2, 0])
+            r = len(trows)
+        return r
+    if op == "not":
+        r = _flatten_trace(plan[2], trows, srows)
+        trows.append([_TOP["not"], r, 0, 0])
+        return len(trows)
+    raise StructuralCompileError(f"bad trace plan op {op!r}")
+
+
+def _flatten_plan(plan: tuple) -> tuple[list, list]:
+    """Flatten an exact plan into (span_rows, trace_rows). The final
+    trace row is always a root copy — OR(root, root), the boolean
+    identity — so the result register is STATICALLY the last trace
+    slot whatever the member's real shape (stack_bucketed keeps it at
+    slot NT-1 with pad rows in between)."""
+    srows: list = []
+    trows: list = []
+    root = _flatten_trace(plan, trows, srows)
+    trows.append([_TOP["or"], root, root, 0])
+    return srows, trows
+
+
+def canonical_bucket(plan: tuple, max_nodes: int) -> tuple | None:
+    """Canonicalize an exact plan into its bucket-shape descriptor
+    ``("bucket", NS, NT, has_rel)``: NS/NT are the pow2 slot tiers of
+    the flattened span/trace programs (NT includes the root-copy
+    slot), has_rel marks the child/desc machinery (relation plans
+    bucket separately from relation-free ones — fusing them would make
+    every member pay the pointer-doubling arms). Returns None when the
+    flattened slot count exceeds ``max_nodes``: the plan "still goes
+    solo", i.e. falls back to exact-plan grouping."""
+    try:
+        srows, trows = _flatten_plan(plan)
+    except (StructuralCompileError, IndexError, KeyError, TypeError):
+        return None
+    if len(srows) + len(trows) > max(2, int(max_nodes)):
+        return None
+    NS = _pow2(len(srows)) if srows else 0
+    NT = _pow2(len(trows))
+    has_rel = any(r[0] in (_SOP["child"], _SOP["desc"]) for r in srows)
+    return ("bucket", NS, NT, bool(has_rel))
+
+
+@dataclass
+class BucketedStructural:
+    """Q mixed-plan compiled predicates fused under ONE bucket
+    descriptor: ``plan`` is the ("bucket", NS, NT, has_rel) jit key and
+    ``tables`` carries NINE dynamic leaves — the 7 standard parameter
+    tables with a leading [Q] axis (padded to the group max exactly
+    like StackedStructural) plus the per-query span/trace slot programs
+    ([Q, NS, 4] / [Q, NT, 4] int32). Member programs index only their
+    OWN padded tables, so member-local indices are always in range."""
+
+    plan: tuple
+    tables: tuple            # 9 leaves, each [Q, ...] or None
+    n_queries: int
+    active_nodes: int = 0    # sum of members' real (unpadded) slots
+    slot_nodes: int = 0      # n_queries * (NS + NT) bucket slots
+
+    def device_tables(self):
+        return _device_tables_cached(self, self.tables)
+
+    def shape_sig(self) -> tuple:
+        def sig(t):
+            return None if t is None else (tuple(t.shape), str(t.dtype))
+        return (self.plan,) + tuple(sig(t) for t in self.tables)
+
+
+def stack_bucketed(sts: list, pad_q: int,
+                   desc: tuple) -> BucketedStructural:
+    """Stack mixed-plan compiled predicates under one bucket descriptor
+    (every member's canonical_bucket MUST equal ``desc`` — the
+    bucket_group_key contract). Parameter tables pad to the group max
+    with inert rows a member's program never references (term_keys -1,
+    val_ranges [1, 0], agg_params (0, 1, 0) so the computed-but-
+    unselected quantile arm never divides by zero); the probe product
+    mirrors stack_structural. Pad query lanes replay member 0."""
+    import jax.numpy as jnp
+
+    from . import packing
+
+    _op, NS, NT, _rel = desc
+    Qn = len(sts)
+    active = 0
+    sprogs = []
+    tprogs = []
+    for st in sts:
+        srows, trows = _flatten_plan(st.plan)
+        active += len(srows) + len(trows)
+        sp = np.zeros((max(1, NS), 4), dtype=np.int32)
+        if srows:
+            sp[:len(srows)] = np.asarray(srows, dtype=np.int32)
+        tp = np.zeros((NT, 4), dtype=np.int32)
+        body = trows[:-1]
+        if body:
+            tp[:len(body)] = np.asarray(body, dtype=np.int32)
+        tp[NT - 1] = trows[-1]       # root copy -> the result slot
+        sprogs.append(sp)
+        tprogs.append(tp)
+
+    def lane(i: int):
+        return sts[i] if i < Qn else sts[0]
+
+    def lane_prog(progs, i: int):
+        return progs[i] if i < Qn else progs[0]
+
+    # term_keys [B, T] -> [Q, B, Tm] (-1 = no term); members that
+    # compiled without tag leaves get all -1 rows
+    term_keys = val_ranges = None
+    if any(st.term_keys is not None for st in sts):
+        B = next(st.term_keys.shape[0] for st in sts
+                 if st.term_keys is not None)
+        Tm = _pow2(max(st.term_keys.shape[1] for st in sts
+                       if st.term_keys is not None))
+        Rm = _pow2(max(st.val_ranges.shape[2] for st in sts
+                       if st.val_ranges is not None))
+        term_keys = np.full((pad_q, B, Tm), -1, dtype=np.int32)
+        val_ranges = np.tile(np.array([1, 0], dtype=np.int32),
+                             (pad_q, B, Tm, Rm, 1))
+        for qi in range(pad_q):
+            st = lane(qi)
+            if st.term_keys is None:
+                continue
+            term_keys[qi, :, :st.term_keys.shape[1]] = st.term_keys
+            vr = st.val_ranges
+            val_ranges[qi, :, :vr.shape[1], :vr.shape[2]] = vr
+
+    def stack_padded(name: str, width: tuple, fill) -> np.ndarray | None:
+        rows = [getattr(lane(i), name) for i in range(pad_q)]
+        if all(r is None for r in rows):
+            return None
+        Nm = _pow2(max(r.shape[0] for r in rows if r is not None))
+        dt = next(r for r in rows if r is not None).dtype
+        out = np.empty((pad_q, Nm) + width, dtype=dt)
+        out[...] = fill
+        for qi, r in enumerate(rows):
+            if r is not None:
+                out[qi, :r.shape[0]] = r
+        return out
+
+    dur_params = stack_padded("dur_params", (2,), 0)
+    kind_params = stack_padded("kind_params", (), 0)
+    agg_params = stack_padded("agg_params", (3,),
+                              np.array([0, 1, 0], dtype=np.uint32))
+    # probe product: same zero-mask + all -1 group-row padding as
+    # stack_structural for host-path / probe-less members
+    val_hits = block_group = None
+    if any(st.val_hits is not None for st in sts):
+        hits = {id(st): st.val_hits for st in sts
+                if st.val_hits is not None}
+        if any(packing.is_packed_mask(h) for h in hits.values()):
+            hits = {k: packing.pack_mask_words(h)
+                    for k, h in hits.items()}
+        Gm = max(int(h.shape[0]) for h in hits.values())
+        Tm2 = max(int(h.shape[1]) for h in hits.values())
+        Vm = max(int(h.shape[2]) for h in hits.values())
+        dt = next(iter(hits.values())).dtype
+        zero = jnp.zeros((Gm, Tm2, Vm), dtype=dt)
+        B = next(st.term_keys.shape[0] for st in sts
+                 if st.term_keys is not None)
+        block_group = np.full((pad_q, B), -1, dtype=np.int32)
+        rows = []
+        for qi in range(pad_q):
+            st = lane(qi)
+            if st.val_hits is None or qi >= Qn:
+                rows.append(zero)
+                continue
+            h = hits[id(st)]
+            rows.append(jnp.pad(h, ((0, Gm - h.shape[0]),
+                                    (0, Tm2 - h.shape[1]),
+                                    (0, Vm - h.shape[2]))))
+            block_group[qi] = st.block_group
+        val_hits = jnp.stack(rows)                 # [Q, Gm, Tm, Vm]
+    span_prog = np.stack([lane_prog(sprogs, i) for i in range(pad_q)])
+    trace_prog = np.stack([lane_prog(tprogs, i) for i in range(pad_q)])
+    return BucketedStructural(
+        plan=desc,
+        tables=(term_keys, val_ranges, val_hits, block_group,
+                dur_params, kind_params, agg_params,
+                span_prog, trace_prog),
+        n_queries=Qn, active_nodes=active,
+        slot_nodes=Qn * (NS + NT))
 
 
 def _device_tables_cached(owner, tables: tuple) -> tuple:
@@ -808,8 +1122,9 @@ def structural_entry_mask(kv_key, kv_val, entry_dur, entry_valid,
 
     safe_pb = jnp.maximum(page_block, 0)
     valid = entry_valid & (page_block >= 0)[:, None]
+    bucketed = plan[0] == "bucket"
     (term_keys, val_ranges, val_hits, block_group,
-     dur_params, kind_params, agg_params) = tables
+     dur_params, kind_params, agg_params) = tables[:7]
     bg_page = None
     if val_hits is not None and block_group is not None:
         bg_page = block_group[safe_pb]                   # [P]
@@ -831,6 +1146,9 @@ def structural_entry_mask(kv_key, kv_val, entry_dur, entry_valid,
                 bg_span)
     ectx = (kv_key, kv_val, entry_dur, entry_dur_res, valid, safe_pb,
             bg_page)
+    if bucketed:
+        return _bucket_trace_mask(ectx, sctx, tables, widths,
+                                  bucket=plan) & valid
     return _trace_mask(plan, ectx, sctx, tables, widths) & valid
 
 
@@ -1037,6 +1355,195 @@ def _trace_mask(plan, ectx, sctx, tables, widths):
     if op == "not":
         return ~_trace_mask(plan[2], ectx, sctx, tables, widths) & valid
     raise StructuralCompileError(f"bad trace plan op {op!r}")
+
+
+def _cmp_dyn(a, b, opc):
+    """Dynamic-opcode comparison (the bucket-program twin of _cmp_dev):
+    all six verdicts compute, the traced compare code selects one."""
+    import jax.numpy as jnp
+
+    out = a != b
+    for code, m in ((0, a > b), (1, a >= b), (2, a < b),
+                    (3, a <= b), (4, a == b)):
+        out = jnp.where(opc == code, m, out)
+    return out
+
+
+def _bucket_span_regs(sctx, core, n_slots, prog, has_rel) -> list:
+    """Evaluate a span slot program: returns the register list (index 0
+    = the dummy all-false register, register i+1 = slot i's [S] mask).
+    Each slot computes every opcode arm from ITS dynamic row and
+    selects by the traced opcode — the slot-machine dual of
+    _span_mask's static descriptor dispatch. Pad slots (opcode 0)
+    evaluate to false and are unreachable from any real slot."""
+    import jax
+    import jax.numpy as jnp
+
+    from .packing import mask_select_grouped
+
+    (s_valid, s_block, s_par, s_dur, s_kind, s_kk, s_vv,
+     _seg_b, _seg_n, bg_span) = sctx
+    (term_keys, val_ranges, val_hits, _bg, dur_params, kind_params,
+     _agg) = core
+    S = int(s_valid.shape[0])
+    false = jnp.zeros(S, dtype=bool)
+    safe_par = jnp.maximum(s_par, 0)
+    regs = [false]
+    for i in range(n_slots):
+        opc, a, b = prog[i, 0], prog[i, 1], prog[i, 2]
+        prev = jnp.stack(regs)                       # [i+1, S]
+        ra = prev[jnp.clip(a, 0, i)]
+        rb = prev[jnp.clip(b, 0, i)]
+        val = false
+        if term_keys is not None:
+            k_per = term_keys[s_block, a]            # [S]
+            keym = s_kk == k_per[:, None]            # [S,Cs]
+            lo = val_ranges[s_block, a, :, 0]        # [S,R]
+            hi = val_ranges[s_block, a, :, 1]
+            v = s_vv[..., None]                      # [S,Cs,1]
+            valm = ((v >= lo[:, None, :]) &
+                    (v <= hi[:, None, :])).any(-1)   # [S,Cs]
+            if bg_span is not None:
+                safe_g = jnp.maximum(bg_span, 0)
+                safe_v = jnp.maximum(s_vv, 0).astype(jnp.int32)
+                mh = (mask_select_grouped(val_hits, safe_g[:, None], a,
+                                          safe_v)
+                      & (s_vv >= 0))
+                valm = jnp.where((bg_span >= 0)[:, None], mh, valm)
+            tag_m = jnp.any(keym & valm, axis=-1) & s_valid
+            val = jnp.where(opc == 1, tag_m, val)
+        if dur_params is not None:
+            dur_m = ((s_dur >= dur_params[a, 0]) &
+                     (s_dur <= dur_params[a, 1]) & s_valid)
+            val = jnp.where(opc == 2, dur_m, val)
+        if kind_params is not None:
+            kind_m = ((s_kind.astype(jnp.int32) == kind_params[a])
+                      & s_valid)
+            val = jnp.where(opc == 3, kind_m, val)
+        val = jnp.where(opc == 4, ra & rb, val)
+        val = jnp.where(opc == 5, ra | rb, val)
+        val = jnp.where(opc == 6, ~ra & s_valid, val)
+        if has_rel:
+            val = jnp.where(opc == 7,
+                            rb & (s_par >= 0) & ra[safe_par], val)
+
+            # the same rolled pointer doubling as _span_mask's desc
+            def _dbl(_i, carry):
+                acc, jump = carry
+                safe_j = jnp.maximum(jump, 0)
+                acc2 = acc | ((jump >= 0) & acc[safe_j])
+                jump2 = jnp.where(jump >= 0, jump[safe_j], -1)
+                return acc2, jump2
+
+            acc, _ = jax.lax.fori_loop(
+                0, max(1, (S - 1).bit_length()), _dbl,
+                ((s_par >= 0) & ra[safe_par], s_par))
+            val = jnp.where(opc == 8, rb & acc, val)
+        regs.append(val)
+    return regs
+
+
+def _bucket_trace_mask(ectx, sctx, tables, widths, *, bucket):
+    """[P, E] bool verdicts for ONE query lane of a bucket-stacked
+    group. ``bucket`` = ("bucket", NS, NT, has_rel) is the static
+    descriptor (part of every consuming kernel's jit key, like
+    ``plan``); tables[7]/tables[8] are this lane's span/trace slot
+    programs. The result register is statically the last trace slot
+    (the flattener's root-copy contract), so no dynamic final gather
+    is needed."""
+    import jax.numpy as jnp
+
+    from .packing import duration_ok, mask_select_grouped, unpack_ids
+
+    core = tables[:7]
+    span_prog, trace_prog = tables[7], tables[8]
+    (kv_key, kv_val, entry_dur, entry_dur_res, valid, safe_pb,
+     bg_page) = ectx
+    (term_keys, val_ranges, val_hits, _bg, dur_params, _kind,
+     agg_params) = core
+    kw, vw, dw = widths if widths is not None else (None, None, None)
+    NS, NT = bucket[1], bucket[2]
+    sprev = seg_b = seg_n = s_dur = None
+    if bucket[1]:
+        if sctx is not None:
+            sregs = _bucket_span_regs(sctx, core, NS, span_prog,
+                                      bucket[3])
+            sprev = jnp.stack(sregs)                 # [NS+1, S]
+            seg_b, seg_n, s_dur = sctx[7], sctx[8], sctx[3]
+    kk = vv = None
+    if term_keys is not None:
+        kk = unpack_ids(kv_key, kw)
+        vv = unpack_ids(kv_val, vw)
+    false = jnp.zeros(valid.shape, dtype=bool)
+    tregs = [false]
+    for i in range(NT):
+        opc, a, b, c = (trace_prog[i, 0], trace_prog[i, 1],
+                        trace_prog[i, 2], trace_prog[i, 3])
+        prev = jnp.stack(tregs)
+        ra = prev[jnp.clip(a, 0, i)]
+        rb = prev[jnp.clip(b, 0, i)]
+        val = false
+        if term_keys is not None:
+            k_per_page = term_keys[safe_pb, a]       # [P]
+            keym = kk == k_per_page[:, None, None]   # [P,E,C]
+            lo = val_ranges[safe_pb, a, :, 0]        # [P,R]
+            hi = val_ranges[safe_pb, a, :, 1]
+            v = vv[..., None]
+            valm = ((v >= lo[:, None, None, :]) &
+                    (v <= hi[:, None, None, :])).any(-1)
+            if bg_page is not None:
+                safe_g = jnp.maximum(bg_page, 0)
+                safe_v = jnp.maximum(vv, 0).astype(jnp.int32)
+                mh = (mask_select_grouped(
+                    val_hits, safe_g[:, None, None], a, safe_v)
+                    & (vv >= 0))
+                valm = jnp.where((bg_page >= 0)[:, None, None], mh,
+                                 valm)
+            ttag_m = jnp.any(keym & valm, axis=-1) & valid
+            val = jnp.where(opc == 1, ttag_m, val)
+        if dur_params is not None:
+            tdur_m = duration_ok(entry_dur, entry_dur_res,
+                                 dur_params[a, 0], dur_params[a, 1],
+                                 dw) & valid
+            val = jnp.where(opc == 2, tdur_m, val)
+        if sprev is not None:
+            sm = sprev[jnp.clip(a, 0, NS)]
+            cnt = _seg_count(sm, seg_b, seg_n).astype(jnp.uint32)
+            val = jnp.where(opc == 3, (cnt > 0) & valid, val)
+            if agg_params is not None:
+                count_m = _cmp_dyn(cnt, agg_params[b, 0], c) & valid
+                val = jnp.where(opc == 4, count_m, val)
+                qn = agg_params[b, 0]
+                # pad agg rows are (0, 1, 0): the clamp keeps the
+                # computed-but-unselected arm division-safe anyway
+                qd = jnp.maximum(agg_params[b, 1], jnp.uint32(1))
+                x = agg_params[b, 2]
+                r = (qn * cnt + qd - jnp.uint32(1)) // qd
+                hi_inner = jnp.where(c == 0, s_dur > x, s_dur >= x)
+                lo_inner = jnp.where(c == 2, s_dur < x, s_dur <= x)
+                c_hi = _seg_count(sm & hi_inner, seg_b,
+                                  seg_n).astype(jnp.uint32)
+                c_lo = _seg_count(sm & lo_inner, seg_b,
+                                  seg_n).astype(jnp.uint32)
+                ok_hi = c_hi >= cnt - r + jnp.uint32(1)
+                ok_lo = c_lo >= r
+                eq = ok_hi & ok_lo
+                q_ok = jnp.where(c <= 1, ok_hi,
+                                 jnp.where(c <= 3, ok_lo,
+                                           jnp.where(c == 4, eq, ~eq)))
+                val = jnp.where(opc == 5,
+                                q_ok & (cnt > 0) & valid, val)
+        elif agg_params is not None:
+            # span-less batch: exists/q are false, count still compares
+            # against zero — the _trace_mask sctx-None semantics
+            n0 = jnp.zeros(valid.shape, dtype=jnp.uint32)
+            count_m = _cmp_dyn(n0, agg_params[b, 0], c) & valid
+            val = jnp.where(opc == 4, count_m, val)
+        val = jnp.where(opc == 6, ra & rb, val)
+        val = jnp.where(opc == 7, ra | rb, val)
+        val = jnp.where(opc == 8, ~ra & valid, val)
+        tregs.append(val)
+    return tregs[-1]
 
 
 # ---------------------------------------------------------------------------

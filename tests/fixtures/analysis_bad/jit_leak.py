@@ -97,6 +97,28 @@ def span_layout_clean_kernel(arr, *, span_sharded):
     return _layout_fixture(arr, span_sharded)
 
 
+def _bucket_fixture(arr, bucket):
+    """Shape-bucket-descriptor-shaped helper (bucketed cross-plan
+    stacking idiom): unpacks slot tiers from its descriptor at trace
+    time, so a tracer reaching `bucket` is a trace-time leak."""
+    if bucket[1]:
+        return arr[: bucket[1]]
+    return arr
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def bucket_taint_kernel(arr, sel, *, bucket):
+    # VIOLATION: tracer data passed as the shape-bucket descriptor —
+    # the helper unpacks slot tiers from it at trace time
+    return _bucket_fixture(arr, sel)
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def bucket_clean_kernel(arr, *, bucket):
+    # the good twin: the descriptor comes from the static `bucket`
+    return _bucket_fixture(arr, bucket)
+
+
 @functools.partial(jax.jit, static_argnames=("top_k",))
 def clean_kernel(scores, mask, extra=None, *, top_k):
     n = scores.shape[0]            # shape reads are static: fine

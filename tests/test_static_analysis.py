@@ -238,6 +238,21 @@ def test_jit_purity_flags_tainted_span_layout_descriptor(bad_pkg):
         [f.message for f in findings]
 
 
+def test_jit_purity_flags_tainted_bucket_descriptor(bad_pkg):
+    """The shape-bucket descriptor (bucketed cross-plan stacking) is a
+    descriptor like widths/plan/span_sharded: tracer data reaching a
+    bucket-dispatching helper is flagged; the static twin stays
+    silent."""
+    findings = JitPurityChecker().check(bad_pkg)
+    taint = [f for f in findings if f.key.startswith("descriptor-taint:")
+             and "bucket_taint_kernel" in f.key]
+    assert taint and "'bucket'" in taint[0].message, \
+        [f.message for f in findings]
+    assert not [f for f in findings
+                if "bucket_clean_kernel" in f.key], \
+        [f.message for f in findings]
+
+
 def test_contract_new_structural_gates_registered():
     """The stacking and sharding gates are pinned by BOTH registries:
     the gate functions test their attribute first (GatedFunction) and
@@ -253,11 +268,17 @@ def test_contract_new_structural_gates_registered():
             "search_structural_stack_enabled") in gated
     assert ("StructuralGate.shard_span_segment",
             "search_structural_shard_spans") in gated
+    assert ("StructuralGate.bucket_group_key",
+            "search_structural_bucket_enabled") in gated
+    assert ("StructuralGate.remainder_pad",
+            "search_structural_remainder_pages") in gated
     guarded = {(m, g.knob) for g in GUARDED_CALLS for m in g.methods}
     assert ("stack_group_key",
             "search_structural_stack_enabled") in guarded
     assert ("shard_span_segment",
             "search_structural_shard_spans") in guarded
+    assert ("remainder_pad",
+            "search_structural_remainder_pages") in guarded
 
 
 def test_jit_purity_clean_on_real_kernels(real_pkg):

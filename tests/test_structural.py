@@ -49,12 +49,18 @@ def _structural_on():
     prev = STRUCTURAL.enabled
     prev_stack = STRUCTURAL.stack_enabled
     prev_shard = STRUCTURAL.shard_spans
+    prev_bucket = STRUCTURAL.bucket_enabled
+    prev_bucket_max = STRUCTURAL.bucket_max_nodes
+    prev_remainder = STRUCTURAL.remainder_pages
     STRUCTURAL.enabled = True
     packing_prev = packing_mod.PACKING.enabled
     yield
     STRUCTURAL.enabled = prev
     STRUCTURAL.stack_enabled = prev_stack
     STRUCTURAL.shard_spans = prev_shard
+    STRUCTURAL.bucket_enabled = prev_bucket
+    STRUCTURAL.bucket_max_nodes = prev_bucket_max
+    STRUCTURAL.remainder_pages = prev_remainder
     packing_mod.PACKING.enabled = packing_prev
     robustness.BREAKER.reset()
 
