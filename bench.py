@@ -1494,6 +1494,94 @@ def phase_freshness():
 
     byte_identical = wal_bytes(True) == wal_bytes(False)
 
+    # ---- hot-tier gate-on leg (search-live-tail.md): push→searchable
+    # through the live tier, NO flush/poll maintenance at all — the
+    # rolling stage alone must make a push searchable, under the same
+    # soak write load as the baseline leg above. The canary probes the
+    # FULL app search path (frontend → ingester leg → hot scan), not
+    # the reader TempoDB, which only sees flushed blocks.
+    from tempo_tpu.db.tempodb import TempoDBConfig
+    from tempo_tpu.search.live_tier import LIVE_TIER
+
+    live_probes = int(os.environ.get("BENCH_FRESH_LIVE_PROBES", probes))
+    app2 = App(AppConfig(
+        wal_dir=os.path.join(tmp, "wal-live"),
+        db=TempoDBConfig(search_live_tier_enabled=True),
+        ingest_telemetry_enabled=True, limits=lim))
+    stop2 = threading.Event()
+    pushed2 = [0] * writers
+
+    def live_writer(w: int) -> None:
+        i = 0
+        while not stop2.is_set():
+            tr = _now_trace(w * 1_000_003 + i)
+            try:
+                app2.push(f"soak-{w}", list(tr.batches))
+                pushed2[w] += 1
+            except Exception:  # noqa: BLE001 — limits under soak are fine
+                pass
+            i += 1
+            time.sleep(0.001)
+
+    threads2 = [threading.Thread(target=live_writer, args=(w,),
+                                 daemon=True) for w in range(writers)]
+    live_t0 = time.monotonic()
+    for t in threads2:
+        t.start()
+    live_canary = IngestCanary(app2.push, app2.search, tenant="canary",
+                               poll_step_s=0.01)
+    # warmup probe (not sampled): first gate-on search pays the hot
+    # kernel's XLA compile — steady state hits the compile cache
+    live_canary.probe_once(timeout_s=60.0)
+    live_canary.probes = live_canary.failures = 0
+    live_samples: list[float] = []
+    live_deadline = time.monotonic() + max(soak_s, live_probes * 2.0) + 30.0
+    while len(live_samples) + live_canary.failures < live_probes \
+            and time.monotonic() < live_deadline:
+        f = live_canary.probe_once(timeout_s=15.0)
+        if f is not None:
+            live_samples.append(f)
+
+    # ---- live_tail sub-phase: standing-query push→notify latency
+    # under the same soak load — the subscription is evaluated inside
+    # the push micro-batch, so notify lands before the push ack
+    from tempo_tpu import tempopb as _pb
+
+    tail_req = _pb.SearchRequest()
+    tail_req.tags["service.name"] = "tempo-canary"
+    tail_sub = app2.tail_subscribe("canary", tail_req)
+    tail_samples: list[float] = []
+    tail_missed = 0
+    if tail_sub is not None:
+        for _ in range(live_probes):
+            t0 = time.monotonic()
+            app2.push("canary",
+                      [live_canary._make_batch("tail-bench")])
+            if tail_sub.poll(timeout_s=5.0):
+                tail_samples.append(time.monotonic() - t0)
+            else:
+                tail_missed += 1
+        app2.tail_unsubscribe(tail_sub)
+    stop2.set()
+    for t in threads2:
+        t.join(timeout=10.0)
+    live_elapsed = time.monotonic() - live_t0
+    try:
+        app2.shutdown()
+    except Exception:  # noqa: BLE001 — bench teardown best-effort
+        pass
+    # later phases measure the gate-off default; don't leak the tier
+    LIVE_TIER.configure(enabled=False)
+
+    def _pct(vals, p):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1, int(p * len(vals)))], 3)
+
+    live_p99 = _pct(live_samples, 0.99)
+    tail_p99 = _pct(tail_samples, 0.99)
+
     samples.sort()
 
     def pct(p):
@@ -1526,6 +1614,22 @@ def phase_freshness():
         "overhead_pct": round(overhead_pct, 3),
         "within_2pct": overhead_pct < 2.0,
         "byte_identical": byte_identical,
+        # hot-tier gate-on leg: no maintenance loop at all — the rolling
+        # stage alone answers, so these numbers ARE the tier's freshness
+        "live_tier": {
+            "soak_s": round(live_elapsed, 2),
+            "traces_pushed": sum(pushed2),
+            "probes": live_canary.probes,
+            "probe_failures": live_canary.failures,
+            "push_to_searchable_p50_s": _pct(live_samples, 0.50),
+            "push_to_searchable_p99_s": live_p99,
+        },
+        "live_tail": {
+            "notified": len(tail_samples),
+            "missed": tail_missed,
+            "push_to_notify_p50_s": _pct(tail_samples, 0.50),
+            "push_to_notify_p99_s": tail_p99,
+        },
     }
     assert samples, (
         f"no canary probe became searchable ({canary.failures} failures: "
@@ -1541,6 +1645,21 @@ def phase_freshness():
         f"ingest telemetry record cost {record_us - noop_us:.2f}us is "
         f"{overhead_pct:.2f}% of the {push_us:.0f}us push ack — exceeds "
         "the 2% budget")
+    assert live_samples, (
+        f"no gate-on canary probe became searchable through the hot "
+        f"tier ({live_canary.failures} failures: "
+        f"{live_canary.last_error}) — the live tier is wedged")
+    # the tentpole SLO: the hot tier answers WITHOUT waiting for
+    # flush+poll, so push→searchable collapses from the multi-second
+    # maintenance cadence to the push ack + one hot scan
+    assert live_p99 is not None and live_p99 < 0.25, (
+        f"hot-tier push→searchable p99 {live_p99}s exceeds the 250ms "
+        "gate-on budget — the rolling stage is not absorbing pushes "
+        "or the scan is falling back")
+    assert tail_sub is not None and not tail_missed, (
+        f"live tail missed {tail_missed} of {live_probes} standing-"
+        "query notifications (sub registered: "
+        f"{tail_sub is not None})")
     return result
 
 
@@ -2720,7 +2839,7 @@ PHASE_TIMEOUTS = {
     "high_cardinality_full": 420.0,
     "profile_overhead": 300.0,
     "query_stats_overhead": 300.0,
-    "freshness": 420.0,
+    "freshness": 560.0,  # baseline leg + hot-tier gate-on leg + tail
     "chaos": 420.0,
     "ownership": 420.0,
     "packing": 420.0,

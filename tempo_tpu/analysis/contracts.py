@@ -131,6 +131,32 @@ GATED_FUNCTIONS = (
     GatedFunction("tempo_tpu.search.structural",
                   "StructuralGate.remainder_pad", ("remainder_pages",),
                   "search_structural_remainder_pages"),
+    # hot-tier live search: every ingest/search/poll hook is internally
+    # gated — disabled deployments pay one attribute read per push, per
+    # cut, per search leg, and the legacy per-entry walk stays
+    # byte-identical (tests/test_live_tier.py asserts the identity)
+    GatedFunction("tempo_tpu.search.live_tier", "LiveTier.absorb",
+                  ("enabled",), "search_live_tier_enabled"),
+    GatedFunction("tempo_tpu.search.live_tier", "LiveTier.mark_cut",
+                  ("enabled",), "search_live_tier_enabled"),
+    GatedFunction("tempo_tpu.search.live_tier", "LiveTier.drop_tenant",
+                  ("enabled",), "search_live_tier_enabled"),
+    GatedFunction("tempo_tpu.search.live_tier",
+                  "LiveTier.mark_poll_visible", ("enabled",),
+                  "search_live_tier_enabled"),
+    GatedFunction("tempo_tpu.search.live_tier", "LiveTier.poll_visible",
+                  ("enabled",), "search_live_tier_enabled"),
+    GatedFunction("tempo_tpu.search.live_tier", "LiveTier.search",
+                  ("enabled",), "search_live_tier_enabled"),
+    GatedFunction("tempo_tpu.search.live_tier", "LiveTier.subscribe",
+                  ("enabled",), "search_live_tier_enabled"),
+    GatedFunction("tempo_tpu.search.live_tier", "LiveTier.unsubscribe",
+                  ("enabled",), "search_live_tier_enabled"),
+    GatedFunction("tempo_tpu.search.live_tier",
+                  "LiveTier.has_subscribers", ("enabled",),
+                  "search_live_tier_enabled"),
+    GatedFunction("tempo_tpu.search.live_tier", "LiveTier.notify_push",
+                  ("enabled",), "search_live_tier_enabled"),
 )
 
 GUARDED_CALLS = (
@@ -166,6 +192,15 @@ GUARDED_CALLS = (
     # without even calling the pad helper
     GuardedCall("STRUCTURAL", ("remainder_pad",), (), "remainder_pages",
                 "STRUCTURAL", "search_structural_remainder_pages"),
+    # hot-tier hooks on the ingest/search hot paths: every call site
+    # must be dominated by the one-attribute gate read so the disabled
+    # deployment never enters the tier (poll_visible/has_subscribers
+    # are consulted inside guard tests themselves and stay covered by
+    # their internal gates)
+    GuardedCall("LIVE_TIER", ("absorb", "mark_cut", "search",
+                              "mark_poll_visible", "subscribe",
+                              "unsubscribe", "notify_push"), (),
+                "enabled", "LIVE_TIER", "search_live_tier_enabled"),
 )
 
 

@@ -65,9 +65,13 @@ _NP_HOST_FNS = {"asarray", "array", "frombuffer", "copy"}
 # relations arm from it at trace time. `shard_tail` is the ragged-tail
 # layout descriptor (remainder-shard staging): the dist kernels select
 # the tail-masking arm on it — both decide branch structure exactly
-# like `span_sharded` and must stay in the static jit key.
+# like `span_sharded` and must stay in the static jit key. `tier` is
+# the hot-tier page-capacity descriptor (live-tier rolling stages): the
+# hot dispatch selects the capacity-masking arm on it at trace time,
+# and keeping it static is what makes absorbs within a capacity tier
+# re-enter the same compiled kernel instead of retracing per size.
 _DESCRIPTOR_PARAMS = {"w", "dw", "widths", "plan", "span_sharded",
-                      "bucket", "shard_tail"}
+                      "bucket", "shard_tail", "tier"}
 
 
 def _branches_on_param(helper: ast.AST, param: str) -> bool:

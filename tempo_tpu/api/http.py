@@ -25,8 +25,10 @@ from .params import (
     HEADER_TENANT,
     PATH_ECHO,
     PATH_SEARCH,
+    PATH_SEARCH_STREAM,
     PATH_SEARCH_TAGS,
     PATH_SEARCH_TAG_VALUES,
+    PATH_TAIL,
     PATH_TRACES,
     InvalidArgument,
     parse_search_request,
@@ -55,6 +57,33 @@ class TextBody(str):
         self = super().__new__(cls, s)
         self.content_type = content_type
         return self
+
+
+class SSEBody:
+    """A streaming response body: an iterator of pre-rendered
+    Server-Sent-Event frames. Unlike TextBody this is NOT a str — the
+    whole point is that the wire serializer must not buffer it. _reply
+    writes each frame as it arrives (Content-Type: text/event-stream, no
+    Content-Length, flush per event); handle() callers in tests iterate
+    `.events` directly. close() closes the underlying generator so its
+    `finally` blocks run (tail routes unsubscribe there) even when the
+    client hangs up mid-stream."""
+
+    content_type = "text/event-stream"
+
+    def __init__(self, events):
+        self.events = events
+
+    def close(self) -> None:
+        close = getattr(self.events, "close", None)
+        if close is not None:
+            close()
+
+
+def _sse_event(name: str, doc: dict) -> str:
+    """One SSE frame. data: is a single line — json.dumps never emits
+    raw newlines — so the event ends at the blank line per the spec."""
+    return f"event: {name}\ndata: {json.dumps(doc)}\n\n"
 
 
 def _int_param(query: dict, key: str, default: int) -> int:
@@ -235,26 +264,12 @@ class HTTPApi:
             if want_proto:
                 return code, resp.trace.SerializeToString()
             return code, json_format.MessageToDict(resp.trace)
+        if path == PATH_SEARCH_STREAM:
+            return self._search_stream(tenant, query, headers)
+        if path == PATH_TAIL:
+            return self._tail_stream(tenant, query)
         if path == PATH_SEARCH:
-            req = parse_search_request(query)
-            from tempo_tpu.search.structural import (STRUCTURAL,
-                                                     STRUCTURAL_QUERY_TAG)
-
-            if STRUCTURAL_QUERY_TAG in req.tags and not STRUCTURAL.enabled:
-                # structural queries are gated per deployment
-                # (docs/search-structural-queries.md): a clear client
-                # error, not a silent legacy-scan answer
-                return 400, {"error": "structural queries disabled "
-                                      "(storage.search_structural_"
-                                      "enabled: true enables)"}
-            # explain opt-in: ?explain=1 (parse_search_request) or the
-            # X-Tempo-Explain header — the response then carries the
-            # full per-query execution breakdown. Same value set as the
-            # query param: "X-Tempo-Explain: 0" must NOT opt in
-            if hasattr(headers, "get") and \
-                    (headers.get("X-Tempo-Explain") or "").strip().lower() \
-                    in ("1", "true", "yes"):
-                req.explain = True
+            req = self._parse_search(query, headers)
             # request deadline: X-Tempo-Timeout-S header, else the
             # search_request_timeout_s config default — propagates
             # http → frontend → querier → TempoDB via the worker
@@ -339,6 +354,120 @@ class HTTPApi:
             db_cfg = getattr(getattr(self.app, "cfg", None), "db", None)
             timeout = getattr(db_cfg, "search_request_timeout_s", 0.0)
         return rdeadline.start(timeout)
+
+    # ---- streaming search + live tail (docs/search-live-tail.md) ----
+
+    def _parse_search(self, query, headers):
+        """Shared request prep for /api/search and /api/search/stream:
+        parse, structural-gate check, explain opt-in."""
+        req = parse_search_request(query)
+        from tempo_tpu.search.structural import (STRUCTURAL,
+                                                 STRUCTURAL_QUERY_TAG)
+
+        if STRUCTURAL_QUERY_TAG in req.tags and not STRUCTURAL.enabled:
+            # structural queries are gated per deployment
+            # (docs/search-structural-queries.md): a clear client
+            # error, not a silent legacy-scan answer
+            raise InvalidArgument("structural queries disabled "
+                                  "(storage.search_structural_"
+                                  "enabled: true enables)")
+        # explain opt-in: ?explain=1 (parse_search_request) or the
+        # X-Tempo-Explain header — the response then carries the
+        # full per-query execution breakdown. Same value set as the
+        # query param: "X-Tempo-Explain: 0" must NOT opt in
+        if hasattr(headers, "get") and \
+                (headers.get("X-Tempo-Explain") or "").strip().lower() \
+                in ("1", "true", "yes"):
+            req.explain = True
+        return req
+
+    def _search_stream(self, tenant, query, headers):
+        """Progressive search: the same fan-out as /api/search, but each
+        sub-response merge that grew the result set streams a `result`
+        snapshot event immediately — hot-tier/ingester legs answer in
+        milliseconds while backend block groups are still scanning. The
+        final `done` event carries the complete merged response
+        (byte-equivalent to what /api/search would have returned)."""
+        import queue as _queue
+
+        req = self._parse_search(query, headers)
+        q: _queue.Queue = _queue.Queue()
+
+        def run():
+            # worker thread: contextvars are thread-local, so the
+            # request deadline must be entered HERE for the frontend's
+            # pool-copy propagation to pick it up
+            try:
+                with self._request_deadline(headers):
+                    resp = self.app.search(
+                        tenant, req,
+                        on_progress=lambda r: q.put(("result", r)))
+                q.put(("done", resp))
+            except Exception as e:  # noqa: BLE001 — ship to the stream
+                q.put(("error", e))
+
+        threading.Thread(target=run, daemon=True,
+                         name="search-stream").start()
+
+        def events():
+            while True:
+                kind, payload = q.get()
+                if kind == "error":
+                    yield _sse_event("error", {
+                        "error": f"{type(payload).__name__}: {payload}"})
+                    return
+                doc = json_format.MessageToDict(payload)
+                yield _sse_event(kind, doc)
+                if kind == "done":
+                    return
+
+        return 200, SSEBody(events())
+
+    def _tail_stream(self, tenant, query):
+        """Live tail: a standing query at the ingest path. Every pushed
+        trace that matches streams a `trace` event within the push's
+        micro-batch — no poll loop against /api/search needed."""
+        import time as _time
+
+        req = self._parse_search(query, headers={})
+        sub = self.app.tail_subscribe(tenant, req)
+        if sub is None:
+            from tempo_tpu.search.live_tier import LIVE_TIER
+
+            if not LIVE_TIER.enabled:
+                return 400, {"error": "live tail disabled "
+                                      "(storage.search_live_tier_"
+                                      "enabled: true enables)"}
+            return 429, {"error": "tail subscription cap reached for "
+                                  "tenant"}
+        # bounded by default: an abandoned curl must not hold a
+        # subscription slot forever (the cap is per tenant)
+        seconds = min(_int_param(query, "seconds", 30), 3600)
+        deadline = _time.monotonic() + seconds
+
+        def events():
+            try:
+                yield _sse_event("subscribed", {"seconds": seconds})
+                while True:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        yield _sse_event("done", {"reason": "duration"})
+                        return
+                    metas = sub.poll(min(remaining, 1.0))
+                    if not metas:
+                        # SSE comment = keepalive; proxies and clients
+                        # see bytes flowing on an idle tail
+                        yield ": keepalive\n\n"
+                        continue
+                    for m in metas:
+                        yield _sse_event(
+                            "trace", json_format.MessageToDict(m))
+            finally:
+                # runs on generator close() too — client hangup mid-
+                # stream must release the tenant's subscription slot
+                self.app.tail_unsubscribe(sub)
+
+        return 200, SSEBody(events())
 
     # ---- /debug/* route handlers (registered in DEBUG_ROUTES) ----
 
@@ -601,6 +730,23 @@ def serve_http(api: HTTPApi, host: str = "0.0.0.0", port: int = 3200):
             self._reply(code, out)
 
         def _reply(self, code, body):
+            if isinstance(body, SSEBody):
+                # streaming: no Content-Length, no gzip, flush per
+                # event — buffering would defeat the route's purpose
+                self.send_response(code)
+                self.send_header("Content-Type", body.content_type)
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for frame in body.events:
+                        self.wfile.write(frame.encode())
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client hung up; close() below cleans up
+                finally:
+                    body.close()
+                return
             if isinstance(body, (bytes, bytearray)):
                 # negotiated protobuf (Accept: application/protobuf on
                 # the query routes) — reference frontend.go:121-127

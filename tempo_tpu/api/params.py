@@ -14,8 +14,10 @@ from tempo_tpu import tempopb
 
 PATH_TRACES = "/api/traces"
 PATH_SEARCH = "/api/search"
+PATH_SEARCH_STREAM = "/api/search/stream"
 PATH_SEARCH_TAGS = "/api/search/tags"
 PATH_SEARCH_TAG_VALUES = "/api/search/tag"
+PATH_TAIL = "/api/tail"
 PATH_ECHO = "/api/echo"
 
 HEADER_TENANT = "X-Scope-OrgID"

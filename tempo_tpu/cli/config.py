@@ -116,6 +116,16 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "search_offload_planner_ewma", 0.25),
         search_offload_planner_ring=storage.get(
             "search_offload_planner_ring", 256),
+        # hot-tier live search (docs/search-live-tail.md): in-flight
+        # traces kernel-scan at query time and tail subscriptions
+        # evaluate per push; false (default) is a true noop — live/WAL
+        # search keeps the per-entry host walk byte-identically
+        search_live_tier_enabled=storage.get(
+            "search_live_tier_enabled", False),
+        search_live_tier_max_entries=storage.get(
+            "search_live_tier_max_entries", 4096),
+        search_live_tail_max_subscriptions=storage.get(
+            "search_live_tail_max_subscriptions", 16),
         # packed HBM residency (docs/search-packed-residency.md):
         # bit-width-adaptive staged columns + in-kernel unpack; false
         # (default) is a true noop and byte-identical either way

@@ -177,6 +177,24 @@ class TempoDBConfig:
     # False (default) is a true noop: pow2 staging exactly as before
     # (one attribute read at the staging site).
     search_structural_remainder_pages: bool = False
+    # hot-tier live search (search/live_tier.py,
+    # docs/search-live-tail.md): the ingesters' in-flight traces absorb
+    # into a per-tenant rolling columnar stage scanned by the SAME
+    # fused kernel as backend blocks (pow2-capacity tiers keep the jit
+    # key shape-only), the WAL head/completing generations kernel-scan
+    # through the identical machinery, and standing tail subscriptions
+    # evaluate per push micro-batch — push→searchable drops from
+    # flush+poll (seconds) to one absorb+scan (sub-100ms on chip).
+    # False (default) is a true noop: every hook reads one attribute;
+    # live/WAL search keeps the per-entry host walk byte-identically.
+    search_live_tier_enabled: bool = False
+    # live-stage entry ceiling per tenant: past it a search falls back
+    # to the legacy walk (counted in
+    # tempo_search_live_tier_scans_total{result=fallback_overflow})
+    search_live_tier_max_entries: int = 4096
+    # standing tail subscriptions allowed per tenant; registration past
+    # the cap is rejected (429 on /api/tail)
+    search_live_tail_max_subscriptions: int = 16
     # packed HBM residency (search/packing.py,
     # docs/search-packed-residency.md): staged value-id columns narrow
     # to the width the per-block dictionary cardinality allows (4-bit/
@@ -389,6 +407,14 @@ class TempoDB:
             bucket_enabled=self.cfg.search_structural_bucket_enabled,
             bucket_max_nodes=self.cfg.search_structural_bucket_max_nodes,
             remainder_pages=self.cfg.search_structural_remainder_pages)
+        # hot-tier live search: process-wide gate like the layers above
+        # (docs/search-live-tail.md)
+        from tempo_tpu.search.live_tier import LIVE_TIER as _live_tier
+
+        _live_tier.configure(
+            enabled=self.cfg.search_live_tier_enabled,
+            max_entries=self.cfg.search_live_tier_max_entries,
+            max_subscriptions=self.cfg.search_live_tail_max_subscriptions)
         # owner-routed HBM placement: process-wide like the layers above
         # (docs/search-hbm-ownership.md)
         from tempo_tpu.search import ownership as _ownership
@@ -552,6 +578,13 @@ class TempoDB:
             # gauge, and the flush->poll_visible pairing that closes the
             # push->searchable stage record (ingest_telemetry)
             TELEMETRY.record_poll(time.perf_counter() - t0, metas)
+        # hot-tier eviction signal: blocks this poll made reader-visible
+        # retire from the ingester's recently-flushed search leg (the
+        # reader leg answers for them now — see live_tier.py)
+        from tempo_tpu.search.live_tier import LIVE_TIER
+
+        if LIVE_TIER.enabled:
+            LIVE_TIER.mark_poll_visible(metas)
         live = {m.block_id for ms in metas.values() for m in ms}
         with self._search_lock:
             for bid in [b for b in self._search_blocks if b not in live]:

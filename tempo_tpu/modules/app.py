@@ -172,8 +172,24 @@ class App:
     def find_trace(self, tenant: str, trace_id: bytes):
         return self.frontend.find_trace_by_id(tenant, trace_id)
 
-    def search(self, tenant: str, req):
-        return self.frontend.search(tenant, req)
+    def search(self, tenant: str, req, on_progress=None):
+        return self.frontend.search(tenant, req, on_progress=on_progress)
+
+    def tail_subscribe(self, tenant: str, req):
+        """Register a standing tail query (docs/search-live-tail.md).
+        None = hot tier disabled, or the tenant's subscription cap is
+        reached — the HTTP layer maps the two to 400/429."""
+        from tempo_tpu.search.live_tier import LIVE_TIER
+
+        if not LIVE_TIER.enabled:
+            return None
+        return LIVE_TIER.subscribe(tenant, req)
+
+    def tail_unsubscribe(self, sub) -> None:
+        from tempo_tpu.search.live_tier import LIVE_TIER
+
+        if LIVE_TIER.enabled:
+            LIVE_TIER.unsubscribe(sub)
 
     # ---- maintenance ticks ----
 

@@ -208,7 +208,8 @@ class QueryFrontend:
 
     # ---- search (reference searchsharding.go:163-306) ----
 
-    def search(self, tenant: str, req: tempopb.SearchRequest) -> tempopb.SearchResponse:
+    def search(self, tenant: str, req: tempopb.SearchRequest,
+               on_progress=None) -> tempopb.SearchResponse:
         """Shard + dispatch one search. Concurrent search() calls are the
         query-coalescer's feedstock: every batched sub-request runs on a
         shared worker-pool thread (never serialized per tenant beyond
@@ -221,7 +222,8 @@ class QueryFrontend:
         first."""
         with tracing.start_span("frontend.Search", kind=tracing.KIND_SERVER,
                                 tenant=tenant) as span:
-            resp, n_batches = self._search(tenant, req)
+            resp, n_batches = self._search(tenant, req,
+                                           on_progress=on_progress)
             span.set_attributes(
                 inspected_blocks=resp.metrics.inspected_blocks,
                 inspected_traces=resp.metrics.inspected_traces,
@@ -350,8 +352,14 @@ class QueryFrontend:
         self._batches_cache.put(key, out)
         return out
 
-    def _search(self, tenant: str,
-                req: tempopb.SearchRequest) -> tuple[tempopb.SearchResponse, int]:
+    def _search(self, tenant: str, req: tempopb.SearchRequest,
+                on_progress=None) -> tuple[tempopb.SearchResponse, int]:
+        """on_progress: optional callable(SearchResponse) invoked after
+        each sub-response merges that GREW the result set — the
+        progressive-streaming seam (docs/search-live-tail.md). The job
+        list leads with the ingester/hot-tier leg, so the first
+        increment a streaming client sees is the freshest data. Called
+        under the merge lock: it must enqueue, not block."""
         import threading
 
         from tempo_tpu.search import query_stats
@@ -378,9 +386,12 @@ class QueryFrontend:
             (reference results.go quit channel + searchsharding.go:219-274
             stop-dispatch)."""
             with merge_lock:
+                before = merged.n_results
                 merged.merge_response(r)
                 if merged.complete:
                     quit_event.set()
+                if on_progress is not None and merged.n_results > before:
+                    on_progress(merged.response())
 
         recent_failed = [False]
 

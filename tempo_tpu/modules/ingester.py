@@ -25,6 +25,7 @@ from tempo_tpu.db import TempoDB
 from tempo_tpu.model.codec import segment_codec_for, CURRENT_ENCODING
 from tempo_tpu.search import SearchResults, decode_search_data
 from tempo_tpu.search.data import SearchData, search_data_matches
+from tempo_tpu.search.live_tier import LIVE_TIER
 from tempo_tpu.search.streaming import StreamingSearchBlock, _meta_from_sd
 from tempo_tpu.observability import metrics as obs
 from tempo_tpu.utils.ids import pad_trace_id
@@ -157,6 +158,12 @@ class TenantInstance:
             obs.live_traces.set(len(self.live), tenant=self.tenant)
             if search_data:
                 t.search_raw.append(search_data)
+                # hot tier: absorb under the instance lock so the tier's
+                # live stage mirrors self.live deterministically (a cut
+                # between push and absorb would otherwise resurrect the
+                # trace in the stage and double-answer forever)
+                if LIVE_TIER.enabled:
+                    LIVE_TIER.absorb(self.tenant, tid, search_data)
 
     # ---- sweep / cut (reference CutCompleteTraces instance.go:222) ----
 
@@ -167,6 +174,7 @@ class TenantInstance:
         now = time.monotonic()
         cut = 0
         cut_ages: list[float] = []
+        cut_tids: list[bytes] = []
         with self.lock:
             for tid in list(self.live):
                 t = self.live[tid]
@@ -184,7 +192,13 @@ class TenantInstance:
                     if TELEMETRY.enabled:
                         cut_ages.append(now - t.first_push)
                 del self.live[tid]
+                cut_tids.append(tid)
                 cut += 1
+            # same critical section as the head_search appends: the cut
+            # traces leave the hot tier's live stage the instant they
+            # become WAL-head entries — never both, never neither
+            if cut_tids and LIVE_TIER.enabled:
+                LIVE_TIER.mark_cut(self.tenant, cut_tids)
             obs.live_traces.set(len(self.live), tenant=self.tenant)
         for age in cut_ages:  # outside the instance lock — observe locks
             TELEMETRY.record_live_cut(age)
@@ -325,13 +339,37 @@ class TenantInstance:
                 partials.append(obj)
         return partials
 
+    # live entries walked between request-deadline reads on the legacy
+    # matching loop (the StreamingSearchBlock stride twin)
+    _DEADLINE_STRIDE = 256
+
     def search(self, req, results: SearchResults) -> None:
+        from tempo_tpu.robustness import deadline as rdeadline
+
+        if rdeadline.expired():
+            # budget already spent: book partial instead of walking a
+            # potentially huge live set (PR 9 contract)
+            StreamingSearchBlock._book_deadline(results)
+            return
+        # hot tier first: the live stage kernel-scans OUTSIDE the
+        # instance lock (it mirrors self.live via the push/cut hooks).
+        # False = gate off or stage overflow — run the legacy walk.
+        hot_live = False
+        if LIVE_TIER.enabled:
+            hot_live = LIVE_TIER.search(self.tenant, req, results)
         with self.lock:
-            live_sds = [sd for tid, t in self.live.items()
-                        if (sd := t.search_data(tid)) is not None]
+            # the decode (search_data) must stay under the lock — it
+            # drains the raw fragment list, which races with push
+            # otherwise; the MATCHING below runs outside it
+            live_sds = ([] if hot_live else
+                        [sd for tid, t in self.live.items()
+                         if (sd := t.search_data(tid)) is not None])
             searches = [self.head_search] + [c.search for c in self.completing]
             recent = [m for m, _ in self.recent]
-        for sd in live_sds:
+        for i, sd in enumerate(live_sds):
+            if i and i % self._DEADLINE_STRIDE == 0 and rdeadline.expired():
+                StreamingSearchBlock._book_deadline(results)
+                return
             results.metrics.inspected_traces += 1
             if search_data_matches(sd, req):
                 results.add(_meta_from_sd(sd))
@@ -339,9 +377,19 @@ class TenantInstance:
                     return
         for ssb in searches:
             ssb.search(req, results)
-            if results.complete:
+            if results.complete or results.metrics.partial:
                 return
         for meta in recent:  # blocklist-poll gap, as in find()
+            if rdeadline.expired():
+                StreamingSearchBlock._book_deadline(results)
+                return
+            # once the reader's poll made this block visible, its leg of
+            # the answer moved to the blocklist path — skipping it here
+            # is the hot tier's eviction-on-poll contract (no double
+            # scan; dedupe no longer needed for it)
+            if LIVE_TIER.enabled and LIVE_TIER.poll_visible(
+                    self.tenant, meta.block_id):
+                continue
             try:
                 self.db._search_block_for(meta).search(req, results)  # noqa: SLF001
             except Exception:  # noqa: BLE001
@@ -352,13 +400,16 @@ class TenantInstance:
     def search_tags(self) -> set:
         tags = set()
         with self.lock:
-            for tid, t in self.live.items():
-                sd = t.search_data(tid)
-                if sd is not None:
-                    tags.update(sd.kvs)
+            # bounded lock hold: decode + snapshot references only (the
+            # decode drains raw fragment lists, so it cannot leave the
+            # lock); the set union over every entry's kv dict runs
+            # against the snapshot below, not against pushes
+            sds = [sd for tid, t in self.live.items()
+                   if (sd := t.search_data(tid)) is not None]
             for ssb in [self.head_search] + [c.search for c in self.completing]:
-                for sd in ssb.entries():
-                    tags.update(sd.kvs)
+                sds.extend(ssb.entries())
+        for sd in sds:
+            tags.update(sd.kvs)
         for meta in self._recent_tag_blocks():
             # blocklist-poll gap, as in find()/search(): a just-completed
             # block is out of head/completing but not yet in any reader's
@@ -453,6 +504,12 @@ class Ingester:
         inst = self.instance(tenant)
         for tid, seg, sd in zip(req.ids, req.traces, req.search_data):
             inst.push(tid, seg, sd)
+        # standing queries evaluate per push micro-batch, AFTER the acks:
+        # notification latency must never sit on the write path's lock
+        if LIVE_TIER.enabled and LIVE_TIER.has_subscribers(tenant):
+            for tid, sd in zip(req.ids, req.search_data):
+                if sd:
+                    LIVE_TIER.notify_push(tenant, pad_trace_id(tid), sd)
 
     def find_trace_by_id(self, tenant: str, trace_id: bytes) -> list[bytes]:
         with self._lock:

@@ -379,6 +379,38 @@ structural_stack_events = Counter(
     "(search_structural_stack_enabled off) — unstackable plan shapes "
     "are visible here instead of silently flushing solo")
 
+# ---- hot-tier live search (search/live_tier.py) ----
+live_tier_entries = Gauge(
+    "tempo_search_live_tier_entries",
+    "in-flight traces held in the hot tier's per-tenant live stage "
+    "(absorbed at push, evicted at cut)")
+live_tier_scans = Counter(
+    "tempo_search_live_tier_scans_total",
+    "hot-tier live-stage scan outcomes (result=scan: answered by the "
+    "fused kernel; fallback_overflow: stage past "
+    "search_live_tier_max_entries, legacy walk ran; fallback: scan "
+    "declined, legacy walk ran)")
+live_tier_rebuilds = Counter(
+    "tempo_search_live_tier_rebuilds_total",
+    "columnar stage rebuilds (one per absorbed/evicted epoch actually "
+    "searched — consecutive mutations between searches coalesce into "
+    "one rebuild)")
+live_tier_evictions = Counter(
+    "tempo_search_live_tier_evictions_total",
+    "entries leaving the live stage (reason=cut: trace cut to the WAL "
+    "head, where the hot scan still covers it)")
+live_tail_subscriptions = Gauge(
+    "tempo_search_live_tail_subscriptions",
+    "standing tail subscriptions registered per tenant")
+live_tail_notifications = Counter(
+    "tempo_search_live_tail_notifications_total",
+    "tail notifications delivered to standing-query subscribers")
+live_tail_dropped = Counter(
+    "tempo_search_live_tail_dropped_total",
+    "tail notifications/registrations dropped (reason=queue: a slow "
+    "consumer's bounded queue overflowed, oldest dropped; cap: "
+    "subscribe rejected at search_live_tail_max_subscriptions)")
+
 # ---- owner-routed HBM (search/ownership.py) ----
 hbm_owner_generation = Gauge(
     "tempo_search_hbm_owner_generation",

@@ -92,6 +92,21 @@ class SearchData:
                     kvs={k: set(vs) for k, vs in sp.kvs.items()}))
 
 
+def clone_search_data(sd: SearchData) -> SearchData:
+    """Copy-on-write clone for merge-on-append stores (live tier, WAL
+    head): replacing the stored reference with a merged clone keeps
+    published entries immutable, so a reader that snapshotted references
+    under a lock can build/scan OUTSIDE it without torn reads. Span
+    rows are shared (merge appends new rows, never mutates old ones)."""
+    out = SearchData(
+        trace_id=sd.trace_id, start_s=sd.start_s, end_s=sd.end_s,
+        dur_ms=sd.dur_ms, root_service=sd.root_service,
+        root_name=sd.root_name,
+        kvs={k: set(v) for k, v in sd.kvs.items()},
+        spans=list(sd.spans))
+    return out
+
+
 def extract_search_data(trace_id: bytes, trace: tempopb.Trace,
                         max_bytes: int = DEFAULT_MAX_SEARCH_BYTES,
                         range_ns: tuple[int, int] | None = None,

@@ -83,6 +83,13 @@ class SearchResults:
                 pass  # a malformed part never fails a merge
 
     @property
+    def n_results(self) -> int:
+        # deliberately NOT __len__: callers use `results or for_request`
+        # to default a None argument, and a falsy empty collector would
+        # silently swap in a fresh object there
+        return len(self._by_id)
+
+    @property
     def complete(self) -> bool:
         return not self.no_quit and len(self._by_id) >= self.limit
 

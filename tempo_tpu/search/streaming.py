@@ -14,7 +14,12 @@ import os
 
 from tempo_tpu import tempopb
 from tempo_tpu.encoding.v2.objects import marshal_object, unmarshal_objects
-from .data import SearchData, decode_search_data, encode_search_data
+from .data import (
+    SearchData,
+    clone_search_data,
+    decode_search_data,
+    encode_search_data,
+)
 from .pipeline import UINT32_MAX
 from tempo_tpu.utils.ids import pad_trace_id
 
@@ -23,6 +28,11 @@ class StreamingSearchBlock:
     def __init__(self, path: str, _replay: bool = False):
         self.path = path
         self._entries: dict[bytes, SearchData] = {}
+        # epoch versions the entry set for the hot-tier scan cache; the
+        # stage itself builds lazily (first gate-on search) so gate-off
+        # and write-only processes never import the kernel machinery
+        self._epoch = 0
+        self._stage = None
         if _replay:
             self._replay()
             self._fh = open(path, "ab")
@@ -41,7 +51,13 @@ class StreamingSearchBlock:
             sd.trace_id = tid
             self._entries[tid] = sd
         else:
-            cur.merge(sd)
+            # copy-on-write: published entries stay immutable so the
+            # hot-tier scan can build pages from a snapshot of
+            # references without holding the instance lock
+            merged = clone_search_data(cur)
+            merged.merge(sd)
+            self._entries[tid] = merged
+        self._epoch += 1
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -53,15 +69,47 @@ class StreamingSearchBlock:
 
     # ---- host linear scan (live/WAL data volume is small) ----
 
-    def search(self, req: tempopb.SearchRequest, results) -> None:
-        from .data import search_data_matches
+    # entries checked between request-deadline reads on the legacy walk:
+    # cheap enough to bound overrun, coarse enough that the contextvar
+    # read never shows up against per-entry match cost
+    _DEADLINE_STRIDE = 256
 
-        for sd in self._entries.values():
+    def search(self, req: tempopb.SearchRequest, results) -> None:
+        from tempo_tpu.robustness import deadline as rdeadline
+
+        from .data import search_data_matches
+        from .live_tier import LIVE_TIER
+
+        if rdeadline.expired():
+            # the budget is already gone: book partial instead of
+            # walking a potentially huge live set (PR 9 contract — the
+            # batcher's legs already respect this)
+            self._book_deadline(results)
+            return
+        if LIVE_TIER.enabled:
+            from .live_tier import _HotStage, scan_search_data
+
+            if self._stage is None:
+                self._stage = _HotStage()
+            if scan_search_data(self.entries(), req, results,
+                                self._stage, self._epoch):
+                return
+        for i, sd in enumerate(self._entries.values()):
+            if i % self._DEADLINE_STRIDE == 0 and i and rdeadline.expired():
+                self._book_deadline(results)
+                return
             results.metrics.inspected_traces += 1
             if search_data_matches(sd, req):
                 results.add(_meta_from_sd(sd))
                 if results.complete:
                     return
+
+    @staticmethod
+    def _book_deadline(results) -> None:
+        from tempo_tpu.observability import metrics as obs
+
+        results.metrics.partial = True
+        obs.partial_results.inc(reason="deadline")
 
     # ---- lifecycle ----
 

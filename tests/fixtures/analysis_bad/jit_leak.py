@@ -119,6 +119,29 @@ def bucket_clean_kernel(arr, *, bucket):
     return _bucket_fixture(arr, bucket)
 
 
+def _tier_fixture(arr, tier):
+    """Capacity-tier-descriptor-shaped helper (hot-tier rolling stage
+    idiom): selects the capacity-masking arm by branching on its
+    descriptor at trace time, so a tracer reaching `tier` is a
+    trace-time leak."""
+    if tier is not None and tier:
+        return arr[:tier]
+    return arr
+
+
+@functools.partial(jax.jit, static_argnames=("tier",))
+def tier_taint_kernel(arr, sel, *, tier):
+    # VIOLATION: tracer data passed as the capacity-tier descriptor —
+    # the helper picks the masking arm on it at trace time
+    return _tier_fixture(arr, sel[0])
+
+
+@functools.partial(jax.jit, static_argnames=("tier",))
+def tier_clean_kernel(arr, *, tier):
+    # the good twin: the descriptor comes from the static `tier`
+    return _tier_fixture(arr, tier)
+
+
 @functools.partial(jax.jit, static_argnames=("top_k",))
 def clean_kernel(scores, mask, extra=None, *, top_k):
     n = scores.shape[0]            # shape reads are static: fine

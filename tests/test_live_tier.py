@@ -1,0 +1,266 @@
+"""Hot-tier live search (search/live_tier.py): differential identity
+against the flushed-block scan, lifecycle no-dup/no-gap, tail
+subscriptions, deadline/overflow degradation, gate-off noop."""
+
+import time
+
+import pytest
+
+from tempo_tpu import tempopb
+from tempo_tpu.db.tempodb import TempoDBConfig
+from tempo_tpu.modules import App, AppConfig
+from tempo_tpu.search.data import SearchData, encode_search_data
+from tempo_tpu.search.live_tier import LIVE_TIER, TailSubscription
+from tempo_tpu.search.results import SearchResults
+from tempo_tpu.utils.test_data import make_trace
+
+
+@pytest.fixture(autouse=True)
+def _reset_live_tier():
+    # LIVE_TIER is the process-wide singleton (most recent TempoDB's
+    # config wins); leave it disabled for whatever test runs next
+    yield
+    LIVE_TIER.configure(enabled=False)
+
+
+def _db(**kw):
+    kw.setdefault("auto_mesh", False)
+    kw.setdefault("search_live_tier_enabled", True)
+    return TempoDBConfig(**kw)
+
+
+def _req(tags=None, limit=50, **kw):
+    req = tempopb.SearchRequest()
+    for k, v in (tags or {}).items():
+        req.tags[k] = v
+    req.limit = limit
+    for k, v in kw.items():
+        setattr(req, k, v)
+    return req
+
+
+def _tid(i: int) -> bytes:
+    return bytes([i]) * 16
+
+
+def _traces(resp) -> list[bytes]:
+    return [m.SerializeToString() for m in resp.traces]
+
+
+_QUERIES = (
+    {"component": "db"},
+    {"service.name": "frontend"},
+    {"http.status_code": "500"},
+    {"component": "db", "service.name": "checkout"},
+    {"nonexistent": "zz"},
+)
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_hot_scan_byte_identical_to_flushed_scan(tmp_path, packed):
+    """The tentpole identity: searching in-flight traces through the
+    hot tier returns byte-identical trace metadata to searching the
+    same data after flush+poll through the backend kernel."""
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal"),
+                        db=_db(search_packed_residency=packed)))
+    for i in range(24):
+        app.push("t1", list(make_trace(_tid(i), seed=i).batches))
+    reqs = [_req(q) for q in _QUERIES] + [
+        _req({"component": "db"}, min_duration_ms=5),
+        _req({}, max_duration_ms=900),
+    ]
+    hot = [app.search("t1", r) for r in reqs]
+    assert any(r.traces for r in hot)  # the corpus matches something
+    app.flush_tick(force=True)
+    app.poll_tick()
+    flushed = [app.search("t1", r) for r in reqs]
+    for h, f in zip(hot, flushed):
+        assert _traces(h) == _traces(f)
+    app.shutdown()
+
+
+def test_gate_off_noop_identity(tmp_path):
+    """search_live_tier_enabled=false answers byte-identically to the
+    gate-on tier over the same pushed data (the legacy per-entry walk
+    is the reference)."""
+
+    def run(db_cfg, sub):
+        app = App(AppConfig(wal_dir=str(tmp_path / sub), db=db_cfg))
+        for i in range(16):
+            app.push("t1", list(make_trace(_tid(i), seed=i).batches))
+        out = [_traces(app.search("t1", _req(q))) for q in _QUERIES]
+        app.shutdown()
+        return out
+
+    off = run(TempoDBConfig(auto_mesh=False), "off")
+    on = run(_db(), "on")
+    assert on == off
+
+
+def test_no_dup_no_gap_across_flush_and_poll(tmp_path):
+    """One trace answers EXACTLY once at every lifecycle stage: live
+    (hot stage), cut+flushed (recently-flushed leg), and poll-visible
+    (reader leg; the recent leg retires via mark_poll_visible)."""
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal"), db=_db()))
+    tid = _tid(7)
+    app.push("t1", list(make_trace(tid, seed=3).batches))
+    req = _req({})  # matches everything pushed
+
+    def hits():
+        return [m.trace_id for m in app.search("t1", req).traces
+                ].count(tid.hex())
+
+    assert hits() == 1                  # live: hot-tier scan
+    app.flush_tick(force=True)
+    assert hits() == 1                  # flushed, not yet poll-visible
+    app.poll_tick()
+    assert hits() == 1                  # reader leg; recent leg retired
+    # the hot stage evicted the cut trace — its live set is empty now
+    assert not LIVE_TIER._tenants["t1"].entries
+    app.shutdown()
+
+
+def test_structural_hot_scan_matches_flushed(tmp_path):
+    """Structural predicates go through the compiled plan on the hot
+    stage exactly as on backend blocks — same answer pre- and
+    post-flush."""
+    app = App(AppConfig(
+        wal_dir=str(tmp_path / "wal"),
+        db=_db(search_structural_enabled=True)))
+    tid = b"\x01" * 16
+    tr = tempopb.Trace()
+    rs = tr.batches.add()
+    kv = rs.resource.attributes.add()
+    kv.key = "service.name"
+    kv.value.string_value = "api"
+    ss = rs.scope_spans.add()
+    root = ss.spans.add()
+    root.trace_id = tid
+    root.span_id = b"\x0a" * 8
+    root.name = "root-op"
+    root.kind = 2
+    root.start_time_unix_nano = 1_600_000_000_000_000_000
+    root.end_time_unix_nano = root.start_time_unix_nano + 500_000_000
+    child = ss.spans.add()
+    child.trace_id = tid
+    child.span_id = b"\x0b" * 8
+    child.parent_span_id = root.span_id
+    child.name = "child-op"
+    child.kind = 3
+    child.start_time_unix_nano = root.start_time_unix_nano
+    child.end_time_unix_nano = child.start_time_unix_nano + 400_000_000
+    app.push("t1", [rs])
+    app.push("t1", list(make_trace(_tid(2), seed=5).batches))
+
+    from tempo_tpu.search.structural import STRUCTURAL_QUERY_TAG
+    q = ('{"child": {"parent": {"tag": {"k": "service.name", '
+         '"v": "api"}}, "child": {"dur": {"min_ms": 300}}}}')
+    req = _req({STRUCTURAL_QUERY_TAG: q}, limit=10)
+    hot = app.search("t1", req)
+    assert [m.trace_id for m in hot.traces] == [tid.hex()]
+    app.flush_tick(force=True)
+    app.poll_tick()
+    flushed = app.search("t1", req)
+    assert _traces(hot) == _traces(flushed)
+    app.shutdown()
+
+
+def test_tail_subscription_delivery_cap_and_release(tmp_path):
+    app = App(AppConfig(
+        wal_dir=str(tmp_path / "wal"),
+        db=_db(search_live_tail_max_subscriptions=2)))
+    sub = app.tail_subscribe("t1", _req({}))
+    assert sub is not None
+    tid = _tid(9)
+    app.push("t1", list(make_trace(tid, seed=1).batches))
+    metas = sub.poll(timeout_s=5.0)
+    assert [m.trace_id for m in metas] == [tid.hex()]
+    # a non-matching standing query stays silent
+    quiet = app.tail_subscribe("t1", _req({"nonexistent": "zz"}))
+    app.push("t1", list(make_trace(_tid(10), seed=2).batches))
+    assert sub.poll(timeout_s=5.0)
+    assert quiet.poll(timeout_s=0.05) == []
+    # per-tenant cap: third registration rejected, released slot reusable
+    assert app.tail_subscribe("t1", _req({})) is None
+    app.tail_unsubscribe(quiet)
+    again = app.tail_subscribe("t1", _req({}))
+    assert again is not None
+    app.shutdown()
+
+
+def test_tail_subscribe_none_when_gate_off(tmp_path):
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal"),
+                        db=TempoDBConfig(auto_mesh=False)))
+    assert app.tail_subscribe("t1", _req({})) is None
+    app.shutdown()
+
+
+def test_tail_queue_drops_oldest():
+    sub = TailSubscription("t", _req({}), max_queue=2)
+    for i in range(3):
+        m = tempopb.TraceSearchMetadata()
+        m.trace_id = _tid(i).hex()
+        sub.offer(m)
+    assert sub.dropped == 1
+    got = [m.trace_id for m in sub.poll(timeout_s=0.0)]
+    assert got == [_tid(1).hex(), _tid(2).hex()]  # oldest lost
+
+
+def test_overflow_falls_back_to_walk():
+    LIVE_TIER.configure(enabled=True, max_entries=2)
+    for i in range(3):
+        sd = SearchData(trace_id=_tid(i))
+        sd.start_s = 1_600_000_000
+        sd.end_s = sd.start_s + 1
+        sd.dur_ms = 5
+        sd.kvs = {"component": {"db"}}
+        LIVE_TIER.absorb("t", _tid(i), encode_search_data(sd))
+    results = SearchResults()
+    # past max_entries: the tier declines and the caller runs the walk
+    assert LIVE_TIER.search("t", _req({}), results) is False
+    assert results.n_results == 0
+
+
+def test_streaming_block_deadline_books_partial(tmp_path):
+    from tempo_tpu.robustness import deadline as rdeadline
+    from tempo_tpu.search.streaming import StreamingSearchBlock
+
+    ssb = StreamingSearchBlock(str(tmp_path / "w.search"))
+    sd = SearchData(trace_id=_tid(1))
+    sd.start_s = 1_600_000_000
+    sd.end_s = sd.start_s + 1
+    sd.dur_ms = 5
+    sd.kvs = {"component": {"db"}}
+    ssb.append(_tid(1), sd)
+    results = SearchResults()
+    with rdeadline.start(0.001):
+        time.sleep(0.01)
+        ssb.search(_req({}), results)
+    assert results.metrics.partial
+    assert results.n_results == 0
+    ssb.clear()
+
+
+def test_progressive_stream_hot_first_then_done(tmp_path):
+    """/api/search/stream: result frames arrive as legs land (hot tier
+    first), the done frame equals the blocking /api/search answer."""
+    import json as _json
+
+    from tempo_tpu.api.http import HTTPApi
+
+    app = App(AppConfig(wal_dir=str(tmp_path / "wal"), db=_db()))
+    api = HTTPApi(app)
+    hdr = {"X-Scope-OrgID": "t1"}
+    tid = _tid(4)
+    app.push("t1", list(make_trace(tid, seed=4).batches))
+    code, body = api.handle("GET", "/api/search/stream",
+                            {"limit": "10"}, hdr)
+    assert code == 200
+    frames = list(body.events)
+    kinds = [f.split("\n", 1)[0] for f in frames]
+    assert kinds[0] == "event: result" and kinds[-1] == "event: done"
+    done = _json.loads(frames[-1].split("data: ", 1)[1])
+    code, blocking = api.handle("GET", "/api/search", {"limit": "10"}, hdr)
+    assert code == 200
+    assert done["traces"] == blocking["traces"]
+    app.shutdown()

@@ -253,6 +253,41 @@ def test_jit_purity_flags_tainted_bucket_descriptor(bad_pkg):
         [f.message for f in findings]
 
 
+def test_jit_purity_flags_tainted_tier_descriptor(bad_pkg):
+    """The hot-tier page-capacity descriptor is a descriptor like
+    widths/plan/span_sharded: tracer data reaching a tier-dispatching
+    helper is flagged; the static twin stays silent."""
+    findings = JitPurityChecker().check(bad_pkg)
+    taint = [f for f in findings if f.key.startswith("descriptor-taint:")
+             and "tier_taint_kernel" in f.key]
+    assert taint and "'tier'" in taint[0].message, \
+        [f.message for f in findings]
+    assert not [f for f in findings
+                if "tier_clean_kernel" in f.key], \
+        [f.message for f in findings]
+
+
+def test_contract_live_tier_gates_registered():
+    """The hot-tier gate is pinned by BOTH registries: every LiveTier
+    hook tests `enabled` first (GatedFunction) and the ingest/search
+    call sites are dominated by the gate read (GuardedCall) — the
+    checker run over the real package enforces them; this test pins
+    that the entries exist so a refactor cannot silently drop the
+    noop contract."""
+    from tempo_tpu.analysis.contracts import (GATED_FUNCTIONS,
+                                              GUARDED_CALLS)
+
+    gated = {(g.qualname, g.knob) for g in GATED_FUNCTIONS}
+    for hook in ("absorb", "mark_cut", "mark_poll_visible",
+                 "poll_visible", "search", "subscribe", "unsubscribe",
+                 "has_subscribers", "notify_push"):
+        assert (f"LiveTier.{hook}", "search_live_tier_enabled") in gated
+    guarded = {(m, g.knob) for g in GUARDED_CALLS for m in g.methods}
+    for m in ("absorb", "mark_cut", "search", "mark_poll_visible",
+              "subscribe", "unsubscribe", "notify_push"):
+        assert (m, "search_live_tier_enabled") in guarded
+
+
 def test_contract_new_structural_gates_registered():
     """The stacking and sharding gates are pinned by BOTH registries:
     the gate functions test their attribute first (GatedFunction) and
