@@ -1980,6 +1980,161 @@ def phase_ownership():
             f"independent caches {off['restage_bytes']} — the placement "
             "split saved nothing")
         assert on["hbm_hit_ratio"] >= off["hbm_hit_ratio"]
+
+        # ---- hot-skew leg (ISSUE 18): heat-adaptive replication +
+        # hedged dispatch vs plain rf=1 under an injected slow primary.
+        # A zipf-ish stream sends ~80% of dispatches at ONE hot group
+        # and ~20% at an alternate group with the same owner; the
+        # primary's budget is 0.55x that two-group working set, so the
+        # alternate traffic keeps thrashing the hot group out of HBM
+        # and every hot re-stage pays the armed `h2d_delay`. With rf=2
+        # the hot group heat-promotes, every hot dispatch hedges to the
+        # replica host (full budget, hot-resident) after a fixed 25 ms
+        # delay, and the hot-group p99 collapses from ~h2d_delay to
+        # ~hedge delay — while every response stays byte-identical and
+        # the replica stages ONLY promoted groups (duplicate-stage
+        # bytes strictly bounded, residency accounting conserved).
+        from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+        from tempo_tpu.modules.querier import Querier
+        from tempo_tpu.modules.ring import Ring
+        from tempo_tpu.robustness import FAULTS
+
+        n_samples = int(os.environ.get("BENCH_HEDGE_SAMPLES", 150))
+        slow_s = float(os.environ.get("BENCH_HEDGE_H2D_DELAY_S", 0.12))
+        hedge_ms = 25.0
+        block_bytes = hot_set_bytes / n_blocks
+        skew_budget = max(1, int(2 * block_bytes * budget_frac))
+
+        def p99(xs):
+            return sorted(xs)[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+        class _HostQuerier:
+            """Serve AS one fleet member: identity is context-scoped
+            (ownership.self_as), so concurrent hedged attempts on their
+            daemon threads each see their own host, race-free."""
+
+            def __init__(self, db, member):
+                self.db = db
+                self.member = member
+                self.inner = Querier(db, Ring(), {})
+
+            def search_blocks(self, breq):
+                with ownership.self_as(self.member):
+                    return self.inner.search_blocks(breq)
+
+        def mk_breq(template):
+            breq = tempopb.SearchBlocksRequest()
+            breq.CopyFrom(template)
+            breq.search_req.CopyFrom(req)
+            breq.tenant_id = "bench"
+            return breq
+
+        def hedge_leg(tag, rf):
+            db0 = mkdb(f"skew-{tag}-h0", skew_budget)  # primary: thrashes
+            db1 = mkdb(f"skew-{tag}-h1", 64 << 30)     # replica: resident
+            fe = QueryFrontend(
+                [_HostQuerier(db0, "h0"), _HostQuerier(db1, "h1")],
+                FrontendConfig(retries=3, target_bytes_per_job=1 << 30,
+                               batch_jobs_per_request=1))
+            # configure AFTER mkdb: TempoDB.__init__ applies its own
+            # (disabled) ownership config
+            ownership.configure(
+                enabled=True, members="h0,h1", self_id="h0", groups=32,
+                rf=rf, hot_rate=0.5, hedge_delay_ms=hedge_ms)
+            by_block = {}
+            for payload, template, owner, width in fe._search_batches("bench"):
+                by_block[payload[0][0].block_id] = (
+                    payload, template, owner, width)
+            h0_blocks = [m.block_id for m in metas
+                         if ownership.OWNERSHIP.owner_of(m.block_id) == "h0"]
+            hot = h0_blocks[0]
+            alt = next(b for b in h0_blocks[1:]
+                       if (ownership.OWNERSHIP.group_of(b)
+                           != ownership.OWNERSHIP.group_of(hot)))
+
+            def dispatch(block_id):
+                payload, template, owner, width = by_block[block_id]
+                breq = mk_breq(template)
+                t0 = time.perf_counter()
+                r = fe._dispatch_batch(breq, owner, width, block_id)
+                return time.perf_counter() - t0, canon(r)
+
+            up0 = obs.hbm_replica_promotions.value(dir="up")
+            hw0 = obs.hedged_dispatches.value(result="hedge_won")
+            if rf > 1:
+                # promote the hot group up front (the serving loop's
+                # record_access gets there too — this pins the promoted
+                # state for the whole measured stream) and pre-stage
+                # the replica un-faulted so the first hedge never races
+                # a cold staging put
+                for _ in range(60):
+                    ownership.OWNERSHIP.record_access(hot)
+                assert ownership.OWNERSHIP.replica_indices(hot), \
+                    "hot group failed to heat-promote"
+                fe.queriers[1].search_blocks(mk_breq(by_block[hot][1]))
+            # warm-up un-faulted: primary residency + kernel compile
+            dispatch(hot)
+            dispatch(alt)
+            dispatch(hot)
+
+            walls_hot, outs = [], []
+            with FAULTS.armed("h2d_delay", delay_s=slow_s, count=10**6):
+                for i in range(n_samples):
+                    blk = alt if i % 5 == 4 else hot
+                    w, out = dispatch(blk)
+                    outs.append(out)
+                    if blk is hot:
+                        walls_hot.append(w)
+            # residency accounting conserved on BOTH hosts: no negative
+            # bytes, cache total == sum of its entries
+            for db in (db0, db1):
+                ent = sum(e.nbytes for e in db.batcher._cache.values())
+                assert db.batcher._cache_total == ent >= 0, (
+                    f"{tag}: cache accounting drifted "
+                    f"({db.batcher._cache_total} != {ent})")
+            stats = {
+                "rf": rf,
+                "hot_dispatches": len(walls_hot),
+                "p50_s": round(sorted(walls_hot)[len(walls_hot) // 2], 4),
+                "p99_s": round(p99(walls_hot), 4),
+                "replica_staged_bytes": int(db1.batcher._cache_total),
+                "promotions_up": int(
+                    obs.hbm_replica_promotions.value(dir="up") - up0),
+                "hedge_won": int(
+                    obs.hedged_dispatches.value(result="hedge_won") - hw0),
+            }
+            ownership.OWNERSHIP.reset()
+            return outs, stats
+
+        rf1_outs, rf1 = hedge_leg("rf1", rf=1)
+        rf2_outs, rf2 = hedge_leg("rf2", rf=2)
+        assert rf1_outs == rf2_outs, (
+            "hedged rf=2 responses diverged from rf=1")
+        assert rf2["p99_s"] < rf1["p99_s"], (
+            f"hedged rf=2 hot-group p99 {rf2['p99_s']}s did not beat "
+            f"rf=1 {rf1['p99_s']}s under a {slow_s}s slow primary")
+        # rf=1 never touches the second host; rf=2 replicates ONLY the
+        # promoted group(s) — hot plus at most the alternate if its
+        # in-stream rate crossed the threshold — never the whole
+        # blocklist (24 blocks) the primary carries
+        assert rf1["replica_staged_bytes"] == 0, (
+            "rf=1 leg staged bytes on the non-owner host")
+        assert rf2["replica_staged_bytes"] <= 2.5 * block_bytes, (
+            f"replica staged {rf2['replica_staged_bytes']} bytes — more "
+            f"than the promoted groups (block ~{int(block_bytes)} bytes)")
+        assert rf2["hedge_won"] >= 1, "no hedge ever won against the slow primary"
+        assert rf2["promotions_up"] >= 1 and rf1["promotions_up"] == 0
+        hot_skew = {
+            "samples": n_samples,
+            "h2d_delay_s": slow_s,
+            "hedge_delay_ms": hedge_ms,
+            "skew_budget_bytes": int(skew_budget),
+            "byte_identical": rf1_outs == rf2_outs,
+            "rf1": rf1,
+            "rf2": rf2,
+            "p99_speedup": round(rf1["p99_s"] / max(rf2["p99_s"], 1e-9), 2),
+        }
+
         return {
             "blocks": n_blocks,
             "rounds": rounds,
@@ -1993,6 +2148,7 @@ def phase_ownership():
             "owner_routed": int(obs.hbm_owner_routed.value(route="owner")),
             "non_owner_host_routed": int(
                 obs.hbm_owner_routed.value(route="non_owner_host")),
+            "hot_skew": hot_skew,
         }
 
 
@@ -2841,7 +2997,7 @@ PHASE_TIMEOUTS = {
     "query_stats_overhead": 300.0,
     "freshness": 560.0,  # baseline leg + hot-tier gate-on leg + tail
     "chaos": 420.0,
-    "ownership": 420.0,
+    "ownership": 540.0,
     "packing": 420.0,
     "structural": 600.0,
     "scale_10k": 900.0,

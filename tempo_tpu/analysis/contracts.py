@@ -94,6 +94,34 @@ GATED_FUNCTIONS = (
     GatedFunction("tempo_tpu.search.ownership",
                   "OwnershipMap.owner_index", ("enabled",),
                   "search_hbm_ownership_enabled"),
+    # heat-adaptive replication: with rf <= 1 the heat table never
+    # records (no clock read, no lock), replica lookups return empty
+    # after one attribute read, and the demotion sweep is a no-op —
+    # rf=1 placement stays bit for bit the single-owner behavior
+    GatedFunction("tempo_tpu.search.ownership",
+                  "OwnershipMap.record_access", ("replicated",),
+                  "search_hbm_ownership_hot_rate"),
+    GatedFunction("tempo_tpu.search.ownership",
+                  "OwnershipMap.replica_indices", ("replicated",),
+                  "search_hbm_ownership_rf"),
+    GatedFunction("tempo_tpu.search.ownership",
+                  "OwnershipMap.replicas_of", ("replicated",),
+                  "search_hbm_ownership_rf"),
+    GatedFunction("tempo_tpu.search.ownership",
+                  "OwnershipMap.sweep", ("replicated",),
+                  "search_hbm_ownership_hot_rate"),
+    GatedFunction("tempo_tpu.search.ownership",
+                  "OwnershipMap.is_replica", ("enabled",),
+                  "search_hbm_ownership_enabled"),
+    # hedged dispatch: the disarmed timer (rf <= 1) must not read a
+    # clock, take its lock, or update the Jacobson/Karels estimate —
+    # one attribute read per call site
+    GatedFunction("tempo_tpu.search.ownership", "HedgeTimer.observe",
+                  ("armed",), "search_hbm_ownership_rf"),
+    GatedFunction("tempo_tpu.search.ownership", "HedgeTimer.delay_s",
+                  ("armed",), "search_hedge_delay_ms"),
+    GatedFunction("tempo_tpu.search.ownership", "HedgeTimer._on_stage",
+                  ("armed",), "search_hbm_ownership_rf"),
     # packed HBM residency: width planning and mask packing are the
     # gate functions — disabled staging pays one attribute read and
     # keeps the byte-identical legacy layout
@@ -167,9 +195,17 @@ GUARDED_CALLS = (
     GuardedCall("coalescer", ("submit",), (), "coalescer", "coalescer",
                 "search_coalesce_max_queries"),
     # hot-path ownership lookups must be dominated by the one-attribute
-    # gate read — the disabled serving path never enters the map
-    GuardedCall("OWNERSHIP", ("owns_group",), (), "enabled", "OWNERSHIP",
-                "search_hbm_ownership_enabled"),
+    # gate read — the disabled serving path never enters the map (the
+    # heat-table feed rides the same gate: record_access additionally
+    # self-gates on `replicated`, so rf=1 deployments pay one read)
+    GuardedCall("OWNERSHIP", ("owns_group", "record_access"), (),
+                "enabled", "OWNERSHIP", "search_hbm_ownership_enabled"),
+    # hedge-timer touches (the delay derivation reads a lock +
+    # estimator state, observe() reads the clock's output) only behind
+    # the armed flag: with search_hbm_ownership_rf <= 1 no call site
+    # may reach the timer — no clock read, no lock, no thread spawn
+    GuardedCall("HEDGE", ("observe", "delay_s"), (), "armed", "HEDGE",
+                "search_hbm_ownership_rf"),
     # staging-site packing calls likewise: the disabled path must not
     # even compute the width-planner inputs (duration rollup maxes)
     GuardedCall("PACKING", ("plan_widths", "pack_hits"), (), "enabled",

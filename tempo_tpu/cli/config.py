@@ -170,6 +170,17 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "search_hbm_ownership_self", ""),
         search_hbm_ownership_groups=storage.get(
             "search_hbm_ownership_groups", 64),
+        # heat-adaptive replication + hedged dispatch
+        # (docs/search-hbm-ownership.md#replication-heat-and-hedged-
+        # dispatch): rf=1 (default) keeps single-owner placement bit
+        # for bit — heat table, replica lookups and hedge timer are
+        # each one attribute read
+        search_hbm_ownership_rf=storage.get(
+            "search_hbm_ownership_rf", 1),
+        search_hbm_ownership_hot_rate=storage.get(
+            "search_hbm_ownership_hot_rate", 50.0),
+        search_hedge_delay_ms=storage.get(
+            "search_hedge_delay_ms", 0.0),
         # robustness (docs/robustness.md): device dispatch watchdog,
         # collective-lock bound, request deadlines, circuit breaker,
         # fault-injection arming. Breaker off + faults disarmed is a

@@ -123,6 +123,24 @@ class TempoDBConfig:
     # rebalance unit): more groups = finer rebalance granularity at a
     # larger /debug/ownership map
     search_hbm_ownership_groups: int = 64
+    # heat-adaptive replication factor: > 1 promotes a placement group
+    # whose access rate crosses the hot-rate threshold to the first rf
+    # distinct members the ownership ring yields for its token —
+    # replicas serve it device-resident and the frontend hedges their
+    # dispatches. 1 (default) keeps single-owner placement bit for bit:
+    # the heat table, replica lookups and the hedge timer are each one
+    # attribute read.
+    search_hbm_ownership_rf: int = 1
+    # per-group access rate (scans/second, EWMA over a 30 s window)
+    # that promotes a group to its replica set; demotion is hysteretic
+    # at half this rate. Only meaningful with rf > 1.
+    search_hbm_ownership_hot_rate: float = 50.0
+    # hedge delay for replicated dispatch, in milliseconds: how long
+    # the frontend waits on a promoted group's primary before firing
+    # the same batch at the next replica. 0 (default) auto-derives a
+    # p99-ish bound from observed dispatch walls (mean + 3*dev, seeded
+    # by the dispatch profiler's stage EWMAs).
+    search_hedge_delay_ms: float = 0.0
     # structural query engine (search/ir.py + search/structural.py,
     # docs/search-structural-queries.md): a typed query IR — span-level
     # predicates, AND/OR/NOT, parent-child / descendant relations,
@@ -423,7 +441,14 @@ class TempoDB:
             enabled=self.cfg.search_hbm_ownership_enabled,
             members=self.cfg.search_hbm_ownership_members or None,
             self_id=self.cfg.search_hbm_ownership_self or None,
-            groups=self.cfg.search_hbm_ownership_groups)
+            groups=self.cfg.search_hbm_ownership_groups,
+            rf=self.cfg.search_hbm_ownership_rf,
+            hot_rate=self.cfg.search_hbm_ownership_hot_rate,
+            hedge_delay_ms=self.cfg.search_hedge_delay_ms)
+        # heat promotions/demotions pre-stage or release residency
+        # through THIS db's batcher (most recent TempoDB wins — the
+        # REGISTRY idiom every process-wide layer above follows)
+        _ownership.OWNERSHIP.set_change_hook(self._ownership_heat_change)
         if (self.cfg.search_offload_planner_enabled
                 and not self.cfg.search_profiling_enabled):
             # the planner's device-side feed (device-probe rate, compile/
@@ -713,6 +738,39 @@ class TempoDB:
             threading.Thread(target=_prestage, name="ownership-prestage",
                              daemon=True).start()
         return out
+
+    def _ownership_heat_change(self, group: int, direction: str,
+                               replicas) -> None:
+        """Heat-table promotion/demotion hook (runs on the ownership
+        map's background thread, never a serving thread). A DEMOTION
+        releases replica residency through the ordinary rebalance walk
+        — owns_group stopped answering true for the dropped replica, so
+        the deferred-evict path applies unchanged. A PROMOTION on a
+        NEW replica (this member, not the primary) pre-stages the
+        group's batches from the cached job plans so the frontend's
+        hedged dispatch never races a cold stage — the hedge delay is
+        p99-derived, and a cold H2D on the hedge path would lose every
+        race it was meant to win."""
+        from tempo_tpu.search.ownership import OWNERSHIP
+
+        if direction == "down":
+            self.batcher.rebalance_ownership()
+            return
+        me = OWNERSHIP.self_id
+        reps = tuple(replicas or ())
+        if not reps or me not in reps or reps[0] == me:
+            return  # not a replica here, or already the serving primary
+        gen = OWNERSHIP.generation
+        with self._search_lock:
+            cached = list(self._jobs_cache.values())
+        for hit in cached:
+            if OWNERSHIP.generation != gen:
+                return  # a rebalance superseded this promotion
+            groups = self.batcher.plan(list(hit[1]))
+            mine = [g for g in groups
+                    if OWNERSHIP.group_of(str(g[0].key[0])) == group]
+            if mine:
+                self.batcher.prewarm(mine, warm_compile=False)
 
     @staticmethod
     def _include_block(m: BlockMeta, block_start: str, block_end: str,

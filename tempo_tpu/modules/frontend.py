@@ -18,6 +18,7 @@ queued sub-requests gets the whole request rejected with TooManyRequests
 
 from __future__ import annotations
 
+import time as _time
 import uuid
 from dataclasses import dataclass
 
@@ -26,9 +27,15 @@ from tempo_tpu.model.codec import codec_for, CURRENT_ENCODING
 from tempo_tpu.model.combine import combine_trace_protos
 from tempo_tpu.observability import tracing
 from tempo_tpu.search import SearchResults
-from tempo_tpu.search.ownership import OWNERSHIP
+from tempo_tpu.search.ownership import HEDGE, OWNERSHIP
 
 from .queue import QueueWorkerPool
+
+# per-attempt deadline a hedged attempt runs under when the REQUEST has
+# no deadline of its own: without one, expiring the losing attempt
+# (d.t_end = 0) would have nothing to expire and the loser would hold
+# its worker slot forever against a wedged querier
+_HEDGE_CANCEL_CAP_S = 600.0
 
 
 @dataclass
@@ -125,17 +132,42 @@ class QueryFrontend:
         self._rr += 1
         return q
 
-    def _owner_querier(self, owner: int | None, attempt: int):
+    def _owner_querier(self, owner: int | None, attempt: int,
+                       width: int | None = None,
+                       replicas: tuple[int, ...] = ()):
         """Owner-routed dispatch (docs/search-hbm-ownership.md): the
         FIRST attempt of a block batch goes to its placement group's
         owner — the one process holding the group HBM-resident, where
         concurrent tenants' dashboards coalesce into fused dispatches.
-        Retries (owner death, a wedged owner timing out) fall back to
-        the round-robin pool, where any non-owner answers through the
-        byte-identical host route instead of failing the query."""
-        if owner is None or attempt > 0 or not self.queriers:
+        Retries prefer the group's SURVIVING REPLICAS (heat-promoted
+        groups carry ``replicas``, member indices primary-first — a
+        replica holds the group device-resident, so the retry stays on
+        the fast path) before falling back to the round-robin pool,
+        where any non-owner answers through the byte-identical host
+        route instead of failing the query.
+
+        ``width`` is the PLAN-TIME pool width the batch's owner index
+        was computed against (it rides the memoized batch plan, which
+        is keyed on the ownership generation): indexing the live pool
+        with ``owner % len(queriers)`` silently remapped EVERY owner
+        whenever the pool resized mid-flight. A grown pool keeps the
+        plan-time mapping; an index past the live pool (a shrink)
+        degrades to round-robin instead of landing on an arbitrary
+        wrong owner."""
+        if owner is None or not self.queriers:
             return self._querier()
-        return self.queriers[owner % len(self.queriers)]
+        n = len(self.queriers)
+        w = width or n
+        if 0 < attempt < len(replicas):
+            idx = replicas[attempt] % w
+            if idx < n:
+                return self.queriers[idx]
+            return self._querier()
+        if attempt == 0:
+            idx = owner % w
+            if idx < n:
+                return self.queriers[idx]
+        return self._querier()
 
     def _retrying(self, fn, job):
         from tempo_tpu.robustness import DeadlineExceeded, deadline
@@ -151,6 +183,128 @@ class QueryFrontend:
                 if deadline.expired():
                     break  # don't burn retries against a dead deadline
         raise last
+
+    def _dispatch_batch(self, breq, owner: int | None,
+                        width: int | None, anchor: str, job=None):
+        """Send one batched SearchBlocksRequest with owner routing,
+        replica-preferring retries, and — for a heat-PROMOTED group —
+        hedged dispatch: the first attempt races the primary against
+        its next replica after the hedge delay, first answer wins.
+        Un-promoted groups (``replica_indices`` returns empty, one
+        attribute read when replication is off) keep the exact rf=1
+        dispatch: attempt 0 to the owner, retries round-robin."""
+        replicas: tuple[int, ...] = ()
+        if OWNERSHIP.enabled:
+            replicas = OWNERSHIP.replica_indices(anchor)
+        attempts = [0]
+
+        def _send(_j):
+            a = attempts[0]
+            attempts[0] += 1
+            q = self._owner_querier(owner, a, width, replicas)
+            if a == 0 and len(replicas) > 1 and HEDGE.armed:
+                hq = self._owner_querier(owner, 1, width, replicas)
+                if hq is not q:
+                    return self._hedged_send(breq, q, hq)
+            if HEDGE.armed:
+                # un-hedged walls feed the hedge-delay estimator too —
+                # they are exactly the "healthy answer" distribution
+                # the p99 bound is derived from
+                t0 = _time.monotonic()
+                r = q.search_blocks(breq)
+                HEDGE.observe(_time.monotonic() - t0)
+                return r
+            return q.search_blocks(breq)
+
+        return self._retrying(_send, job)
+
+    def _hedged_send(self, breq, primary, hedge):
+        """Race ``primary`` against ``hedge`` for one batch: dispatch
+        to the primary, wait out the hedge delay, fire the identical
+        request at the replica if the primary hasn't answered, return
+        the FIRST success and cancel the loser by force-expiring its
+        per-attempt deadline (the batcher checks the deadline between
+        groups, so the loser stops at the next group boundary instead
+        of burning device time on an answer nobody wants).
+
+        Both attempts run on daemon threads under
+        ``contextvars.copy_context()`` — the tenant/query-stats
+        ``fronted()`` mark and the caller's deadline must reach the
+        in-process querier exactly as an un-hedged call's would, and
+        the per-attempt ``deadline.start`` override scopes to the copy.
+        A primary FAILURE inside the hedge delay raises immediately so
+        ``_retrying`` moves straight to the surviving replica."""
+        import contextvars
+        import queue as _qmod
+        import threading
+
+        from tempo_tpu.observability import metrics as obs
+        from tempo_tpu.robustness import DeadlineExceeded, deadline as _dl
+
+        delay = _HEDGE_CANCEL_CAP_S
+        if HEDGE.armed:
+            delay = HEDGE.delay_s()
+        budget = _dl.remaining()
+        cap = budget if budget is not None else _HEDGE_CANCEL_CAP_S
+        results: "_qmod.Queue" = _qmod.Queue()
+        dls: dict = {}
+
+        def _attempt(q, tag):
+            try:
+                with _dl.start(cap) as d:
+                    dls[tag] = d
+                    t0 = _time.monotonic()
+                    r = q.search_blocks(breq)
+                    results.put((tag, True, r, _time.monotonic() - t0))
+            except BaseException as e:  # noqa: BLE001 — raced, loser surfaced
+                results.put((tag, False, e, 0.0))
+
+        def _launch(q, tag):
+            ctx = contextvars.copy_context()
+            threading.Thread(target=ctx.run, args=(_attempt, q, tag),
+                             name="hedge-%s" % tag, daemon=True).start()
+
+        def _win(tag, val, wall, pending):
+            obs.hedged_dispatches.inc(
+                result="primary" if tag == "primary" else "hedge_won")
+            if HEDGE.armed:
+                HEDGE.observe(wall)
+            for loser in pending:
+                d = dls.get(loser)
+                if d is not None:
+                    # force-expire the loser's per-attempt deadline:
+                    # deadline.expired() answers True from here on, so
+                    # the in-flight attempt stops at its next check
+                    d.t_end = 0.0
+                obs.hedged_dispatches.inc(result="cancelled")
+            return val
+
+        _launch(primary, "primary")
+        try:
+            tag, ok, val, wall = results.get(timeout=delay)
+        except _qmod.Empty:
+            tag = None
+        if tag is not None:
+            if ok:
+                return _win(tag, val, wall, ())
+            raise val  # fast primary failure: retry goes to the replica
+        _launch(hedge, "hedge")
+        pending = {"primary", "hedge"}
+        failures = []
+        while pending:
+            rem = _dl.remaining()
+            try:
+                tag, ok, val, wall = results.get(
+                    timeout=_HEDGE_CANCEL_CAP_S if rem is None
+                    else max(0.0, rem))
+            except _qmod.Empty:
+                raise DeadlineExceeded(
+                    "hedged dispatch exhausted the request deadline")
+            pending.discard(tag)
+            if ok:
+                return _win(tag, val, wall, pending)
+            failures.append(val)
+        raise failures[0]
 
     # ---- trace by id (reference frontend.go:91-176) ----
 
@@ -259,14 +413,17 @@ class QueryFrontend:
         stacks its share into few kernel dispatches; batches break at
         geometry (and, under ownership, owner) boundaries so every
         batch is geometry-pure and owner-pure. Returns
-        [(payload, breq_template, owner)] where payload is the [(meta,
-        start, n_pages)] job list (failure accounting), breq_template a
-        read-only SearchBlocksRequest with the jobs pre-built, and
-        owner the batch's member index for owner routing (None = no
-        preference). Memoized per (tenant, blocklist epoch, ownership
-        generation): re-sorting a 10K-block meta list and rebuilding
-        its job list is O(blocks) host work per query otherwise
-        (VERDICT r3 #1).
+        [(payload, breq_template, owner, width)] where payload is the
+        [(meta, start, n_pages)] job list (failure accounting),
+        breq_template a read-only SearchBlocksRequest with the jobs
+        pre-built, owner the batch's member index for owner routing
+        (None = no preference), and width the querier-pool width the
+        owner index was computed against (_owner_querier keys its
+        member->querier mapping on it so a pool resize mid-flight
+        cannot silently remap every owner). Memoized per (tenant,
+        blocklist epoch, ownership generation): re-sorting a 10K-block
+        meta list and rebuilding its job list is O(blocks) host work
+        per query otherwise (VERDICT r3 #1).
 
         Deliberately NOT filtered by the request's time window (the
         reference sharder excludes out-of-range metas,
@@ -348,7 +505,7 @@ class QueryFrontend:
                 j.end_time = m.end_time or 0
             # the batch's routing preference: its (single, by the run
             # break above) owner's member index; None = round-robin
-            out.append((b, t, owner_of.get(b[0][0].block_id)))
+            out.append((b, t, owner_of.get(b[0][0].block_id), width))
         self._batches_cache.put(key, out)
         return out
 
@@ -431,23 +588,20 @@ class QueryFrontend:
                     recent_failed[0] = True  # ingester leg is not a block
                     raise
             else:
-                payload, template, owner = payload
+                payload, template, owner, width = payload
                 breq = tempopb.SearchBlocksRequest()
                 breq.CopyFrom(template)  # C-level copy of the job list
                 breq.search_req.CopyFrom(req)
                 breq.tenant_id = tenant
                 # attempt 0 targets the group's owner (owner-routed
-                # HBM); retries round-robin — owner death degrades to
-                # any non-owner's byte-identical host route
-                attempts = [0]
-
-                def _send(_j):
-                    q = self._owner_querier(owner, attempts[0])
-                    attempts[0] += 1
-                    return q.search_blocks(breq)
-
+                # HBM; a heat-promoted group hedges against its next
+                # replica); retries prefer surviving replicas, then
+                # round-robin — owner death degrades to any non-owner's
+                # byte-identical host route
                 try:
-                    r = self._retrying(_send, job)
+                    r = self._dispatch_batch(
+                        breq, owner, width,
+                        payload[0][0].block_id, job=job)
                 except Exception:
                     # one failed batch = every distinct block it carried
                     with merge_lock:

@@ -432,6 +432,18 @@ hbm_owner_rebalance_evictions = Counter(
     "tempo_search_hbm_owner_rebalance_evictions_total",
     "HBM batches released because a rebalance moved their group away "
     "(result=dropped|deferred; deferred batches drop at unpin)")
+hbm_replica_promotions = Counter(
+    "tempo_search_hbm_replica_promotions_total",
+    "heat-table replica-set transitions (dir=up: a placement group's "
+    "access rate crossed search_hbm_ownership_hot_rate and promoted to "
+    "its rf-deep replica set; dir=down: rate decayed below the "
+    "hysteresis floor and the group demoted back to its single owner)")
+hedged_dispatches = Counter(
+    "tempo_search_hedged_dispatches_total",
+    "frontend hedged-dispatch outcomes over promoted groups "
+    "(result=primary: primary answered inside the hedge delay; "
+    "hedge_won: the replica's duplicate answered first; cancelled: a "
+    "losing in-flight attempt was expired through its deadline)")
 
 # ---- offload planner (search/planner.py) ----
 offload_decisions = Counter(

@@ -836,6 +836,11 @@ class BlockBatcher:
         would re-pay IO+decompress on the next routed-away query."""
         if not OWNERSHIP.enabled:
             return {"hbm_dropped": 0, "hbm_deferred": 0}
+        # load-aware: demote heat-promoted groups whose rate decayed
+        # below the hysteresis floor FIRST, so a stale replica's
+        # residency falls out through the ordinary owns_group walk below
+        # (same dropped/deferred path a placement move takes)
+        OWNERSHIP.sweep()
         dropped = deferred = 0
         with self._lock:
             for gkey in list(self._cache):
@@ -882,6 +887,10 @@ class BlockBatcher:
                 "bytes": int(nbytes),
                 "pins": int(pins),
                 "deferred_evict": pending,
+                # residency held through a heat-promoted replica set
+                # rather than plain ownership (owner included while
+                # the group is promoted)
+                "replica": OWNERSHIP.is_replica(anchor),
             })
         return out
 
@@ -1563,7 +1572,13 @@ class BlockBatcher:
                     # serves from the byte-identical host route — a
                     # non-owner never stages a duplicate device copy
                     # (docs/search-hbm-ownership.md); the owner's serve
-                    # proceeds below, device-resident
+                    # proceeds below, device-resident. Every served
+                    # group feeds the heat table (one attribute read
+                    # while replication is off): the batcher's dispatch
+                    # loop is the one site that observes every scan,
+                    # and a group crossing hot_rate here promotes to
+                    # its replica set for hedged dispatch
+                    OWNERSHIP.record_access(str(gkey[0][0]))
                     if not OWNERSHIP.owns_group(gkey):
                         obs.hbm_owner_routed.inc(route="non_owner_host")
                         if qs is not None:

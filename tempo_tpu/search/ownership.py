@@ -34,17 +34,44 @@ Routing contract (docs/search-hbm-ownership.md):
     (or, while a search pins the batch, defers) residency and the new
     owner pre-stages (``TempoDB.rebalance_ownership``).
 
+Heat-adaptive replication (``search_hbm_ownership_rf`` > 1): rf=1 makes
+the single owner of the hour's hot placement group a tail bottleneck —
+it saturates while every other chip idles, and its death forces a cold
+re-stage of exactly the hottest data. With replication on, every served
+group feeds a per-group EWMA heat table (:meth:`record_access`, the
+decayed-counter form ``r <- r*exp(-dt/tau) + 1/tau`` that converges on
+the true access rate); a group crossing
+``search_hbm_ownership_hot_rate`` PROMOTES to a replica set — the first
+``rf`` distinct members the ownership ring yields for its token
+(``Ring.get(token, rf)``), primary first, precomputed per generation
+like the owner table. A promoted group's replicas serve device-resident
+too (:meth:`owns_group` answers true for them), the frontend hedges
+their dispatches (:class:`HedgeTimer`), and a promotion/demotion fires
+the change hook so TempoDB can pre-stage the new replica / release the
+demoted residency in the background. Demotion is hysteretic (half the
+promotion rate) so a group oscillating around the threshold doesn't
+flap its replica residency.
+
 Noop contract: ``search_hbm_ownership_enabled: false`` (the default)
 costs ONE attribute read (``OWNERSHIP.enabled``) at every call site and
 routing is byte-identical — the same contract the planner and
 query-stats knobs carry, pinned by the static noop-contract checker
 (analysis/contracts.py registers both the gate and the guarded calls).
+Replication carries the same contract one level up: with
+``search_hbm_ownership_rf`` <= 1 (the default), :meth:`record_access`,
+:meth:`replica_indices` and the hedge timer are each ONE attribute read
+(``replicated`` / ``armed``) — no clock read, no lock, no thread spawn
+— and routing stays exactly the rf=1 behavior.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+import math
 import threading
-from typing import Iterable
+import time as _time
+from typing import Iterable, Iterator
 
 from tempo_tpu.observability import metrics as obs
 from tempo_tpu.utils.hashing import fnv1a_64, jump_hash, mix64
@@ -54,6 +81,25 @@ DEFAULT_PLACEMENT_GROUPS = 64
 # small fleets without making the table rebuild (n_groups ring walks)
 # noticeable on a membership change
 _RING_TOKENS = 64
+# per-group access-rate EWMA time constant: the decayed-counter update
+# converges on the true rate (in 1/s) within a few tau for any access
+# pattern, so "accesses per second" is what hot_rate compares against
+_HEAT_TAU_S = 30.0
+# demotion hysteresis: a promoted group demotes only after its rate
+# decays below this fraction of the promotion threshold — a group
+# oscillating around hot_rate must not flap replica residency (every
+# flap is a replica drop + a future cold re-stage)
+_DEMOTE_FRACTION = 0.5
+# hedge-delay derivation: before _HEDGE_MIN_SAMPLES direct dispatch
+# observations, fall back to the profiler-stage seed, then the default
+_HEDGE_MIN_SAMPLES = 8
+_HEDGE_DEFAULT_S = 0.05
+_HEDGE_FLOOR_S = 0.002
+
+# context-scoped member-identity override (see self_as): None = use
+# OWNERSHIP.self_id, the production single-identity path
+_SELF_OVERRIDE: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "tempo_ownership_self", default=None)
 
 
 def _group_token(group: int) -> int:
@@ -61,6 +107,123 @@ def _group_token(group: int) -> int:
     group id — mix64-finalized so consecutive group ids spread across
     the whole token space instead of clustering in one ring segment."""
     return mix64(fnv1a_64(b"hbm-group-%d" % group)) & 0xFFFFFFFF
+
+
+@contextlib.contextmanager
+def self_as(member_id: str) -> Iterator[None]:
+    """Serve the body AS another fleet member (tests/bench): a single
+    process simulating several hosts must answer hedged dispatches
+    CONCURRENTLY under different identities, and mutating
+    ``OWNERSHIP.self_id`` would race one attempt's routing against
+    another's. The contextvar scopes the identity to this thread's
+    context instead; production deployments never set it."""
+    token = _SELF_OVERRIDE.set(str(member_id))
+    try:
+        yield
+    finally:
+        _SELF_OVERRIDE.reset(token)
+
+
+class HedgeTimer:
+    """The hedge delay for replicated dispatch: how long the frontend
+    waits on a promoted group's primary before firing the same batch at
+    the next replica.
+
+    ``search_hedge_delay_ms`` > 0 pins it; the default (0 = auto)
+    derives a p99-ish bound from a Jacobson/Karels EWMA over completed
+    dispatch walls (``mean + 3*dev`` — the TCP RTO estimator, cheap and
+    robust without a histogram). Until enough direct observations
+    exist, the dispatch profiler's stage EWMAs seed the estimate
+    (``execute``/``d2h`` stage listener — what a healthy primary answer
+    costs), then the default. Noop contract: disarmed (rf <= 1) is ONE
+    attribute read — no clock, no lock, no thread."""
+
+    def __init__(self) -> None:
+        self.armed = False
+        self.fixed_ms = 0.0
+        self._lock = threading.Lock()
+        self._mean = 0.0
+        self._dev = 0.0
+        self._n = 0
+        self._seed_mean = 0.0
+        self._seed_n = 0
+        self._listening = False
+
+    def configure(self, armed: bool, fixed_ms: float | None = None) -> None:
+        if fixed_ms is not None:
+            self.fixed_ms = max(0.0, float(fixed_ms))
+        self.armed = bool(armed)
+        if self.armed and not self._listening:
+            # profiler-stage seed: registered once per process, and the
+            # listener itself is gated on `armed` so a later disarm
+            # costs one attribute read per stage observation
+            from tempo_tpu.observability.profile import PROFILER
+
+            PROFILER.add_stage_listener(self._on_stage)
+            self._listening = True
+
+    def _on_stage(self, stage: str, mode: str, seconds: float,
+                  nbytes: int) -> None:
+        if not self.armed:
+            return
+        if stage not in ("execute", "d2h"):
+            return
+        with self._lock:
+            if self._seed_n == 0:
+                self._seed_mean = seconds
+            else:
+                self._seed_mean += 0.125 * (seconds - self._seed_mean)
+            self._seed_n += 1
+
+    def observe(self, seconds: float) -> None:
+        """Fold one completed (un-hedged or winning) dispatch wall into
+        the delay estimate."""
+        if not self.armed:
+            return
+        with self._lock:
+            if self._n == 0:
+                self._mean = seconds
+                self._dev = seconds / 2.0
+            else:
+                err = seconds - self._mean
+                self._mean += 0.125 * err
+                self._dev += 0.25 * (abs(err) - self._dev)
+            self._n += 1
+
+    def delay_s(self) -> float:
+        """Current hedge delay in seconds."""
+        if not self.armed:
+            return _HEDGE_DEFAULT_S
+        if self.fixed_ms > 0:
+            return self.fixed_ms / 1000.0
+        with self._lock:
+            if self._n >= _HEDGE_MIN_SAMPLES:
+                return max(_HEDGE_FLOOR_S, self._mean + 3.0 * self._dev)
+            if self._seed_n:
+                return max(_HEDGE_FLOOR_S, 3.0 * self._seed_mean)
+            return _HEDGE_DEFAULT_S
+
+    def snapshot(self) -> dict:
+        delay = self.delay_s()
+        with self._lock:
+            return {
+                "armed": self.armed,
+                "fixed_ms": self.fixed_ms,
+                "delay_ms": round(delay * 1e3, 3),
+                "observed": self._n,
+                "mean_ms": round(self._mean * 1e3, 3),
+                "dev_ms": round(self._dev * 1e3, 3),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.armed = False
+            self.fixed_ms = 0.0
+            self._mean = 0.0
+            self._dev = 0.0
+            self._n = 0
+            self._seed_mean = 0.0
+            self._seed_n = 0
 
 
 class OwnershipMap:
@@ -71,7 +234,12 @@ class OwnershipMap:
     lookups answer "this member owns it" while the layer is DISABLED or
     no membership is installed: single-process deployments behave
     exactly as before the layer existed.
-    """
+
+    Replication state is split the same way: the per-generation replica
+    table (group -> first-rf ring members) is immutable and swapped
+    with the owner table; the PROMOTED set is a frozenset swapped under
+    ``_heat_lock`` — a hot-path replica lookup is one attribute read
+    plus a set membership test, never a lock."""
 
     def __init__(self, n_groups: int = DEFAULT_PLACEMENT_GROUPS) -> None:
         self.enabled = False
@@ -89,6 +257,20 @@ class OwnershipMap:
         # 128-group hash would IndexError a live query)
         self._table: tuple[int, tuple[str, ...], tuple[int, ...]] = \
             (self.n_groups, (), ())
+        # ---- heat-adaptive replication (rf > 1) ----
+        self.rf = 1
+        self.hot_rate = 0.0
+        # the replication gate: ONE attribute read decides the whole
+        # heat/replica/hedge layer (recomputed by configure())
+        self.replicated = False
+        self._replica_depth = 0
+        self._replicas: tuple[tuple[str, ...], ...] = ()
+        self._replica_idx: tuple[tuple[int, ...], ...] = ()
+        self._heat_lock = threading.Lock()
+        self._heat: dict[int, list] = {}       # group -> [rate, last_t]
+        self._promoted: frozenset = frozenset()
+        self._events: dict[int, dict] = {}     # group -> change stamps
+        self._change_hook = None
 
     # ---- membership (the rebalance surface) ----
 
@@ -98,18 +280,21 @@ class OwnershipMap:
 
     def set_members(self, members: Iterable[str],
                     self_id: str | None = None) -> int:
-        """Install a fleet membership and precompute the placement table;
-        returns how many placement groups MOVED owner (0 on the first
-        install — nothing was placed before). Idempotent for an unchanged
-        member set (no generation bump), so repeated ``configure()``
-        calls from TempoDB construction never churn placement."""
+        """Install a fleet membership and precompute the placement table
+        (owners AND the first-rf replica sets — one ring walk yields
+        both); returns how many placement groups MOVED owner (0 on the
+        first install — nothing was placed before). Idempotent for an
+        unchanged member set at an unchanged replica depth (no
+        generation bump), so repeated ``configure()`` calls from
+        TempoDB construction never churn placement."""
         new = tuple(dict.fromkeys(m for m in members if m))
         if not new:
             raise ValueError("ownership members must be non-empty")
+        depth = max(1, min(int(self.rf), len(new)))
         with self._lock:
             if self_id is not None:
                 self.self_id = self_id
-            if new == self._members:
+            if new == self._members and depth == self._replica_depth:
                 self._publish_locked()
                 return 0
             # lazy: modules.ring (via the modules package) must not load
@@ -124,14 +309,20 @@ class OwnershipMap:
                 ring.register(m, n_tokens=_RING_TOKENS)
             idx = {m: i for i, m in enumerate(new)}
             owners: list[str] = []
+            replicas: list[tuple[str, ...]] = []
             for g in range(self.n_groups):
-                got = ring.get(_group_token(g), rf=1)
+                got = ring.get(_group_token(g), rf=depth)
                 owners.append(got[0])
+                replicas.append(tuple(got))
             moved = sum(1 for old, cur in zip(self._owners, owners)
                         if old != cur)
             self._members = new
             self._owners = tuple(owners)
             self._owner_idx = tuple(idx[o] for o in owners)
+            self._replica_depth = depth
+            self._replicas = tuple(replicas)
+            self._replica_idx = tuple(
+                tuple(idx[m] for m in reps) for reps in replicas)
             self._table = (self.n_groups, self._owners, self._owner_idx)
             self.generation += 1
             if moved:
@@ -144,7 +335,19 @@ class OwnershipMap:
         obs.hbm_owner_groups.set(float(
             sum(1 for o in self._owners if o == self.self_id)))
 
+    def set_change_hook(self, hook) -> None:
+        """Register the promotion/demotion callback — called as
+        ``hook(group, "up"|"down", replica_member_ids)`` on a
+        short-lived background thread (never a serving thread: the hook
+        pre-stages or releases HBM residency). Most recent TempoDB
+        wins, the same REGISTRY idiom :func:`configure` follows."""
+        self._change_hook = hook
+
     # ---- placement lookups (hot path: no lock, no clock) ----
+
+    def _effective_self(self) -> str:
+        ov = _SELF_OVERRIDE.get()
+        return ov if ov is not None else self.self_id
 
     def group_of(self, block_id: str) -> int:
         """Placement group of a block id: shared jump hash over the
@@ -169,14 +372,26 @@ class OwnershipMap:
             return None
         return idx[jump_hash(fnv1a_64(block_id.encode()), n)]
 
+    def _is_replica_here(self, g: int, me: str) -> bool:
+        """Promoted-replica membership for group ``g`` — the replica
+        table is per-generation immutable, the promoted set a swapped
+        frozenset: no lock on this path."""
+        if not (self.replicated and g in self._promoted):
+            return False
+        reps = self._replicas
+        return g < len(reps) and me in reps[g]
+
     def owns_block(self, block_id: str) -> bool:
         if not self.enabled:
             return True
         n, owners, _ = self._table
         if not owners:
             return True
-        return owners[jump_hash(fnv1a_64(block_id.encode()), n)] \
-            == self.self_id
+        g = jump_hash(fnv1a_64(block_id.encode()), n)
+        me = self._effective_self()
+        if owners[g] == me:
+            return True
+        return self._is_replica_here(g, me)
 
     def owns_group(self, gkey: tuple) -> bool:
         """Does this member own staged batch group ``gkey`` (a tuple of
@@ -185,20 +400,153 @@ class OwnershipMap:
         owner-routing every block in a received group is owned anyway,
         and any deterministic representative keeps routing
         byte-identical — a non-owner's host route returns the same
-        answer either way."""
+        answer either way. A heat-promoted group's REPLICAS own it too:
+        a replica stages and serves device-resident, which is what
+        makes the hedged dispatch it receives fast."""
         if not self.enabled:
             return True
         n, owners, _ = self._table
         if not owners:
             return True
         anchor = str(gkey[0][0])
-        return owners[jump_hash(fnv1a_64(anchor.encode()), n)] \
-            == self.self_id
+        g = jump_hash(fnv1a_64(anchor.encode()), n)
+        me = self._effective_self()
+        if owners[g] == me:
+            return True
+        return self._is_replica_here(g, me)
+
+    def replica_indices(self, block_id: str) -> tuple[int, ...]:
+        """Member indices of the block's replica set, PRIMARY FIRST —
+        the frontend's hedge targets. Empty unless the block's group is
+        heat-promoted: an un-promoted group has exactly its owner, and
+        the frontend's plain owner routing already covers that."""
+        if not self.replicated:
+            return ()
+        promoted = self._promoted
+        if not promoted:
+            return ()
+        n, _, _ = self._table
+        g = jump_hash(fnv1a_64(block_id.encode()), n)
+        if g not in promoted or g >= len(self._replica_idx):
+            return ()
+        return self._replica_idx[g]
+
+    def replicas_of(self, block_id: str) -> tuple[str, ...]:
+        """Replica member ids (primary first) for a heat-promoted
+        block's group; empty when not promoted."""
+        if not self.replicated:
+            return ()
+        promoted = self._promoted
+        if not promoted:
+            return ()
+        n, _, _ = self._table
+        g = jump_hash(fnv1a_64(block_id.encode()), n)
+        if g not in promoted or g >= len(self._replicas):
+            return ()
+        return self._replicas[g]
+
+    def is_replica(self, block_id: str) -> bool:
+        """Does this member hold ``block_id``'s group through the
+        heat-promoted replica set (owner included)? Operator surface
+        for the residency rows."""
+        if not self.enabled:
+            return False
+        n, _, _ = self._table
+        g = jump_hash(fnv1a_64(block_id.encode()), n)
+        return self._is_replica_here(g, self._effective_self())
+
+    # ---- heat table (replication gate: one attribute read when off) ----
+
+    def record_access(self, block_id: str) -> None:
+        """Feed the per-group EWMA heat table — one call per served
+        group scan (the batcher's dispatch site, which observes every
+        scan the process serves). Crossing ``hot_rate`` promotes the
+        group to its precomputed replica set; decaying below the
+        hysteresis floor demotes it. Promotion/demotion fires the
+        change hook on a background thread — this method runs on the
+        serving hot path and must not stage or evict anything itself."""
+        if not self.replicated:
+            return
+        n, _, _ = self._table
+        g = jump_hash(fnv1a_64(block_id.encode()), n)
+        now = _time.monotonic()
+        fire = None
+        with self._heat_lock:
+            ent = self._heat.get(g)
+            if ent is None:
+                ent = self._heat[g] = [0.0, now]
+            dt = max(0.0, now - ent[1])
+            rate = ent[0] * math.exp(-dt / _HEAT_TAU_S) + 1.0 / _HEAT_TAU_S
+            ent[0] = rate
+            ent[1] = now
+            if g not in self._promoted:
+                if rate >= self.hot_rate:
+                    fire = self._promote_locked(g)
+            elif rate < self.hot_rate * _DEMOTE_FRACTION:
+                fire = self._demote_locked(g)
+        if fire is not None:
+            self._fire_change(*fire)
+
+    def _promote_locked(self, g: int) -> tuple:
+        self._promoted = self._promoted | {g}
+        self._events.setdefault(g, {})["promoted_t"] = _time.time()
+        obs.hbm_replica_promotions.inc(dir="up")
+        reps = self._replicas[g] if g < len(self._replicas) else ()
+        return (g, "up", reps)
+
+    def _demote_locked(self, g: int) -> tuple:
+        self._promoted = self._promoted - {g}
+        self._events.setdefault(g, {})["demoted_t"] = _time.time()
+        obs.hbm_replica_promotions.inc(dir="down")
+        reps = self._replicas[g] if g < len(self._replicas) else ()
+        return (g, "down", reps)
+
+    def _fire_change(self, g: int, direction: str, replicas: tuple) -> None:
+        hook = self._change_hook
+        if hook is None:
+            return
+        # background thread: the hook pre-stages (promotion) or sweeps
+        # residency (demotion) — seconds of H2D/eviction work that must
+        # never ride the serving thread that tipped the rate over
+        threading.Thread(target=hook, args=(g, direction, replicas),
+                         name="ownership-heat", daemon=True).start()
+
+    def sweep(self, now: float | None = None) -> int:
+        """Demote promoted groups whose rate has DECAYED below the
+        hysteresis floor. Promotion is access-driven, so a group whose
+        traffic vanishes entirely can only demote here — called from
+        :meth:`snapshot` and the batcher's rebalance walk, which is
+        what makes rebalance load-aware: stale replicas demote first,
+        then drop through the ordinary owns_group residency walk.
+        Returns the number of demotions (hooks fire per demotion)."""
+        if not self.replicated:
+            return 0
+        if now is None:
+            now = _time.monotonic()
+        fires = []
+        with self._heat_lock:
+            for g in list(self._promoted):
+                ent = self._heat.get(g)
+                rate = 0.0
+                if ent is not None:
+                    dt = max(0.0, now - ent[1])
+                    rate = ent[0] * math.exp(-dt / _HEAT_TAU_S)
+                    ent[0] = rate
+                    ent[1] = now
+                if rate < self.hot_rate * _DEMOTE_FRACTION:
+                    fires.append(self._demote_locked(g))
+        for f in fires:
+            self._fire_change(*f)
+        return len(fires)
 
     # ---- operator surface ----
 
     def snapshot(self) -> dict[str, object]:
-        """/debug/ownership payload: the map, generation, member split."""
+        """/debug/ownership payload: the map, generation, member split,
+        and the per-group heat table (rate, rf, replica set, last
+        promotion/demotion stamps)."""
+        if self.replicated:
+            self.sweep()
         with self._lock:
             owners = self._owners
             members = self._members
@@ -207,7 +555,7 @@ class OwnershipMap:
         counts: dict[str, int] = {}
         for o in owners:
             counts[o] = counts.get(o, 0) + 1
-        return {
+        out: dict[str, object] = {
             "enabled": self.enabled,
             "generation": gen,
             "self": self_id,
@@ -215,7 +563,30 @@ class OwnershipMap:
             "n_groups": self.n_groups,
             "owners": {str(g): o for g, o in enumerate(owners)},
             "groups_per_member": counts,
+            "rf": self.rf,
+            "hot_rate": self.hot_rate,
+            "replicated": self.replicated,
         }
+        heat: dict[str, dict] = {}
+        now = _time.monotonic()
+        with self._heat_lock:
+            promoted = self._promoted
+            for g, ent in self._heat.items():
+                rate = ent[0] * math.exp(
+                    -max(0.0, now - ent[1]) / _HEAT_TAU_S)
+                up = g in promoted and g < len(self._replicas)
+                row: dict[str, object] = {
+                    "rate": round(rate, 4),
+                    "promoted": g in promoted,
+                    "rf": len(self._replicas[g]) if up else 1,
+                    "replicas": list(self._replicas[g]) if up else [],
+                }
+                for k, v in self._events.get(g, {}).items():
+                    row[k] = round(v, 3)
+                heat[str(g)] = row
+        out["heat"] = heat
+        out["hedge"] = HEDGE.snapshot()
+        return out
 
     def reset(self) -> None:
         """Back to the factory state (tests)."""
@@ -228,23 +599,41 @@ class OwnershipMap:
             self._owners = ()
             self._owner_idx = ()
             self._table = (self.n_groups, (), ())
+            self.rf = 1
+            self.hot_rate = 0.0
+            self.replicated = False
+            self._replica_depth = 0
+            self._replicas = ()
+            self._replica_idx = ()
+            self._change_hook = None
             self._publish_locked()
+        with self._heat_lock:
+            self._heat = {}
+            self._promoted = frozenset()
+            self._events = {}
+        HEDGE.reset()
 
 
 OWNERSHIP = OwnershipMap()
+HEDGE = HedgeTimer()
 
 
 def configure(enabled: bool | None = None,
               members: str | Iterable[str] | None = None,
               self_id: str | None = None,
-              groups: int | None = None) -> OwnershipMap:
-    """Apply config (TempoDBConfig.search_hbm_ownership_*) to the
-    process-wide map — the most recent TempoDB wins, the REGISTRY idiom.
-    ``members`` accepts the comma-separated config string or an
-    iterable; empty/None with the layer enabled auto-derives the fleet
-    from the multihost env contract
-    (parallel.multihost.ownership_members) so a mesh fleet needs zero
-    extra config."""
+              groups: int | None = None,
+              rf: int | None = None,
+              hot_rate: float | None = None,
+              hedge_delay_ms: float | None = None) -> OwnershipMap:
+    """Apply config (TempoDBConfig.search_hbm_ownership_* and
+    search_hedge_delay_ms) to the process-wide map — the most recent
+    TempoDB wins, the REGISTRY idiom. ``members`` accepts the
+    comma-separated config string or an iterable; empty/None with the
+    layer enabled auto-derives the fleet from the multihost env
+    contract (parallel.multihost.ownership_members) so a mesh fleet
+    needs zero extra config. ``rf`` > 1 (with a positive ``hot_rate``)
+    arms heat-adaptive replication and the hedge timer; the defaults
+    keep today's rf=1 behavior bit for bit."""
     if groups is not None and int(groups) > 0 \
             and int(groups) != OWNERSHIP.n_groups:
         with OWNERSHIP._lock:
@@ -256,7 +645,20 @@ def configure(enabled: bool | None = None,
             OWNERSHIP._members = ()
             OWNERSHIP._owners = ()
             OWNERSHIP._owner_idx = ()
+            OWNERSHIP._replica_depth = 0
+            OWNERSHIP._replicas = ()
+            OWNERSHIP._replica_idx = ()
             OWNERSHIP._table = (int(groups), (), ())
+        with OWNERSHIP._heat_lock:
+            # group ids re-hash on a resize: the old heat rates and
+            # promotions describe groups that no longer exist
+            OWNERSHIP._heat = {}
+            OWNERSHIP._promoted = frozenset()
+            OWNERSHIP._events = {}
+    if rf is not None:
+        OWNERSHIP.rf = max(1, int(rf))
+    if hot_rate is not None:
+        OWNERSHIP.hot_rate = max(0.0, float(hot_rate))
     mlist: list[str] | None
     if isinstance(members, str):
         parsed = [m.strip() for m in members.split(",") if m.strip()]
@@ -278,4 +680,17 @@ def configure(enabled: bool | None = None,
         OWNERSHIP.set_members(mlist, self_id=self_id)
     elif self_id:
         OWNERSHIP.self_id = self_id
+    # the replication gate is ONE precomputed attribute: enabled, rf>1
+    # and a positive promotion threshold — everything the heat/hedge
+    # layer tests on its hot paths
+    OWNERSHIP.replicated = bool(
+        OWNERSHIP.enabled and OWNERSHIP.rf > 1 and OWNERSHIP.hot_rate > 0)
+    if OWNERSHIP.members:
+        depth = max(1, min(OWNERSHIP.rf, len(OWNERSHIP.members)))
+        if depth != OWNERSHIP._replica_depth:
+            # rf changed after the members installed: rebuild the
+            # replica table at the new depth (generation bumps — the
+            # frontend's batch plans re-key, routing potential changed)
+            OWNERSHIP.set_members(OWNERSHIP.members)
+    HEDGE.configure(armed=OWNERSHIP.replicated, fixed_ms=hedge_delay_ms)
     return OWNERSHIP

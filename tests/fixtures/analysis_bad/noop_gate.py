@@ -7,6 +7,7 @@ from . import fakes as obs
 
 FAULTS = object()        # stand-in singleton; never executed
 TELEMETRY = object()
+HEDGE = object()
 
 
 class Telemetry:
@@ -66,3 +67,14 @@ def hit_in_else():
         pass
     else:
         FAULTS.hit("some_faultpoint")
+
+
+def hedge_unguarded(seconds):
+    # VIOLATION: hedge-timer touch with no dominating .armed check —
+    # the rf=1 deployment would pay the estimator lock on every call
+    HEDGE.observe(seconds)
+
+
+def hedge_guarded(seconds):
+    if HEDGE.armed:
+        HEDGE.observe(seconds)

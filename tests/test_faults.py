@@ -775,6 +775,160 @@ def test_chaos_rebalance_under_load_4way(tmp_path, _clean_ownership):
         assert b._cache_total == sum(e.nbytes for e in b._cache.values())
 
 
+# --------------------------------------- replicated ownership + hedging
+
+
+def test_chaos_primary_death_mid_hedge(tmp_path, _clean_ownership):
+    """Primary death MID-HEDGE: the promoted group's primary wedges
+    past the hedge delay and then dies; the hedge already fired at the
+    replica, the replica's answer wins, and the response stays
+    byte-identical — the primary's late failure is swallowed by the
+    race, never surfaced."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.modules.ring import Ring
+    from tempo_tpu.search import ownership
+    from tempo_tpu.search.ownership import OWNERSHIP
+
+    db = _mkdb(tmp_path, n_blocks=6, search_max_batch_pages=8)
+    q = Querier(db, Ring(), {})
+
+    class _DyingSlow:
+        def __init__(self, inner):
+            self.inner = inner
+            self.db = inner.db
+            self.wedged = False
+
+        def search_recent(self, tenant, req):
+            return self.inner.search_recent(tenant, req)
+
+        def search_blocks(self, breq):
+            if self.wedged:
+                time.sleep(0.2)  # past the 20 ms hedge delay...
+                raise RuntimeError("primary died mid-hedge")
+            return self.inner.search_blocks(breq)
+
+    primary, replica = _DyingSlow(q), _DyingSlow(q)
+    fe = QueryFrontend([primary, replica], FrontendConfig(retries=3))
+    req = _req(limit=10_000)
+    base = _canon(fe.search("t", req))
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=32, rf=2, hot_rate=0.01,
+                        hedge_delay_ms=20)
+    # one access per block promotes every group past the tiny threshold
+    for m in db.blocklist.metas("t"):
+        OWNERSHIP.record_access(m.block_id)
+    won0 = obs.hedged_dispatches.value(result="hedge_won")
+    primary.wedged = True  # member 0's process wedges, then dies
+    t0 = time.perf_counter()
+    got = _canon(fe.search("t", req))
+    wall = time.perf_counter() - t0
+    assert got == base
+    assert wall < 30.0
+    batches = fe._search_batches("t")
+    if any(b[2] == 0 for b in batches):  # some group owned by m0
+        assert obs.hedged_dispatches.value(result="hedge_won") > won0
+
+
+def test_chaos_both_replicas_wedged_breaker_host_route(
+        tmp_path, _clean_ownership):
+    """Both replicas of every promoted group wedge at the device (the
+    shared device dispatch hangs): the watchdog faults the dispatches,
+    the breaker opens, every group — replicated or not — degrades to
+    the host route, byte-identical and bounded by the watchdog."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.modules.ring import Ring
+    from tempo_tpu.search import ownership
+    from tempo_tpu.search.ownership import OWNERSHIP
+
+    db = _mkdb(tmp_path, n_blocks=6, search_max_batch_pages=8)
+    q = Querier(db, Ring(), {})
+    fe = QueryFrontend([q, q], FrontendConfig(retries=3))
+    req = _req(limit=10_000)
+    base = _canon(fe.search("t", req))
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=32, rf=2, hot_rate=0.01,
+                        hedge_delay_ms=10)
+    for m in db.blocklist.metas("t"):
+        OWNERSHIP.record_access(m.block_id)
+    robustness.BREAKER.reset()
+    robustness.GUARD.timeout_s = 0.3
+    with robustness.FAULTS.armed("device_dispatch_hang", delay_s=5.0,
+                                 count=1000):
+        t0 = time.perf_counter()
+        got = _canon(fe.search("t", req))
+        wall = time.perf_counter() - t0
+    assert got == base
+    assert wall < 30.0  # watchdog-bounded, never a hang per attempt
+    assert robustness.BREAKER.snapshot()["faults_in_window"] >= 1
+    # breaker now forced open: still byte-identical, zero device
+    for _ in range(3):
+        robustness.BREAKER.record_fault("timeout")
+    assert robustness.BREAKER.state == OPEN
+    assert _canon(fe.search("t", req)) == base
+
+
+def test_chaos_promotion_flapping_residency_conserved(
+        tmp_path, _clean_ownership):
+    """Promotion/demotion flapping under concurrent searchers: a
+    background thread force-demotes every promoted group (far-future
+    sweep) while the serving loop's heat feed re-promotes on each scan
+    — every answer stays byte-identical and the HBM accounting never
+    goes negative (cache total == sum of entries)."""
+    import threading
+
+    from tempo_tpu.search import ownership
+    from tempo_tpu.search.ownership import OWNERSHIP
+
+    db = _mkdb(tmp_path, n_blocks=4, search_max_batch_pages=8)
+    req = _req(limit=10_000)
+    base = _canon(db.search("t", req).response())
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=32, rf=2, hot_rate=0.02)
+    stop = threading.Event()
+    errors: list = []
+
+    def searcher():
+        while not stop.is_set():
+            try:
+                got = _canon(db.search("t", req).response())
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+                return
+            if got != base:
+                errors.append(AssertionError("diverged mid-flap"))
+                return
+
+    def flapper():
+        while not stop.is_set():
+            # far-future decay: every promoted group demotes, firing
+            # the TempoDB hook's residency rebalance in background;
+            # the next scan's record_access promotes again
+            OWNERSHIP.sweep(now=time.monotonic() + 600.0)
+            time.sleep(0.005)
+
+    ts = [threading.Thread(target=searcher) for _ in range(3)]
+    ts.append(threading.Thread(target=flapper))
+    for t in ts:
+        t.start()
+    time.sleep(1.5)
+    stop.set()
+    for t in ts:
+        t.join(timeout=60)
+        assert not t.is_alive(), "hung under promotion flapping"
+    assert not errors, errors[:1]
+    up = obs.hbm_replica_promotions.value(dir="up")
+    down = obs.hbm_replica_promotions.value(dir="down")
+    assert up >= 1 and down >= 1  # it really flapped
+    b = db.batcher
+    with b._lock:
+        b._run_deferred_evictions_locked()
+        assert b._cache_total >= 0
+        assert b._cache_total == sum(e.nbytes for e in b._cache.values())
+    assert _canon(db.search("t", req).response()) == base
+
+
 # ----------------------------------------------------------- docs drift
 
 

@@ -395,6 +395,34 @@ def test_frontend_owner_death_degrades_to_peer(tmp_path):
     assert not db.batcher._cache or True  # serving path decided per self
 
 
+def test_frontend_pool_resize_mid_flight_keeps_plan_mapping(tmp_path):
+    """Regression (satellite): the batch plan carries the pool width it
+    was computed against, so a querier joining the pool BETWEEN
+    planning and dispatch cannot silently remap every owner — the
+    in-flight batch lands on the plan-mapped querier, and the new pool
+    member only receives freshly-planned work."""
+    db, proxies, fe = _frontend(tmp_path)
+    ownership.configure(enabled=True, members="m0,m1,m2", self_id="m0",
+                        groups=32)
+    req = _req(limit=10_000)
+    batches = fe._search_batches("t")
+    assert all(b[3] == 2 for b in batches)  # planned against 2 queriers
+    payload, template, owner, width = next(
+        b for b in batches if b[2] is not None)
+    breq = tempopb.SearchBlocksRequest()
+    breq.CopyFrom(template)
+    breq.search_req.CopyFrom(req)
+    breq.tenant_id = "t"
+    # the pool grows mid-flight
+    q3 = _RecordingQuerier(proxies[0].inner)
+    fe.queriers.append(q3)
+    fe._dispatch_batch(breq, owner, width, payload[0][0].block_id)
+    # plan-width mapping: owner % 2 — the live-pool indexing this
+    # replaces would have sent owner-2 batches to the NEW querier
+    assert not q3.block_batches
+    assert proxies[owner % width].block_batches
+
+
 def test_frontend_batch_plan_rekeys_on_generation(tmp_path):
     db, proxies, fe = _frontend(tmp_path)
     ownership.configure(enabled=True, members="m0,m1", groups=32)
@@ -431,7 +459,12 @@ def test_debug_ownership_snapshot_shape(tmp_path):
     assert isinstance(doc["residency"], list) and doc["residency"]
     row = doc["residency"][0]
     assert {"anchor_block", "placement_group", "owner", "owned",
-            "bytes", "pins", "deferred_evict"} <= set(row)
+            "bytes", "pins", "deferred_evict", "replica"} <= set(row)
+    # the replication surface rides the same snapshot (empty heat
+    # table and a disarmed hedge timer at the rf=1 default)
+    assert doc["rf"] == 1 and doc["replicated"] is False
+    assert doc["heat"] == {}
+    assert doc["hedge"]["armed"] is False
 
 
 def test_ownership_metrics_documented():
@@ -448,9 +481,340 @@ def test_ownership_metrics_documented():
 
 def test_noop_contract_registered():
     """The ownership gate rides the static noop-contract checker like
-    the planner/query-stats knobs."""
+    the planner/query-stats knobs — and the replication/hedge gates
+    ride beside it (heat table, replica lookups and the hedge timer
+    must each cost one attribute read at rf=1)."""
     from tempo_tpu.analysis.contracts import GATED_FUNCTIONS, GUARDED_CALLS
 
     knobs = {g.knob for g in GATED_FUNCTIONS}
     assert "search_hbm_ownership_enabled" in knobs
+    assert "search_hbm_ownership_rf" in knobs
+    assert "search_hbm_ownership_hot_rate" in knobs
+    assert "search_hedge_delay_ms" in knobs
+    gated = {g.qualname for g in GATED_FUNCTIONS}
+    assert {"OwnershipMap.record_access", "OwnershipMap.replica_indices",
+            "OwnershipMap.sweep", "HedgeTimer.observe",
+            "HedgeTimer.delay_s"} <= gated
     assert any(r.receiver == "OWNERSHIP" for r in GUARDED_CALLS)
+    assert any(r.receiver == "HEDGE" and "observe" in r.methods
+               for r in GUARDED_CALLS)
+    assert any(r.receiver == "OWNERSHIP" and "record_access" in r.methods
+               for r in GUARDED_CALLS)
+
+
+# ------------------------------------- heat-adaptive replication (rf>1)
+
+
+def test_replica_table_primary_first_distinct():
+    """The per-generation replica table: rf distinct ring members per
+    group, primary (the owner) first — the frontend's hedge order."""
+    ownership.configure(enabled=True, members="h0,h1,h2", self_id="h0",
+                        groups=32, rf=2, hot_rate=5.0)
+    assert OWNERSHIP.replicated is True
+    assert OWNERSHIP._replica_depth == 2
+    for g in range(32):
+        reps = OWNERSHIP._replicas[g]
+        assert len(reps) == 2 and len(set(reps)) == 2
+        assert reps[0] == OWNERSHIP._owners[g]
+
+
+def test_rf_defaults_are_true_noop():
+    """rf=1 (the default): the heat table never records, replica
+    lookups return empty, the sweep no-ops, the hedge timer stays
+    disarmed — single-owner behavior bit for bit."""
+    from tempo_tpu.search.ownership import HEDGE
+
+    ownership.configure(enabled=True, members="h0,h1", self_id="h0",
+                        groups=32)
+    assert OWNERSHIP.rf == 1 and OWNERSHIP.replicated is False
+    OWNERSHIP.record_access("blk")  # one attribute read: no heat entry
+    assert OWNERSHIP._heat == {}
+    assert OWNERSHIP.replica_indices("blk") == ()
+    assert OWNERSHIP.replicas_of("blk") == ()
+    assert OWNERSHIP.sweep() == 0
+    assert HEDGE.armed is False
+    t = ownership.HedgeTimer()
+    t.observe(1.0)  # disarmed: must not touch the estimator
+    assert t._n == 0
+
+
+def test_record_access_promotes_and_sweep_demotes():
+    import time as _t
+
+    ownership.configure(enabled=True, members="h0,h1,h2", self_id="h0",
+                        groups=32, rf=2, hot_rate=0.01)
+    up0 = obs.hbm_replica_promotions.value(dir="up")
+    down0 = obs.hbm_replica_promotions.value(dir="down")
+    events: list = []
+    OWNERSHIP.set_change_hook(
+        lambda g, d, reps: events.append((g, d, reps)))
+    # one access books rate 1/30 ≈ 0.033 ≥ the 0.01 threshold: promote
+    OWNERSHIP.record_access("blk-0")
+    g = OWNERSHIP.group_of("blk-0")
+    assert g in OWNERSHIP._promoted
+    reps = OWNERSHIP.replicas_of("blk-0")
+    assert len(reps) == 2 and reps[0] == OWNERSHIP.owner_of("blk-0")
+    assert len(OWNERSHIP.replica_indices("blk-0")) == 2
+    assert obs.hbm_replica_promotions.value(dir="up") == up0 + 1
+    # every replica owns the promoted group (serves it device-resident);
+    # the third member still doesn't
+    for m in reps:
+        with ownership.self_as(m):
+            assert OWNERSHIP.owns_block("blk-0")
+            assert OWNERSHIP.is_replica("blk-0")
+    (other,) = set(OWNERSHIP.members) - set(reps)
+    with ownership.self_as(other):
+        assert not OWNERSHIP.owns_block("blk-0")
+    # two minutes of silence: the rate decays below the hysteresis
+    # floor and the sweep demotes
+    assert OWNERSHIP.sweep(now=_t.monotonic() + 120.0) == 1
+    assert g not in OWNERSHIP._promoted
+    assert OWNERSHIP.replica_indices("blk-0") == ()
+    assert obs.hbm_replica_promotions.value(dir="down") == down0 + 1
+    # the change hook saw both transitions (fired on background threads)
+    deadline = _t.time() + 5
+    while _t.time() < deadline and len(events) < 2:
+        _t.sleep(0.01)
+    assert [e[1] for e in events] == ["up", "down"]
+    assert events[0][0] == g and events[0][2] == reps
+
+
+def test_demotion_is_hysteretic():
+    """A group whose rate sits between half the threshold and the
+    threshold stays promoted — oscillating around hot_rate must not
+    flap replica residency."""
+    import time as _t
+
+    ownership.configure(enabled=True, members="h0,h1", self_id="h0",
+                        groups=32, rf=2, hot_rate=0.02)
+    OWNERSHIP.record_access("blk-0")  # 0.033 ≥ 0.02: promoted
+    g = OWNERSHIP.group_of("blk-0")
+    assert g in OWNERSHIP._promoted
+    # 24 s of decay: rate ≈ 0.015 — under the threshold but above the
+    # 0.01 floor. No demotion.
+    assert OWNERSHIP.sweep(now=_t.monotonic() + 24.0) == 0
+    assert g in OWNERSHIP._promoted
+
+
+def test_snapshot_heat_and_hedge_shape():
+    ownership.configure(enabled=True, members="h0,h1,h2", self_id="h0",
+                        groups=32, rf=2, hot_rate=0.01,
+                        hedge_delay_ms=25)
+    OWNERSHIP.record_access("blk-0")
+    snap = OWNERSHIP.snapshot()
+    assert snap["rf"] == 2 and snap["replicated"] is True
+    assert snap["hot_rate"] == 0.01
+    row = snap["heat"][str(OWNERSHIP.group_of("blk-0"))]
+    assert row["promoted"] is True and row["rf"] == 2
+    assert len(row["replicas"]) == 2
+    assert row["rate"] > 0 and "promoted_t" in row
+    assert snap["hedge"]["armed"] is True
+    assert snap["hedge"]["delay_ms"] == 25.0
+
+
+def test_hedge_timer_delay_derivation():
+    t = ownership.HedgeTimer()
+    # disarmed: the default, after one attribute read
+    assert t.delay_s() == 0.05
+    t.armed = True
+    t.fixed_ms = 40.0
+    assert t.delay_s() == pytest.approx(0.040)
+    t.fixed_ms = 0.0
+    # profiler-stage seed carries the estimate before direct samples
+    t._on_stage("execute", "device", 0.02, 0)
+    assert t.delay_s() == pytest.approx(0.06)
+    t._on_stage("header_prune", "host", 9.9, 0)  # not a dispatch stage
+    assert t.delay_s() == pytest.approx(0.06)
+    # enough direct observations: Jacobson/Karels mean + 3*dev
+    for _ in range(12):
+        t.observe(0.05)
+    assert 0.05 <= t.delay_s() <= 0.2
+    t.reset()
+    assert t.armed is False and t._n == 0
+
+
+def test_configure_rf_change_rebuilds_replica_depth():
+    """Raising rf after the members installed rebuilds the replica
+    table at the new depth (generation bumps: the frontend's plans
+    must re-key — routing potential changed)."""
+    ownership.configure(enabled=True, members="h0,h1,h2", self_id="h0",
+                        groups=32)
+    gen = OWNERSHIP.generation
+    assert OWNERSHIP._replica_depth == 1
+    ownership.configure(rf=2, hot_rate=0.5)
+    assert OWNERSHIP._replica_depth == 2
+    assert OWNERSHIP.generation == gen + 1
+    # idempotent re-configure at the same depth: no churn
+    ownership.configure(rf=2, hot_rate=0.5)
+    assert OWNERSHIP.generation == gen + 1
+
+
+def test_group_resize_clears_heat_state():
+    ownership.configure(enabled=True, members="h0,h1", self_id="h0",
+                        groups=32, rf=2, hot_rate=0.01)
+    OWNERSHIP.record_access("blk-0")
+    assert OWNERSHIP._promoted
+    ownership.configure(groups=64, members="h0,h1")
+    # group ids re-hashed: stale heat/promotions describe dead groups
+    assert not OWNERSHIP._promoted and OWNERSHIP._heat == {}
+
+
+# ----------------------------------------- hedged dispatch (frontend)
+
+
+def test_owner_querier_plan_width_and_replica_preference():
+    """Satellite: the owner→querier mapping keys on the PLAN-TIME pool
+    width (riding the generation-keyed batch plan), so a pool resize
+    mid-flight cannot silently remap every owner; replica retries walk
+    the replica set before the round-robin fallback."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+
+    fe = QueryFrontend(["q0", "q1", "q2"], FrontendConfig())
+    # plan-time width pins the mapping even though the live pool is 3
+    assert fe._owner_querier(2, 0, 2) == "q0"   # 2 % plan-width 2
+    assert fe._owner_querier(1, 0, 2) == "q1"
+    # replica preference: attempts 1..rf-1 walk the replica set
+    assert fe._owner_querier(2, 0, 3, (2, 0)) == "q2"
+    assert fe._owner_querier(2, 1, 3, (2, 0)) == "q0"
+    # past the replica set: round-robin fallback
+    assert fe._owner_querier(2, 2, 3, (2, 0)) in ("q0", "q1", "q2")
+    # a plan index past a SHRUNK pool degrades to round-robin, never an
+    # IndexError or an arbitrary wrong owner
+    small = QueryFrontend(["q0", "q1"], FrontendConfig())
+    assert small._owner_querier(5, 0, 6) in ("q0", "q1")
+
+
+class _FakeQuerier:
+    """search_blocks stub with a programmable wall/failure — the
+    hedged-send race harness. Checks the per-attempt deadline between
+    'groups' like the real batcher, so a cancelled loser stops early."""
+
+    def __init__(self, resp, delay_s=0.0, fail=False):
+        self.resp = resp
+        self.delay_s = delay_s
+        self.fail = fail
+        self.calls = 0
+        self.cancelled = 0
+
+    def search_blocks(self, breq):
+        from tempo_tpu.robustness import deadline as _dl
+        import time as _t
+
+        self.calls += 1
+        t_end = _t.monotonic() + self.delay_s
+        while _t.monotonic() < t_end:
+            if _dl.expired():
+                self.cancelled += 1
+                raise robustness.DeadlineExceeded("cancelled mid-scan")
+            _t.sleep(0.005)
+        if self.fail:
+            raise RuntimeError("querier died")
+        return self.resp
+
+
+def _hedge_armed(fixed_ms=20.0):
+    from tempo_tpu.search.ownership import HEDGE
+
+    HEDGE.armed = True
+    HEDGE.fixed_ms = fixed_ms
+    return HEDGE
+
+
+def test_hedged_send_primary_wins_inside_delay():
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+
+    _hedge_armed(fixed_ms=50.0)
+    primary = _FakeQuerier("fast", delay_s=0.0)
+    hedge = _FakeQuerier("never", delay_s=0.0)
+    fe = QueryFrontend([primary, hedge], FrontendConfig())
+    before = obs.hedged_dispatches.value(result="primary")
+    r = fe._hedged_send(tempopb.SearchBlocksRequest(), primary, hedge)
+    assert r == "fast"
+    assert hedge.calls == 0  # the hedge never fired
+    assert obs.hedged_dispatches.value(result="primary") == before + 1
+
+
+def test_hedged_send_replica_wins_and_loser_cancelled():
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+
+    _hedge_armed(fixed_ms=20.0)
+    primary = _FakeQuerier("slow", delay_s=5.0)   # wedged past the delay
+    hedge = _FakeQuerier("fast", delay_s=0.0)
+    fe = QueryFrontend([primary, hedge], FrontendConfig())
+    won0 = obs.hedged_dispatches.value(result="hedge_won")
+    can0 = obs.hedged_dispatches.value(result="cancelled")
+    r = fe._hedged_send(tempopb.SearchBlocksRequest(), primary, hedge)
+    assert r == "fast"
+    assert hedge.calls == 1
+    assert obs.hedged_dispatches.value(result="hedge_won") == won0 + 1
+    assert obs.hedged_dispatches.value(result="cancelled") == can0 + 1
+    # the loser's force-expired deadline stops it at the next check —
+    # it must not burn its full 5 s wall
+    deadline = __import__("time").time() + 3
+    while __import__("time").time() < deadline and not primary.cancelled:
+        __import__("time").sleep(0.01)
+    assert primary.cancelled == 1
+
+
+def test_hedged_send_fast_primary_failure_raises_for_retry():
+    """A primary that FAILS inside the hedge delay raises immediately —
+    _retrying moves to the surviving replica (attempt 1 prefers it)
+    instead of waiting out the delay."""
+    from tempo_tpu.modules.frontend import FrontendConfig, QueryFrontend
+
+    _hedge_armed(fixed_ms=5000.0)  # the delay must not be waited out
+    primary = _FakeQuerier(None, delay_s=0.0, fail=True)
+    hedge = _FakeQuerier("alive", delay_s=0.0)
+    fe = QueryFrontend([primary, hedge], FrontendConfig())
+    t0 = __import__("time").monotonic()
+    with pytest.raises(RuntimeError, match="querier died"):
+        fe._hedged_send(tempopb.SearchBlocksRequest(), primary, hedge)
+    assert __import__("time").monotonic() - t0 < 2.0
+    assert hedge.calls == 0
+
+
+def test_dispatch_batch_hedges_only_promoted_groups(tmp_path):
+    """End to end through _dispatch_batch: an un-promoted group keeps
+    the exact rf=1 single dispatch; a promoted one hedges and stays
+    byte-identical."""
+    db, proxies, fe = _frontend(tmp_path)
+    req = _req(limit=10_000)
+    base = _canon(fe.search("t", req))
+    ownership.configure(enabled=True, members="m0,m1", self_id="m0",
+                        groups=32, rf=2, hot_rate=0.01,
+                        hedge_delay_ms=15)
+    for p in proxies:
+        p.block_batches.clear()
+    calls_before = sum(len(p.block_batches) for p in proxies)
+    assert calls_before == 0
+    # not promoted yet: no hedging, one dispatch per batch
+    assert _canon(fe.search("t", req)) == base
+    batches = fe._search_batches("t")
+    n_owned = sum(1 for b in batches if b[2] is not None)
+    assert sum(len(p.block_batches) for p in proxies) == n_owned
+    # promote every group, wedge the primaries: the hedge answers and
+    # the response stays byte-identical
+    for m in db.blocklist.metas("t"):
+        OWNERSHIP.record_access(m.block_id)
+    won0 = obs.hedged_dispatches.value(result="hedge_won")
+
+    class _SlowFirst:
+        """Delay injected around member-0's querier only."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.db = inner.db
+
+        def search_recent(self, tenant, req):
+            return self.inner.search_recent(tenant, req)
+
+        def search_blocks(self, breq):
+            __import__("time").sleep(0.25)
+            return self.inner.search_blocks(breq)
+
+    fe.queriers[0] = _SlowFirst(proxies[0])
+    got = _canon(fe.search("t", req))
+    assert got == base
+    # at least one batch was owned by the slow member: its hedge won
+    if any(b[2] == 0 for b in batches):
+        assert obs.hedged_dispatches.value(result="hedge_won") > won0

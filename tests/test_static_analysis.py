@@ -153,11 +153,15 @@ _FIXTURE_GATES = (
 def test_contract_flags_pre_gate_work_and_unguarded_calls(bad_pkg):
     findings = NoopContractChecker(gated=_FIXTURE_GATES).check(bad_pkg)
     keys = sorted(f.key.split(":")[0] for f in findings)
-    assert keys == ["pre-gate", "pre-gate"] + ["unguarded"] * 5, \
+    assert keys == ["pre-gate", "pre-gate"] + ["unguarded"] * 6, \
         [f.message for f in findings]
     msgs = " | ".join(f.message for f in findings)
     assert "metric write" in msgs and "clock read" in msgs
     assert "FAULTS.hit()" in msgs and "TELEMETRY.record_age()" in msgs
+    # the hedge-timer rule: an estimator touch without the armed gate
+    # is flagged; the guarded twin stays silent
+    assert "hedge_unguarded" in msgs and "HEDGE.observe()" in msgs
+    assert "hedge_guarded" not in msgs
     # polarity: `if FAULTS.active: return` exits on the ARMED path —
     # it must NOT count as a guard for what follows; and the else
     # branch of a gate test is the gate-OFF path
