@@ -2944,6 +2944,217 @@ def _structural_sharded_span_leg(mk_entries):
     }
 
 
+def phase_analytics():
+    """Device-side aggregate analytics contract (ISSUE 19,
+    docs/search-analytics.md):
+
+      - ingest: a paired native-summary corpus (client + server rows of
+        each edge in the same push, unique span ids) through the batched
+        device reduction vs the per-span Python walk — the registries
+        must come out BYTE-identical (exposition, LRU order, pairing
+        store) and the batched path >= 5x the walk's rows/s (hard floor
+        below the target for shared-CPU noise; exact ratio recorded);
+      - query: ?agg=red answers over the serving path must equal a
+        plain-python reference aggregator exactly, and the aggregate's
+        marginal cost vs the same queries without ?agg= is recorded.
+
+    Runs with the gate flipped per leg; the standard `_breaker` /
+    `device_wedged` riders label any mid-run trip.
+    """
+    import bisect as _bisect
+    import struct as _struct
+    import tempfile
+
+    from tempo_tpu import tempopb
+    from tempo_tpu.backend.local import LocalBackend
+    from tempo_tpu.db import TempoDB, TempoDBConfig
+    from tempo_tpu.modules.generator import (MetricsGenerator,
+                                             ServiceGraphProcessor,
+                                             SpanMetricsProcessor)
+    from tempo_tpu.search.analytics import ANALYTICS, MS_BUCKETS, attach_agg
+    from tempo_tpu.search.data import SearchData, encode_search_data
+
+    n_rows = int(os.environ.get("BENCH_ANALYTICS_ROWS", 8192))
+    n_pushes = int(os.environ.get("BENCH_ANALYTICS_PUSHES", 10))
+    floor = float(os.environ.get("BENCH_ANALYTICS_FLOOR", 4.0))
+    q_entries = int(os.environ.get("BENCH_ANALYTICS_ENTRIES", 4096))
+    q_rounds = int(os.environ.get("BENCH_ANALYTICS_ROUNDS", 3))
+
+    # ---- ingest leg -------------------------------------------------
+    _ROW = _struct.Struct("<6IQQ8s8s")
+    svcs = [f"svc-{i:02d}" for i in range(8)]
+    ops = [f"op-{i}" for i in range(4)]
+    strs = svcs + ops
+
+    def mk_push(seed):
+        """client+server rows of each edge in ONE push, globally unique
+        span ids — every pair completes in-batch, the walk's hot path."""
+        rng = np.random.default_rng(3000 + seed)
+        tids = [rng.bytes(16) for _ in range(256)]
+        rows = []
+        sid = seed * n_rows + 1
+        for _ in range(n_rows // 2):
+            ti = int(rng.integers(0, len(tids)))
+            name = len(svcs) + int(rng.integers(0, len(ops)))
+            start = int(rng.integers(0, 1 << 40))
+            dur = int(rng.integers(1, 20_000_000_000))
+            csid = sid.to_bytes(8, "little")
+            ssid = (sid + 1).to_bytes(8, "little")
+            sid += 2
+            rows.append((ti, int(rng.integers(0, len(svcs))), name, 3,
+                         2 * int(rng.integers(0, 2)), 0, start,
+                         start + dur, csid, b"\x00" * 8))
+            rows.append((ti, int(rng.integers(0, len(svcs))),
+                         len(svcs) + int(rng.integers(0, len(ops))), 2,
+                         2 * int(rng.integers(0, 2)), 0, start,
+                         start + dur // 2, ssid, csid))
+        out = [_struct.pack("<I", len(strs))]
+        for s in strs:
+            b = s.encode()
+            out.append(_struct.pack("<H", len(b)))
+            out.append(b)
+        out.append(_struct.pack("<I", len(rows)))
+        for r in rows:
+            out.append(_ROW.pack(*r))
+        return b"".join(out), tids
+
+    pushes = [mk_push(s) for s in range(n_pushes)]
+
+    def feed(enabled):
+        ANALYTICS.configure(enabled=enabled, min_rows=1)
+        if enabled:  # compile warm-up outside the measurement
+            scratch = MetricsGenerator()
+            scratch.push_summary_blob("warm", *pushes[0])
+        gen = MetricsGenerator()
+        t0 = time.perf_counter()
+        for blob, tids in pushes:
+            gen.push_summary_blob("bench", blob, tids)
+        wall = time.perf_counter() - t0
+        _reg, procs = gen._instance("bench")
+        spm = next(p for p in procs
+                   if isinstance(p, SpanMetricsProcessor))
+        sgp = next(p for p in procs
+                   if isinstance(p, ServiceGraphProcessor))
+        snap = (gen.collect("bench"), list(spm._series),
+                {k: v[:3] for k, v in sgp._store.items()})
+        return wall, snap
+
+    walk_wall, walk_snap = feed(False)
+    dev_wall, dev_snap = feed(True)
+    ANALYTICS.configure(enabled=False)
+    assert dev_snap == walk_snap, (
+        "batched ingest registries diverged from the per-span walk")
+    speedup = walk_wall / max(dev_wall, 1e-9)
+    total_rows = n_rows * n_pushes
+    assert speedup >= floor, (
+        f"batched ingest only {speedup:.2f}x the walk "
+        f"(target 5x, floor {floor}x)")
+
+    ingest = {
+        "rows_per_push": n_rows,
+        "pushes": n_pushes,
+        "walk_rows_per_s": round(total_rows / max(walk_wall, 1e-9)),
+        "device_rows_per_s": round(total_rows / max(dev_wall, 1e-9)),
+        "speedup": round(speedup, 2),
+        "byte_identical": True,
+    }
+
+    # ---- query leg --------------------------------------------------
+    def mk_entries(s):
+        rng = np.random.default_rng(4000 + s)
+        out = []
+        for i in range(q_entries):
+            sd = SearchData(
+                trace_id=rng.bytes(16),
+                start_s=int(rng.integers(1, 5_000)),
+                end_s=int(rng.integers(5_000, 10_000)),
+                dur_ms=int(rng.integers(1, 30_000)),
+            )
+            sd.root_service = svcs[int(rng.integers(0, len(svcs)))]
+            sd.kvs = {"service.name": {sd.root_service},
+                      "env": {"prod" if i % 2 else "dev"}}
+            if rng.random() < 0.25:
+                sd.kvs["error"] = {"true"}
+            out.append(sd)
+        return out
+
+    def ref_series(corpus, pred):
+        series = {}
+        for sd in corpus:
+            if not pred(sd):
+                continue
+            s = series.setdefault(sd.root_service, {
+                "calls": 0, "errors": 0,
+                "hist": [0] * (len(MS_BUCKETS) + 1)})
+            s["calls"] += 1
+            s["errors"] += int("true" in sd.kvs.get("error", ()))
+            s["hist"][_bisect.bisect_left(MS_BUCKETS, sd.dur_ms)] += 1
+        return series
+
+    preds = {
+        "env=prod": lambda sd: "prod" in next(iter(sd.kvs["env"])),
+        "env=dev": lambda sd: "dev" in next(iter(sd.kvs["env"])),
+        "svc-03": lambda sd: "svc-03" == sd.root_service,
+    }
+    tag_of = {"env=prod": ("env", "prod"), "env=dev": ("env", "dev"),
+              "svc-03": ("service.name", "svc-03")}
+
+    with tempfile.TemporaryDirectory() as td:
+        be = LocalBackend(td + "/blocks")
+        db = TempoDB(be, td + "/wal", TempoDBConfig(
+            auto_mesh=False, search_analytics_enabled=True))
+        corpus = []
+        for s in range(3):
+            entries = sorted(mk_entries(s), key=lambda sd: sd.trace_id)
+            corpus.extend(entries)
+            db.write_block_direct(
+                "bench",
+                [(sd.trace_id, encode_search_data(sd), sd.start_s,
+                  sd.end_s) for sd in entries],
+                search_entries=entries)
+
+        def run(name, agg):
+            k, v = tag_of[name]
+            req = tempopb.SearchRequest()
+            req.limit = len(corpus)
+            req.tags[k] = v
+            if agg:
+                attach_agg(req, "red")
+            db.search("bench", req)        # warm
+            t0 = time.perf_counter()
+            for _ in range(q_rounds):
+                resp = db.search("bench", req).response()
+            return (time.perf_counter() - t0) / q_rounds, resp
+
+        queries = {}
+        agg_wall = plain_wall = 0.0
+        for name, pred in preds.items():
+            w_plain, _ = run(name, agg=False)
+            w_agg, resp = run(name, agg=True)
+            agg_wall += w_agg
+            plain_wall += w_plain
+            got = json.loads(resp.metrics.agg_json)
+            want = ref_series(corpus, pred)
+            assert got["series"] == want, (
+                f"?agg=red diverged from the host reference on {name}")
+            queries[name] = {
+                "matches": sum(s["calls"] for s in want.values()),
+                "plain_ms": round(w_plain * 1e3, 3),
+                "agg_ms": round(w_agg * 1e3, 3),
+            }
+
+        query = {
+            "entries": len(corpus),
+            "rounds": q_rounds,
+            "reference_identical": True,
+            "agg_overhead_ratio": round(
+                agg_wall / max(plain_wall, 1e-9), 3),
+            "queries": queries,
+        }
+
+    return {"ingest": ingest, "query": query}
+
+
 def phase_scale_10k():
     n_blocks = int(os.environ.get("BENCH_SCALE_BLOCKS", 10_000))
     if not n_blocks:
@@ -2978,6 +3189,7 @@ PHASES = {
     "ownership": phase_ownership,
     "packing": phase_packing,
     "structural": phase_structural,
+    "analytics": phase_analytics,
     "scale_10k": phase_scale_10k,
     "scale_large_blocks": phase_scale_large_blocks,
 }
@@ -3000,6 +3212,7 @@ PHASE_TIMEOUTS = {
     "ownership": 540.0,
     "packing": 420.0,
     "structural": 600.0,
+    "analytics": 420.0,
     "scale_10k": 900.0,
     "scale_large_blocks": 1200.0,
 }

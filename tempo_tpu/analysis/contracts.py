@@ -185,6 +185,12 @@ GATED_FUNCTIONS = (
                   "search_live_tier_enabled"),
     GatedFunction("tempo_tpu.search.live_tier", "LiveTier.notify_push",
                   ("enabled",), "search_live_tier_enabled"),
+    # device-side aggregate analytics: the ingest hook gates first —
+    # the default-off deployment's push-ack path pays one attribute
+    # read before any blob decode, clock read, or planner touch
+    GatedFunction("tempo_tpu.search.analytics",
+                  "AnalyticsEngine.consume_blob", ("enabled",),
+                  "search_analytics_enabled"),
 )
 
 GUARDED_CALLS = (
@@ -237,6 +243,12 @@ GUARDED_CALLS = (
                               "mark_poll_visible", "subscribe",
                               "unsubscribe", "notify_push"), (),
                 "enabled", "LIVE_TIER", "search_live_tier_enabled"),
+    # aggregate analytics hooks: the ingest feed and the query-side
+    # batch staging both only behind the one-attribute gate read (the
+    # batcher folds the gate into `want_agg` = enabled AND the request
+    # opted in — mentioning it in a test guards like the gate itself)
+    GuardedCall("ANALYTICS", ("consume_blob", "stage_for_batch"), (),
+                "enabled", "want_agg", "search_analytics_enabled"),
 )
 
 

@@ -70,8 +70,14 @@ _NP_HOST_FNS = {"asarray", "array", "frombuffer", "copy"}
 # hot dispatch selects the capacity-masking arm on it at trace time,
 # and keeping it static is what makes absorbs within a capacity tier
 # re-enter the same compiled kernel instead of retracing per size.
+# `buckets` is the analytics count kernel's two-limb latency-threshold
+# descriptor and `agg`/`n_keys` the ?agg= dense key-space sizes
+# (search/analytics.py): all three select the aggregate-reduction arm
+# and size its key range at trace time, so they belong to the static
+# jit key for exactly the `widths`/`plan` reason.
 _DESCRIPTOR_PARAMS = {"w", "dw", "widths", "plan", "span_sharded",
-                      "bucket", "shard_tail", "tier"}
+                      "bucket", "shard_tail", "tier", "buckets", "agg",
+                      "n_keys"}
 
 
 def _branches_on_param(helper: ast.AST, param: str) -> bool:

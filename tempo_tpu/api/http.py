@@ -294,6 +294,14 @@ class HTTPApi:
                     doc.get("metrics", {}).pop("queryStatsJson", None)
                 except ValueError:
                     pass
+            if resp.metrics.agg_json:
+                # the ?agg= aggregate, inlined as a real JSON object
+                # like queryStats above
+                try:
+                    doc["aggregates"] = json.loads(resp.metrics.agg_json)
+                    doc.get("metrics", {}).pop("aggJson", None)
+                except ValueError:
+                    pass
             return code, doc
         if path == PATH_SEARCH_TAGS:
             resp = self.app.queriers[0].search_tags(tenant)
@@ -370,6 +378,15 @@ class HTTPApi:
             # error, not a silent legacy-scan answer
             raise InvalidArgument("structural queries disabled "
                                   "(storage.search_structural_"
+                                  "enabled: true enables)")
+        from tempo_tpu.search.analytics import ANALYTICS, AGG_QUERY_TAG
+
+        if AGG_QUERY_TAG in req.tags and not ANALYTICS.enabled:
+            # ?agg= is gated per deployment (docs/search-analytics.md):
+            # a clear client error, not a silent plain-search answer
+            # missing the aggregate the caller asked for
+            raise InvalidArgument("search aggregation disabled "
+                                  "(storage.search_analytics_"
                                   "enabled: true enables)")
         # explain opt-in: ?explain=1 (parse_search_request) or the
         # X-Tempo-Explain header — the response then carries the

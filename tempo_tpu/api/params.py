@@ -97,6 +97,17 @@ def parse_search_request(query: dict[str, str]) -> tempopb.SearchRequest:
             except _ir.IRSyntaxError as e:
                 raise InvalidArgument(
                     f"bad structural query: {e}") from None
+        if query.get("agg"):
+            # ?agg= aggregate opt-in (docs/search-analytics.md):
+            # grammar validated HERE (a bad spec is a 400, never a deep
+            # 500), then stowed canonically in the reserved tag so it
+            # survives the frontend <-> querier round-trip
+            from tempo_tpu.search.analytics import attach_agg
+
+            try:
+                attach_agg(req, query["agg"])
+            except ValueError as e:
+                raise InvalidArgument(str(e)) from None
         return req
     except InvalidArgument:
         # already the dedicated client-error type with its own message

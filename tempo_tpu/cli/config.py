@@ -126,6 +126,14 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             "search_live_tier_max_entries", 4096),
         search_live_tail_max_subscriptions=storage.get(
             "search_live_tail_max_subscriptions", 16),
+        # device-side aggregate analytics (docs/search-analytics.md):
+        # batched RED/service-graph reductions on the generator feed +
+        # query-time ?agg=; false (default) is a true noop and the
+        # drained series are byte-identical either way
+        search_analytics_enabled=storage.get(
+            "search_analytics_enabled", False),
+        search_analytics_min_rows=storage.get(
+            "search_analytics_min_rows", 64),
         # packed HBM residency (docs/search-packed-residency.md):
         # bit-width-adaptive staged columns + in-kernel unpack; false
         # (default) is a true noop and byte-identical either way

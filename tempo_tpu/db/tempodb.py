@@ -224,6 +224,21 @@ class TempoDBConfig:
     # results. False (default) is a true noop: one attribute read per
     # staging site, byte-identical layout and results.
     search_packed_residency: bool = False
+    # device-side aggregate analytics (search/analytics.py,
+    # docs/search-analytics.md): the metrics generator's native
+    # summary-row feed batches into rolling pow2-tier device
+    # micro-batches — calls/errors by (service, span_name, kind,
+    # status), exact latency-bucket counts, and service-graph edge
+    # counts compute as ONE dense sorted-key reduction per push, and
+    # the host drains per-series deltas into the same ManagedRegistry
+    # handles (byte-identical to the per-span walk); at query time
+    # ?agg=red compiles group-by-service RED answers onto the fused
+    # scan dispatch. False (default) is a true noop: one attribute
+    # read per push / per search, walk and response byte-identical.
+    search_analytics_enabled: bool = False
+    # blobs under this many rows stay on the per-span walk (batch
+    # setup costs more than it saves on tiny pushes)
+    search_analytics_min_rows: int = 64
     # persistent XLA compilation cache directory for the SEARCH kernels
     # (jax_compilation_cache_dir): a cold process replays first-seen-
     # shape compiles from disk instead of re-paying XLA. Empty
@@ -433,6 +448,13 @@ class TempoDB:
             enabled=self.cfg.search_live_tier_enabled,
             max_entries=self.cfg.search_live_tier_max_entries,
             max_subscriptions=self.cfg.search_live_tail_max_subscriptions)
+        # device-side aggregate analytics: process-wide gate like the
+        # layers above (docs/search-analytics.md)
+        from tempo_tpu.search.analytics import ANALYTICS as _analytics
+
+        _analytics.configure(
+            enabled=self.cfg.search_analytics_enabled,
+            min_rows=self.cfg.search_analytics_min_rows)
         # owner-routed HBM placement: process-wide like the layers above
         # (docs/search-hbm-ownership.md)
         from tempo_tpu.search import ownership as _ownership

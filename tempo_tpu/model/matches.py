@@ -75,12 +75,14 @@ def matches(trace: tempopb.Trace, req: tempopb.SearchRequest) -> bool:
     if req.end and start_ns // 1_000_000_000 > req.end:
         return False
     if req.tags:
+        from tempo_tpu.search.analytics import AGG_QUERY_TAG
         from tempo_tpu.search.pipeline import EXHAUSTIVE_SEARCH_TAG
         from tempo_tpu.search.structural import STRUCTURAL_QUERY_TAG
 
         attrs = None
         for k, v in req.tags.items():
-            if k in (EXHAUSTIVE_SEARCH_TAG, STRUCTURAL_QUERY_TAG):
+            if k in (EXHAUSTIVE_SEARCH_TAG, STRUCTURAL_QUERY_TAG,
+                     AGG_QUERY_TAG):
                 continue  # in-band flags, not tag predicates
             if attrs is None:
                 attrs = list(_iter_all_attrs(trace))

@@ -22,12 +22,14 @@ from __future__ import annotations
 import struct
 import threading
 import time
+from collections import OrderedDict
 
 from tempo_tpu import tempopb
 
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 from tempo_tpu.observability.metrics import Registry, Counter, Histogram
+from tempo_tpu.search.analytics import ANALYTICS
 from tempo_tpu.search.data import _any_value_str
 
 LATENCY_BUCKETS_S = (0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
@@ -41,7 +43,9 @@ class SpanMetricsProcessor:
         self.latency = Histogram("traces_spanmetrics_latency",
                                  "span latency (s)",
                                  buckets=LATENCY_BUCKETS_S, registry=registry)
-        self._series: dict[tuple, tuple] = {}  # bound-handle cache
+        # bound-handle cache, LRU by last touch: the runaway-cardinality
+        # cap evicts the COLDEST series, not the oldest-created one
+        self._series: OrderedDict[tuple, tuple] = OrderedDict()
 
     # enum int → name, resolved once: proto .Name() does a descriptor
     # lookup per call, and this runs per SPAN on the ack path
@@ -49,6 +53,29 @@ class SpanMetricsProcessor:
                    for v in tempopb.Span.SpanKind.DESCRIPTOR.values}
     _STATUS_NAMES = {v.number: v.name
                      for v in tempopb.Status.StatusCode.DESCRIPTOR.values}
+
+    def _series_touch(self, sk: tuple) -> tuple:
+        """Bound handles for one (service, span_name, kind, status)
+        series, LRU-touched: a hit moves the series to the hot end so
+        the cap evicts the least-recently-SEEN series — the old
+        ``pop(next(iter(...)))`` was FIFO insertion order and rebuilt
+        hot series under churn."""
+        series = self._series
+        hit = series.get(sk)
+        if hit is not None:
+            series.move_to_end(sk)
+            return hit
+        svc, name, kind, status = sk
+        labels = dict(
+            service=svc, span_name=name,
+            span_kind=self._KIND_NAMES.get(kind, str(kind)),
+            status_code=self._STATUS_NAMES.get(status, str(status)),
+        )
+        hit = series[sk] = (self.calls.labels(**labels),
+                            self.latency.labels(**labels))
+        while len(series) > 65_536:  # runaway-cardinality cap
+            series.popitem(last=False)
+        return hit
 
     def consume(self, batch: tempopb.ResourceSpans) -> None:
         svc = ""
@@ -58,24 +85,10 @@ class SpanMetricsProcessor:
                 # service.name ('true', '123') must yield the same series
                 # as search-data extraction and the native summary feed
                 svc = _any_value_str(kv.value)
-        kind_names, status_names = self._KIND_NAMES, self._STATUS_NAMES
-        series = self._series  # (svc, name, kind, status) → bound handles
         for ss in batch.scope_spans:
             for span in ss.spans:
-                sk = (svc, span.name, span.kind, span.status.code)
-                hit = series.get(sk)
-                if hit is None:
-                    labels = dict(
-                        service=svc, span_name=span.name,
-                        span_kind=kind_names.get(span.kind, str(span.kind)),
-                        status_code=status_names.get(span.status.code,
-                                                     str(span.status.code)),
-                    )
-                    hit = series[sk] = (self.calls.labels(**labels),
-                                        self.latency.labels(**labels))
-                    while len(series) > 65_536:  # runaway-cardinality cap
-                        series.pop(next(iter(series)))
-                c, h = hit
+                c, h = self._series_touch(
+                    (svc, span.name, span.kind, span.status.code))
                 c.inc()
                 dur_s = max(0, span.end_time_unix_nano
                             - span.start_time_unix_nano) / 1e9
@@ -83,23 +96,10 @@ class SpanMetricsProcessor:
 
     def consume_rows(self, strs, rows, tids) -> None:
         """Native summary-row feed — same series as consume()."""
-        kind_names, status_names = self._KIND_NAMES, self._STATUS_NAMES
-        series = self._series
         for (_ti, svc_i, name_i, kind, status, _flags,
              start, end, _sid, _pid) in rows:
-            sk = (strs[svc_i], strs[name_i], kind, status)
-            hit = series.get(sk)
-            if hit is None:
-                labels = dict(
-                    service=sk[0], span_name=sk[1],
-                    span_kind=kind_names.get(kind, str(kind)),
-                    status_code=status_names.get(status, str(status)),
-                )
-                hit = series[sk] = (self.calls.labels(**labels),
-                                    self.latency.labels(**labels))
-                while len(series) > 65_536:
-                    series.pop(next(iter(series)))
-            c, h = hit
+            c, h = self._series_touch(
+                (strs[svc_i], strs[name_i], kind, status))
             c.inc()
             h.observe(max(0, end - start) / 1e9)
 
@@ -118,8 +118,15 @@ class ServiceGraphProcessor:
         self.latency = Histogram("traces_service_graph_request_seconds",
                                  "edge client latency (s)",
                                  buckets=LATENCY_BUCKETS_S, registry=registry)
+        self.expired_total = Counter(
+            "traces_servicegraph_expired_total",
+            "unpaired edges dropped by the expiry sweep before their "
+            "partner span arrived", registry=registry)
         self.wait_s = wait_s
         self.max_items = max_items
+        # each sweep evicts at most this many entries — a burst of
+        # unpaired edges must not stall the ack path under the lock
+        self.max_expire_per_sweep = 1024
         self._store: dict[tuple, tuple] = {}  # key -> (kind, svc, span, t)
         self._lock = threading.Lock()
         self.expired = 0
@@ -143,12 +150,7 @@ class ServiceGraphProcessor:
                     self._pair(key, "server", svc,
                                (span.status.code, span.start_time_unix_nano,
                                 span.end_time_unix_nano), now)
-        # amortize: an O(store) expiry sweep per BATCH was a steady tax
-        # on the ack path; unpaired edges only need to age out at wait_s
-        # granularity, so sweep at most once per wait_s/4
-        if now - self._last_expire >= self.wait_s / 4:
-            self._last_expire = now
-            self._expire(now)
+        self._maybe_expire(now)
 
     def consume_rows(self, strs, rows, tids) -> None:
         """Native summary-row feed: same pairing store as consume().
@@ -164,13 +166,20 @@ class ServiceGraphProcessor:
             elif kind == 2:  # SPAN_KIND_SERVER
                 self._pair((tids[ti], pid), "server", strs[svc_i],
                            (status, start, end), now)
-        if now - self._last_expire >= self.wait_s / 4:
-            self._last_expire = now
-            self._expire(now)
+        self._maybe_expire(now)
 
     def _pair(self, key, kind, svc, surrogate, now) -> None:
-        """surrogate: (status_code, start_ns, end_ns) — all the edge
-        emission needs; storing it beats serializing whole spans."""
+        em = self._pair_collect(key, kind, svc, surrogate, now)
+        if em is not None:
+            self._emit(em)
+
+    def _pair_collect(self, key, kind, svc, surrogate, now):
+        """One pairing-store round-trip; surrogate is (status_code,
+        start_ns, end_ns) — all the edge emission needs. Returns the
+        emission tuple (client_svc, server_svc, c_status, s_status,
+        c_start, c_end) when the pair completed, else None — the
+        batched analytics path collects emissions and counts them in
+        one pass, the walk emits each immediately via _pair."""
         with self._lock:
             other = self._store.get(key)
             if other is None or other[0] == kind:
@@ -179,24 +188,20 @@ class ServiceGraphProcessor:
                     # loss: expired entries may be squatting the slots —
                     # sweep NOW and retry the insert (inline expiry, the
                     # lock is already held)
-                    dead = [k for k, v in self._store.items()
-                            if now - v[3] > self.wait_s]
-                    for k in dead:
-                        del self._store[k]
-                    self.expired += len(dead)
+                    self._sweep_locked(now)
                 if len(self._store) < self.max_items:
                     self._store[key] = (kind, svc, surrogate, now)
-                return
+                return None
             del self._store[key]
         o_kind, o_svc, o_sur, _ = other
         if kind == "client":
-            client_svc, server_svc = svc, o_svc
             c_status, c_start, c_end = surrogate
-            s_status = o_sur[0]
-        else:
-            client_svc, server_svc = o_svc, svc
-            c_status, c_start, c_end = o_sur
-            s_status = surrogate[0]
+            return (svc, o_svc, c_status, o_sur[0], c_start, c_end)
+        c_status, c_start, c_end = o_sur
+        return (o_svc, svc, c_status, surrogate[0], c_start, c_end)
+
+    def _emit(self, em) -> None:
+        client_svc, server_svc, c_status, s_status, c_start, c_end = em
         labels = dict(client=client_svc, server=server_svc)
         self.requests.inc(**labels)
         ERR = tempopb.Status.STATUS_CODE_ERROR
@@ -204,13 +209,34 @@ class ServiceGraphProcessor:
             self.failed.inc(**labels)
         self.latency.observe(max(0, c_end - c_start) / 1e9, **labels)
 
+    def _sweep_locked(self, now) -> None:
+        """One bounded expiry sweep (lock held): at most
+        max_expire_per_sweep evictions per call, booked to the
+        per-tenant traces_servicegraph_expired_total counter."""
+        dead = []
+        limit = self.max_expire_per_sweep
+        for k, v in self._store.items():
+            if now - v[3] > self.wait_s:
+                dead.append(k)
+                if len(dead) >= limit:
+                    break
+        for k in dead:
+            del self._store[k]
+        if dead:
+            self.expired += len(dead)
+            self.expired_total.inc(len(dead))
+
+    def _maybe_expire(self, now) -> None:
+        # amortize: an O(store) expiry sweep per BATCH was a steady tax
+        # on the ack path; unpaired edges only need to age out at wait_s
+        # granularity, so sweep at most once per wait_s/4
+        if now - self._last_expire >= self.wait_s / 4:
+            self._last_expire = now
+            self._expire(now)
+
     def _expire(self, now) -> None:
         with self._lock:
-            dead = [k for k, v in self._store.items()
-                    if now - v[3] > self.wait_s]
-            for k in dead:
-                del self._store[k]
-            self.expired += len(dead)
+            self._sweep_locked(now)
 
 
 class ManagedRegistry(Registry):
@@ -295,6 +321,10 @@ class MetricsGenerator:
             off += ln
         (n_rows,) = _U32.unpack_from(blob, off)
         off += 4
+        if ANALYTICS.enabled:
+            if ANALYTICS.consume_blob(procs, strs, blob, off, n_rows,
+                                      tids):
+                return  # batched device reduction fed the same series
         rows = list(self._ROW.iter_unpack(
             blob[off:off + n_rows * self._ROW.size]))
         for p in procs:

@@ -177,6 +177,33 @@ class Histogram(_Metric):
             if ex is not None:
                 self._exemplars.setdefault(k, {})[i] = (ex, v, time.time())
 
+    def observe_bulk(self, bins: list[int], vals: list[float],
+                     **labels: LabelValue) -> None:
+        self._observe_bulk_key(self._key(labels), bins, vals)
+
+    def _observe_bulk_key(self, k: SeriesKey, bins: list[int],
+                          vals: list[float]) -> None:
+        # batched drain for the device analytics path: per-bin tallies
+        # arrive pre-counted, and the float sum folds sequentially in
+        # row order under one lock hold — the resulting series is
+        # byte-identical to the same values through observe() one by one
+        ex = _exemplar_ref()
+        with self._lock:
+            counts = self._counts.get(k)
+            if counts is None:
+                counts = self._counts[k] = [0] * (len(self.buckets) + 1)
+            for i, n in enumerate(bins):
+                counts[i] += n
+            s = self._sums.get(k, 0)
+            for v in vals:
+                s = s + v
+            self._sums[k] = s
+            if ex is not None and vals:
+                exs = self._exemplars.setdefault(k, {})
+                for v in vals:
+                    exs[bisect_left(self.buckets, v)] = (ex, v,
+                                                         time.time())
+
     def labels(self, **labels: LabelValue) -> "_BoundHistogram":
         return _BoundHistogram(self, self._key(labels))
 
@@ -247,6 +274,9 @@ class _BoundHistogram:
 
     def observe(self, v: float) -> None:
         self._m._observe_key(self._k, v)
+
+    def observe_bulk(self, bins: list[int], vals: list[float]) -> None:
+        self._m._observe_bulk_key(self._k, bins, vals)
 
 
 class _Timer:
@@ -411,6 +441,16 @@ live_tail_dropped = Counter(
     "consumer's bounded queue overflowed, oldest dropped; cap: "
     "subscribe rejected at search_live_tail_max_subscriptions)")
 
+# ---- device-side aggregate analytics (search/analytics.py) ----
+search_analytics_dispatches = Counter(
+    "tempo_search_analytics_dispatches_total",
+    "aggregate-analytics count dispatches (route=device: the dense "
+    "count kernel ran on the accelerator; host: breaker-open or "
+    "overflow fallback computed the byte-identical numpy counts)")
+search_analytics_staged_bytes = Gauge(
+    "tempo_search_analytics_staged_bytes",
+    "bytes staged to the device for the most recent analytics "
+    "micro-batch (pow2-tier padded row columns)")
 # ---- owner-routed HBM (search/ownership.py) ----
 hbm_owner_generation = Gauge(
     "tempo_search_hbm_owner_generation",

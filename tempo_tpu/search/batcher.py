@@ -47,6 +47,7 @@ from tempo_tpu.observability import tracing
 
 from . import query_stats
 from . import structural as _structural
+from .analytics import ANALYTICS, agg_requested
 from .engine import DEFAULT_TOP_K, fetch_coalesced_out, resolve_top_k, \
     start_fetch
 from .ownership import OWNERSHIP
@@ -72,7 +73,10 @@ def host_scan(host, mq, top_k: int):
     The CPU-staged arrays memoize on the HostBatch (`_cpu_staged`), so
     a wedged-device soak re-stages each batch once, not per query; the
     memo dies with the host-tier entry. Returns the drain-format host
-    tuple (count, inspected, scores, idx)."""
+    tuple (count, inspected, scores, idx), plus the dense ?agg= counts
+    when the query carries an agg_stage — the same integer reduction
+    the device kernels run, so the host route's aggregate is
+    byte-identical by construction."""
     import jax.numpy as jnp
 
     from .engine import cpu_pinned
@@ -104,6 +108,16 @@ def host_scan(host, mq, top_k: int):
                     span_dev = {k: jnp.asarray(v)
                                 for k, v in span_host.items()}
                     host._cpu_span_staged = span_dev
+        # ?agg= composite keys, CPU-pinned and memoized like the page
+        # arrays above (the AggStage itself is shared with the device
+        # route via the batch memo — only the placement differs)
+        agg_stage = getattr(mq, "agg_stage", None)
+        agg = entry_agg = None
+        if agg_stage is not None:
+            agg = agg_stage.n_keys
+            entry_agg = getattr(host, "_cpu_agg_staged", None)
+            if entry_agg is None:
+                entry_agg = host._cpu_agg_staged = agg_stage.cpu()
         out = multi_scan_kernel(
             dev["kv_key"], dev["kv_val"], dev["entry_start"],
             dev["entry_end"], dev["entry_dur"], dev["entry_valid"],
@@ -112,15 +126,17 @@ def host_scan(host, mq, top_k: int):
             jnp.uint32(mq.win_start),
             jnp.uint32(min(mq.win_end, 0xFFFFFFFF)),
             None, None, dev.get("entry_dur_res"),
-            span_dev, s_tables,
+            span_dev, s_tables, entry_agg,
             n_terms=mq.n_terms, top_k=top_k,
             # the host tier stages the SAME packed layout (stack_host
             # packs before the tiers fork), so the fallback kernel
             # unpacks with the batch's own width descriptor
-            widths=getattr(host, "widths", None), plan=plan)
-        count, inspected, scores, idx = out
+            widths=getattr(host, "widths", None), plan=plan, agg=agg)
+        count, inspected, scores, idx, *ext = out
         res = (int(count), int(inspected), np.asarray(scores),
                np.asarray(idx))
+        if ext:
+            res += (np.asarray(ext[0]),)
     profile.observe_stage("execute", "host_fallback",
                           time.perf_counter() - t0)
     return res
@@ -263,9 +279,14 @@ class _FusedSlice:
         self._qi = qi
 
     def __iter__(self):
-        counts, inspected, scores, idx = self._shared.host()
+        counts, inspected, scores, idx, *ext = self._shared.host()
         qi = self._qi
-        return iter((int(counts[qi]), inspected, scores[qi], idx[qi]))
+        res = (int(counts[qi]), inspected, scores[qi], idx[qi])
+        if ext:
+            # fused ?agg= counts demux like scores: row qi of the [Q, K]
+            # dense-count matrix belongs to this member
+            res += (ext[0][qi],)
+        return iter(res)
 
 
 class QueryCoalescer:
@@ -371,6 +392,12 @@ class QueryCoalescer:
                 self._run(grp)
                 return fut
             key = skey
+        if getattr(mq, "agg_stage", None) is not None:
+            # ?agg= members group apart from plain peers: the agg static
+            # changes the fused kernel's jit key, and a mixed group
+            # would make the no-agg hot path's compiled shape depend on
+            # whichever member happened to join the window
+            key = key + ("agg",)
         flush_now = None
         with self._lock:
             grp = self._pending.get(key)
@@ -1220,11 +1247,15 @@ class BlockBatcher:
                 t0d = _time.perf_counter()
 
                 def _sync(fut=fut):
-                    count, inspected, scores, idx = fut
-                    return (int(count), int(inspected),
-                            np.asarray(scores), np.asarray(idx))
+                    count, inspected, scores, idx, *ext = fut
+                    out = (int(count), int(inspected),
+                           np.asarray(scores), np.asarray(idx))
+                    if ext:
+                        # dense ?agg= counts ride the same sync
+                        out += (np.asarray(ext[0]),)
+                    return out
 
-                count, inspected, scores, idx = \
+                count, inspected, scores, idx, *agg_counts = \
                     robustness.GUARD.run("d2h", _sync)
             except robustness.DeadlineExceeded:
                 # the request's budget ran out mid-drain: the answer
@@ -1299,6 +1330,8 @@ class BlockBatcher:
             results.metrics.inspected_traces += max(0, inspected)
             for m in self.engine.results(cached.batch, mq, scores, idx):
                 results.add(m)
+            if agg_counts:
+                results.add_agg(mq.agg_stage.decode(agg_counts[0]))
             stages["drain"] += _time.perf_counter() - t0
 
         def _skip_reason_counts(skip, reasons) -> dict:
@@ -1380,6 +1413,12 @@ class BlockBatcher:
             return pre
 
         sig = _predicate_sig(req)
+        # ?agg= opt-in (gated: one attribute read + one dict probe while
+        # analytics is off). The AggStage itself is staged lazily at
+        # dispatch time, memoized per batch — prepare() memos stay
+        # shareable with non-agg requests because `pre` carries no agg
+        # state
+        want_agg = ANALYTICS.enabled and agg_requested(req)
 
         def host_route(group, gkey, hdr_reasons, book_skips=True):
             """Scan one group ENTIRELY on the host path: this member is
@@ -1431,9 +1470,11 @@ class BlockBatcher:
                     win_start=pre["win_start"], win_end=pre["win_end"],
                     limit=req.limit or 20, n_terms=pre["n_terms"],
                     structural=pre.get("structural"))
+                if want_agg:
+                    mq.agg_stage = ANALYTICS.stage_for_batch(host)
                 if qs is not None and pre.get("structural") is not None:
                     qs.add_structural(pre["structural"])
-                count, inspected, scores, idx = host_scan(
+                count, inspected, scores, idx, *agg_counts = host_scan(
                     host, mq, resolve_top_k(self.engine.top_k, mq.limit))
                 # the CPU-pinned copies host_scan memoized are real RAM:
                 # charge them to the host-tier budget (evicting the
@@ -1471,6 +1512,8 @@ class BlockBatcher:
                                       or host.cat_nbytes))
                 for m in self.engine.results(host, mq, scores, idx):
                     results.add(m)
+                if agg_counts:
+                    results.add_agg(mq.agg_stage.decode(agg_counts[0]))
             finally:
                 stages["host_fallback"] += _time.perf_counter() - t0
 
@@ -1683,6 +1726,12 @@ class BlockBatcher:
                     val_hits=pre.get("val_hits"),
                     block_group=pre.get("block_group"),
                     structural=pre.get("structural"))
+                if want_agg:
+                    # memoized per batch: repeat ?agg= queries over a
+                    # resident batch pay one attribute read, and every
+                    # route (direct, coalesced, host resubmit) decodes
+                    # against the same service table
+                    mq.agg_stage = ANALYTICS.stage_for_batch(cached.batch)
                 if qs is not None and pre.get("structural") is not None:
                     # explain plan registration: node cost weights merge
                     # across this query's groups; measured device time

@@ -299,11 +299,13 @@ def search_data_matches(sd: SearchData, req) -> bool:
         return False
     if req.end and sd.start_s > req.end:
         return False
+    from .analytics import AGG_QUERY_TAG
     from .pipeline import EXHAUSTIVE_SEARCH_TAG
     from .structural import STRUCTURAL_QUERY_TAG
 
     for k, v in req.tags.items():
-        if k in (EXHAUSTIVE_SEARCH_TAG, STRUCTURAL_QUERY_TAG):
+        if k in (EXHAUSTIVE_SEARCH_TAG, STRUCTURAL_QUERY_TAG,
+                 AGG_QUERY_TAG):
             continue  # in-band flags: not themselves tag predicates
         vs = sd.kvs.get(k)
         if not vs:

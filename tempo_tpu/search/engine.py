@@ -252,11 +252,16 @@ def start_fetch(arrays) -> None:
 
 
 def fetch_scan_out(out):
-    """(count, inspected, scores, idx) device arrays → host values with a
-    single synchronization point."""
+    """(count, inspected, scores, idx[, agg]) device arrays → host
+    values with a single synchronization point. The optional trailing
+    aggregate histogram (?agg= dispatches) rides the same sync."""
     start_fetch(out)
-    count, inspected, scores, idx = out
-    return int(count), int(inspected), np.asarray(scores), np.asarray(idx)
+    count, inspected, scores, idx, *ext = out
+    fetched = (int(count), int(inspected), np.asarray(scores),
+               np.asarray(idx))
+    if ext:
+        return fetched + (np.asarray(ext[0]),)
+    return fetched
 
 
 def resolve_top_k(base: int, limit: int) -> int:
@@ -272,13 +277,16 @@ def resolve_top_k(base: int, limit: int) -> int:
 
 def fetch_coalesced_out(out):
     """Query-axis variant of fetch_scan_out: (counts [Q], inspected,
-    scores [Q,k], idx [Q,k]) device arrays → host values with a single
-    synchronization point. The per-query demux slices the host arrays —
-    one D2H wait for the whole coalesced group, not Q."""
+    scores [Q,k], idx [Q,k][, agg [Q,K]]) device arrays → host values
+    with a single synchronization point. The per-query demux slices the
+    host arrays — one D2H wait for the whole coalesced group, not Q."""
     start_fetch(out)
-    counts, inspected, scores, idx = out
-    return (np.asarray(counts), int(inspected),
-            np.asarray(scores), np.asarray(idx))
+    counts, inspected, scores, idx, *ext = out
+    fetched = (np.asarray(counts), int(inspected),
+               np.asarray(scores), np.asarray(idx))
+    if ext:
+        return fetched + (np.asarray(ext[0]),)
+    return fetched
 
 
 _TOPK_CHUNK = 8192

@@ -473,6 +473,9 @@ class MultiQuery:
     # static plan + dynamic tables ANDed into the entry mask by the
     # kernels; None = the legacy pytree and executables exactly
     structural: object = None
+    # staged ?agg= stage (analytics.AggStage) — batch-scoped composite
+    # keys + service table; None = no aggregate stage compiled in
+    agg_stage: object = None
 
 
 def _dict_groups(blocks: list[ColumnarPages], cache_on=None):
@@ -648,6 +651,9 @@ class CoalescedQuery:
     # static plan + [Q, ...]-stacked structural parameter tables. None
     # = the legacy pytree and executables exactly.
     structural: object = None
+    # batch-scoped ?agg= stage (analytics.AggStage), shared across the
+    # query axis — set when any member requested aggregation
+    agg_stage: object = None
 
 
 def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
@@ -749,11 +755,15 @@ def stack_queries(mqs: list[MultiQuery]) -> CoalescedQuery:
                                     (0, Vm - h.shape[2]))))
             block_group[qi] = mq.block_group
         val_hits = jnp.stack(rows)                  # [Q, Gm, T, Vm]
+    aggs = [mq for mq in mqs if getattr(mq, "agg_stage", None) is not None]
     return CoalescedQuery(
         term_keys=term_keys, val_ranges=val_ranges, term_active=term_active,
         dur_lo=dur_lo, dur_hi=dur_hi, win_start=win_start, win_end=win_end,
         n_terms=T, n_queries=Qn, val_hits=val_hits, block_group=block_group,
-        structural=stacked_st)
+        structural=stacked_st,
+        # members share one batch, so their AggStage is the same
+        # memoized object — any requester turns the stage on
+        agg_stage=aggs[0].agg_stage if aggs else None)
 
 
 def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
@@ -825,15 +835,30 @@ def multi_entry_mask(kv_key, kv_val, entry_start, entry_end, entry_dur,
     return mask
 
 
+def agg_entry_counts(mask, entry_agg, n_keys: int):
+    """Dense aggregate counts over the verdict mask: entries the final
+    mask accepts contribute their staged composite key (see
+    search/analytics.py — (service, latency-bucket, error) for
+    ?agg=red), rejected entries take the sentinel ``n_keys`` one past
+    the counted range, and sort + searchsorted-diff produces the [K]
+    histogram — the scatter-free dense-count idiom, fused into the
+    same dispatch as the scan's mask."""
+    key = jnp.where(mask, entry_agg, jnp.int32(n_keys)).reshape(-1)
+    skey = jax.lax.sort(key)
+    edges = jnp.searchsorted(skey,
+                             jnp.arange(n_keys + 1, dtype=jnp.int32))
+    return (edges[1:] - edges[:-1]).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths",
-                                             "plan"))
+                                             "plan", "agg"))
 def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                       entry_valid, page_block, term_keys, val_ranges,
                       dur_lo, dur_hi, win_start, win_end,
                       val_hits=None, block_group=None, entry_dur_res=None,
-                      span_cols=None, s_tables=None,
+                      span_cols=None, s_tables=None, entry_agg=None,
                       *, n_terms: int, top_k: int, widths=None,
-                      plan=None):
+                      plan=None, agg=None):
     mask = multi_entry_mask(
         kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
         page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
@@ -853,21 +878,28 @@ def multi_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
     count = jnp.sum(mask, dtype=jnp.int32)
     inspected = jnp.sum(entry_valid & (page_block >= 0)[:, None], dtype=jnp.int32)
     scores, idx = masked_topk(mask, entry_start, top_k)
+    if agg is not None:
+        # `agg` (static, the dense key-space size K — part of the jit
+        # key like `plan`) adds the ?agg= reduction as one more stage
+        # gated by the SAME verdict mask
+        return (count, inspected, scores, idx,
+                agg_entry_counts(mask, entry_agg, agg))
     return count, inspected, scores, idx
 
 
 @functools.partial(jax.jit,
                    static_argnames=("mesh", "n_terms", "top_k", "widths",
-                                    "plan", "span_sharded", "shard_tail"))
+                                    "plan", "span_sharded", "shard_tail",
+                                    "agg"))
 def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                            entry_dur, entry_valid, page_block, term_keys,
                            val_ranges, dur_lo, dur_hi, win_start, win_end,
                            val_hits=None, block_group=None,
                            entry_dur_res=None,
-                           span_cols=None, s_tables=None,
+                           span_cols=None, s_tables=None, entry_agg=None,
                            *, n_terms: int, top_k: int, widths=None,
                            plan=None, span_sharded=False,
-                           shard_tail: int = 0):
+                           shard_tail: int = 0, agg=None):
     """Multi-block scan sharded over the mesh's scan axis: the stacked
     page axis (blocks × pages — the corpus 'sequence' axis, SURVEY.md §5)
     splits across devices; the [B,...] term tables replicate; counts
@@ -914,7 +946,7 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                  entry_valid, page_block, term_keys, val_ranges,
                  dur_lo, dur_hi, win_start, win_end, val_hits,
                  block_group, entry_dur_res, struct_mask,
-                 sh_span_cols, sh_s_tables):
+                 sh_span_cols, sh_s_tables, entry_agg):
         if shard_tail:
             # remainder-shard layout descriptor (static, part of the
             # jit key like `widths`): the trailing `shard_tail` pad
@@ -958,6 +990,13 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
         all_idx = jax.lax.all_gather(gidx, SCAN_AXIS).reshape(-1)
         k = min(top_k, all_scores.shape[0])
         top_scores, pos = jax.lax.top_k(all_scores, k)
+        if agg is not None:
+            # per-shard dense counts over the local page slice psum to
+            # the global histogram — integer adds, so the distributed
+            # answer is bit-equal to the single-device one
+            agg_counts = jax.lax.psum(
+                agg_entry_counts(mask, entry_agg, agg), SCAN_AXIS)
+            return count, inspected, top_scores, all_idx[pos], agg_counts
         return count, inspected, top_scores, all_idx[pos]
 
     from tempo_tpu.parallel.mesh import shard_map_compat
@@ -970,28 +1009,30 @@ def dist_multi_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
         # page axis. Sharded span columns split on their leading axis
         # (the chunk-per-shard span axis / the page axis of the entry
         # range columns); the structural parameter tables replicate.
+        # The staged ?agg= composite keys shard with their pages.
         in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 8
-        + (P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS), P()),
-        out_specs=(P(), P(), P(), P()),
+        + (P(SCAN_AXIS), P(SCAN_AXIS), P(SCAN_AXIS), P(), P(SCAN_AXIS)),
+        out_specs=(P(), P(), P(), P())
+        + ((P(),) if agg is not None else ()),
         # all_gather+top_k yields identical values on every shard, but the
         # replication checker can't infer it through the gather
         check=False,
     )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
       page_block, term_keys, val_ranges, dur_lo, dur_hi, win_start,
       win_end, val_hits, block_group, entry_dur_res, struct_mask,
-      sh_span_cols, sh_s_tables)
+      sh_span_cols, sh_s_tables, entry_agg)
 
 
 @functools.partial(jax.jit, static_argnames=("n_terms", "top_k", "widths",
-                                             "plan"))
+                                             "plan", "agg"))
 def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                           entry_valid, page_block, term_keys, val_ranges,
                           term_active, dur_lo, dur_hi, win_start, win_end,
                           val_hits=None, block_group=None,
                           entry_dur_res=None, span_cols=None,
-                          s_tables=None,
+                          s_tables=None, entry_agg=None,
                           *, n_terms: int, top_k: int, widths=None,
-                          plan=None):
+                          plan=None, agg=None):
     """The query-axis variant of multi_scan_kernel: predicate tables are
     [Q, ...]-stacked and vmap lifts the per-query mask + top-k over the
     query axis — ONE dispatch serves Q concurrent requests over the same
@@ -1025,10 +1066,21 @@ def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
                 entry_dur_res, span_cols, st_t, plan=plan, widths=widths)
         count = jnp.sum(mask, dtype=jnp.int32)
         scores, idx = masked_topk(mask, entry_start, top_k)
+        if agg is not None:
+            # the staged composite keys are batch-global (entry_agg
+            # closes over, query-invariant like span_cols) — each
+            # query's verdict mask gates its own [K] dense counts
+            return (count, scores, idx,
+                    agg_entry_counts(mask, entry_agg, agg))
         return count, scores, idx
 
     # val_hits/block_group/s_tables are [Q,...]-stacked like the other
     # predicate tables (None vmaps as an empty pytree — no leaves)
+    if agg is not None:
+        counts, scores, idx, aggs = jax.vmap(one_query)(
+            term_keys, val_ranges, term_active, dur_lo, dur_hi,
+            win_start, win_end, val_hits, block_group, s_tables)
+        return counts, inspected, scores, idx, aggs
     counts, scores, idx = jax.vmap(one_query)(
         term_keys, val_ranges, term_active, dur_lo, dur_hi,
         win_start, win_end, val_hits, block_group, s_tables)
@@ -1037,16 +1089,18 @@ def coalesced_scan_kernel(kv_key, kv_val, entry_start, entry_end, entry_dur,
 
 @functools.partial(jax.jit,
                    static_argnames=("mesh", "n_terms", "top_k", "widths",
-                                    "plan", "span_sharded", "shard_tail"))
+                                    "plan", "span_sharded", "shard_tail",
+                                    "agg"))
 def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                                entry_dur, entry_valid, page_block, term_keys,
                                val_ranges, term_active, dur_lo, dur_hi,
                                win_start, win_end, val_hits=None,
                                block_group=None, entry_dur_res=None,
                                span_cols=None, s_tables=None,
+                               entry_agg=None,
                                *, n_terms: int, top_k: int, widths=None,
                                plan=None, span_sharded=False,
-                               shard_tail: int = 0):
+                               shard_tail: int = 0, agg=None):
     """Coalesced scan sharded over the mesh's scan axis: the page axis
     splits across devices, the [Q,...] query tables replicate, and the
     per-shard per-query top-k candidates all_gather into a per-query
@@ -1084,7 +1138,7 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                  entry_valid, page_block, term_keys, val_ranges,
                  term_active, dur_lo, dur_hi, win_start, win_end,
                  val_hits, block_group, entry_dur_res, struct_masks,
-                 sh_span_cols, sh_s_tables):
+                 sh_span_cols, sh_s_tables, entry_agg):
         if shard_tail:
             # remainder-shard ragged tail (see dist_multi_scan_kernel)
             pp = page_block.shape[0]
@@ -1113,12 +1167,22 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
                     widths=widths)
             count = jnp.sum(mask, dtype=jnp.int32)
             scores, idx = masked_topk(mask, entry_start, top_k)
+            if agg is not None:
+                return (count, scores, idx,
+                        agg_entry_counts(mask, entry_agg, agg))
             return count, scores, idx
 
-        counts, scores, idx = jax.vmap(one_query)(
-            term_keys, val_ranges, term_active, dur_lo, dur_hi,
-            win_start, win_end, val_hits, block_group, struct_masks,
-            sh_s_tables)
+        if agg is not None:
+            counts, scores, idx, agg_local = jax.vmap(one_query)(
+                term_keys, val_ranges, term_active, dur_lo, dur_hi,
+                win_start, win_end, val_hits, block_group, struct_masks,
+                sh_s_tables)
+            agg_counts = jax.lax.psum(agg_local, SCAN_AXIS)  # [Q, K]
+        else:
+            counts, scores, idx = jax.vmap(one_query)(
+                term_keys, val_ranges, term_active, dur_lo, dur_hi,
+                win_start, win_end, val_hits, block_group, struct_masks,
+                sh_s_tables)
         shard = jax.lax.axis_index(SCAN_AXIS).astype(jnp.int32)
         gidx = idx + shard * local_flat
         counts = jax.lax.psum(counts, SCAN_AXIS)
@@ -1131,6 +1195,8 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
         k = min(top_k, flat_scores.shape[-1])
         top_scores, pos = jax.lax.top_k(flat_scores, k)      # batched [Q,k]
         top_idx = jnp.take_along_axis(flat_idx, pos, axis=-1)
+        if agg is not None:
+            return counts, inspected, top_scores, top_idx, agg_counts
         return counts, inspected, top_scores, top_idx
 
     from tempo_tpu.parallel.mesh import shard_map_compat
@@ -1139,17 +1205,20 @@ def dist_coalesced_scan_kernel(mesh, kv_key, kv_val, entry_start, entry_end,
         shard_fn, mesh=mesh,
         # stacked structural verdicts [Q, P, E] shard on the PAGE axis
         # (second); sharded span columns on their leading axis; the
-        # stacked parameter tables replicate like the query tables
+        # stacked parameter tables replicate like the query tables; the
+        # staged ?agg= composite keys shard with their pages
         in_specs=(P(SCAN_AXIS),) * 7 + (P(),) * 9
-        + (P(SCAN_AXIS), P(None, SCAN_AXIS), P(SCAN_AXIS), P()),
-        out_specs=(P(), P(), P(), P()),
+        + (P(SCAN_AXIS), P(None, SCAN_AXIS), P(SCAN_AXIS), P(),
+           P(SCAN_AXIS)),
+        out_specs=(P(), P(), P(), P())
+        + ((P(),) if agg is not None else ()),
         # same stance as dist_multi_scan_kernel: the gather+top_k output
         # is replicated but the replication checker can't infer it
         check=False,
     )(kv_key, kv_val, entry_start, entry_end, entry_dur, entry_valid,
       page_block, term_keys, val_ranges, term_active, dur_lo, dur_hi,
       win_start, win_end, val_hits, block_group, entry_dur_res,
-      struct_masks, sh_span_cols, sh_s_tables)
+      struct_masks, sh_span_cols, sh_s_tables, entry_agg)
 
 
 class MultiBlockEngine:
@@ -1266,11 +1335,19 @@ class MultiBlockEngine:
                 s_tables = None if st is None else st.device_tables()
                 span_cols = (batch.span_device if st is not None
                              else None)
+                # ?agg= reduction (search/analytics.py): the staged
+                # per-entry composite keys ride the dispatch; the dense
+                # key-space size is the static plan-stage descriptor
+                agg_stage = getattr(mq, "agg_stage", None)
+                agg = None if agg_stage is None else agg_stage.n_keys
+                entry_agg = (None if agg_stage is None
+                             else agg_stage.device())
             widths = batch.widths
             args = (d["kv_key"], d["kv_val"], d["entry_start"],
                     d["entry_end"], d["entry_dur"], d["entry_valid"],
                     d["page_block"], tk, vr, dlo, dhi, ws, we, vh, bg,
-                    d.get("entry_dur_res"), span_cols, s_tables)
+                    d.get("entry_dur_res"), span_cols, s_tables,
+                    entry_agg)
             span_sharded = bool(st is not None and batch.span_sharded)
             shard_tail = self._shard_tail(batch, d)
             miss = rec.compile_check(
@@ -1279,7 +1356,7 @@ class MultiBlockEngine:
                  None if vh is None else (tuple(vh.shape), str(vh.dtype)),
                  widths, mq.n_terms, k,
                  None if st is None else st.shape_sig(), span_sharded,
-                 shard_tail,
+                 shard_tail, agg,
                  None if span_cols is None else
                  tuple(sorted((n, tuple(a.shape))
                               for n, a in span_cols.items()))))
@@ -1297,7 +1374,7 @@ class MultiBlockEngine:
                             self.mesh, *args, n_terms=mq.n_terms, top_k=k,
                             widths=widths, plan=plan,
                             span_sharded=span_sharded,
-                            shard_tail=shard_tail)
+                            shard_tail=shard_tail, agg=agg)
                 # fence AFTER releasing the collective lock: a fenced
                 # wait under dispatch_lock would serialize every other
                 # mesh dispatch behind this kernel's completion (the
@@ -1309,7 +1386,7 @@ class MultiBlockEngine:
                 return out
             with rec.stage(stage):
                 out = multi_scan_kernel(*args, n_terms=mq.n_terms, top_k=k,
-                                        widths=widths, plan=plan)
+                                        widths=widths, plan=plan, agg=agg)
                 rec.fence(out)
             return out
 
@@ -1354,6 +1431,14 @@ class MultiBlockEngine:
                 plan = None if st is None else st.plan
                 s_tables = None if st is None else st.device_tables()
                 span_cols = batch.span_device if st is not None else None
+                # ?agg= stage: batch-global staged keys shared across
+                # the fused query axis (any member requesting agg turns
+                # it on for the dispatch; non-requesters ignore their
+                # row of the [Q, K] output)
+                agg_stage = getattr(cq, "agg_stage", None)
+                agg = None if agg_stage is None else agg_stage.n_keys
+                entry_agg = (None if agg_stage is None
+                             else agg_stage.device())
             st_bytes = 0 if st is None else sum(
                 int(getattr(t, "nbytes", 0)) for t in st.tables
                 if t is not None)
@@ -1366,7 +1451,8 @@ class MultiBlockEngine:
             args = (d["kv_key"], d["kv_val"], d["entry_start"],
                     d["entry_end"], d["entry_dur"], d["entry_valid"],
                     d["page_block"], *tables, vh, bg,
-                    d.get("entry_dur_res"), span_cols, s_tables)
+                    d.get("entry_dur_res"), span_cols, s_tables,
+                    entry_agg)
             miss = rec.compile_check(
                 ("coalesced", self.mesh is not None, d["kv_key"].shape,
                  str(d["kv_key"].dtype), str(d["kv_val"].dtype),
@@ -1374,7 +1460,7 @@ class MultiBlockEngine:
                  None if vh is None else (tuple(vh.shape), str(vh.dtype)),
                  widths, cq.n_terms, top_k,
                  None if st is None else st.shape_sig(), span_sharded,
-                 shard_tail,
+                 shard_tail, agg,
                  None if span_cols is None else
                  tuple(sorted((n, tuple(a.shape))
                               for n, a in span_cols.items()))))
@@ -1390,7 +1476,7 @@ class MultiBlockEngine:
                             self.mesh, *args, n_terms=cq.n_terms,
                             top_k=top_k, widths=widths, plan=plan,
                             span_sharded=span_sharded,
-                            shard_tail=shard_tail)
+                            shard_tail=shard_tail, agg=agg)
                 # fence outside the collective lock (see
                 # _scan_async_impl — same lock-order stance)
                 with rec.stage(stage):
@@ -1399,7 +1485,7 @@ class MultiBlockEngine:
             with rec.stage(stage):
                 out = coalesced_scan_kernel(*args, n_terms=cq.n_terms,
                                             top_k=top_k, widths=widths,
-                                            plan=plan)
+                                            plan=plan, agg=agg)
                 rec.fence(out)
             return out
 

@@ -183,12 +183,16 @@ def _tags_sig(req) -> tuple:
     duration/window/limit are scalar passthroughs. The structural
     reserved tag is excluded like the exhaustive flag (it is not a term;
     its OWN compilation caches separately in search/structural.py), so
-    structural variants of one base predicate share the probe product."""
+    structural variants of one base predicate share the probe product.
+    The ?agg= reserved tag is likewise not a term — the aggregate stage
+    is batch-scoped, never per-predicate."""
+    from .analytics import AGG_QUERY_TAG
     from .structural import STRUCTURAL_QUERY_TAG
 
     return (tuple(sorted((k, v) for k, v in req.tags.items()
                          if k not in (EXHAUSTIVE_SEARCH_TAG,
-                                      STRUCTURAL_QUERY_TAG))),
+                                      STRUCTURAL_QUERY_TAG,
+                                      AGG_QUERY_TAG))),
             is_exhaustive(req))
 
 
@@ -403,12 +407,14 @@ def _probe_tags(key_dict: list, val_dict: list, req,
     enabled — the cost model picks device) yielding a device hit mask.
     Returns (term_keys, term_vals, val_ranges, val_hits) or None
     (pruned)."""
+    from .analytics import AGG_QUERY_TAG
     from .structural import STRUCTURAL_QUERY_TAG
 
     exhaustive = is_exhaustive(req)
     terms = sorted((k, v) for k, v in req.tags.items()
                    if k not in (EXHAUSTIVE_SEARCH_TAG,
-                                STRUCTURAL_QUERY_TAG))
+                                STRUCTURAL_QUERY_TAG,
+                                AGG_QUERY_TAG))
     if staged_dict is not None and terms \
             and _use_device_probe(staged_dict, terms, fp):
         from tempo_tpu.robustness import GUARD, DeviceFault

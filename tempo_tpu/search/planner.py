@@ -76,7 +76,11 @@ from tempo_tpu.observability import metrics as obs
 # same pages land in smaller buckets, so the rate table is effectively
 # bucketed by the columns' packed width — the /debug/planner view an
 # operator reads to see what a byte of residency buys.
-PER_BYTE_KINDS = ("host_probe", "device_probe", "pack", "h2d", "scan")
+PER_BYTE_KINDS = ("host_probe", "device_probe", "pack", "h2d", "scan",
+                  # ingest-side analytics reduction (search/analytics
+                  # .py): seconds per summary-row byte — observational
+                  # like "scan" (fills from live consume_blob calls)
+                  "analytics")
 # kinds the one-shot microbenchmark seeds: everything the probe
 # DECISION consumes. "scan" is observational (it needs a real staged
 # batch, which the seed deliberately never creates) and fills from the
@@ -94,6 +98,7 @@ _DEFAULT_RATES = {
     "pack": 6e-9,
     "h2d": 1e-9,             # ~1 GB/s put
     "scan": 1e-10,           # ~10 GB/s linear pass (HBM-bound on chip)
+    "analytics": 2e-9,       # ~500 MB/s batched summary-row reduction
 }
 _DEFAULT_FIXED = {"dispatch": 1e-3, "compile": 0.5, "collective": 2e-3}
 

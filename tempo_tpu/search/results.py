@@ -27,12 +27,29 @@ class SearchResults:
         # opt-in) — the frontend folds these into its request-level
         # QueryStats instead of concatenating opaque strings
         self.explain_parts: list[dict] = []
+        # ?agg= aggregate payload (search/analytics.py agg_response
+        # shape), merged exactly across groups and sub-responses —
+        # integer counts, so fan-in order never changes the answer
+        self.agg: dict | None = None
 
     @classmethod
     def for_request(cls, req) -> "SearchResults":
+        from .analytics import agg_requested
         from .pipeline import is_exhaustive
 
-        return cls(limit=req.limit or 20, no_quit=is_exhaustive(req))
+        # an aggregation must see every contributing group: the limit
+        # early-quit would freeze the aggregate at whichever groups
+        # happened to drain first (cache-residency-dependent), breaking
+        # the cross-route byte-identity the ?agg= contract promises
+        return cls(limit=req.limit or 20,
+                   no_quit=is_exhaustive(req) or agg_requested(req))
+
+    def add_agg(self, series: dict) -> None:
+        """Fold one group's decoded agg series in (AggStage.decode) —
+        called per drained dispatch, device and host routes alike."""
+        from .analytics import agg_response, merge_agg
+
+        self.agg = merge_agg(self.agg, agg_response(series))
 
     def add(self, meta: tempopb.TraceSearchMetadata) -> None:
         prev = self._by_id.get(meta.trace_id)
@@ -81,6 +98,16 @@ class SearchResults:
                     json.loads(resp.metrics.query_stats_json))
             except ValueError:
                 pass  # a malformed part never fails a merge
+        if resp.metrics.agg_json:
+            import json
+
+            from .analytics import merge_agg
+
+            try:
+                self.agg = merge_agg(self.agg,
+                                     json.loads(resp.metrics.agg_json))
+            except ValueError:
+                pass  # a malformed part never fails a merge
 
     @property
     def n_results(self) -> int:
@@ -109,4 +136,11 @@ class SearchResults:
         )[: self.limit]
         resp.traces.extend(metas)
         resp.metrics.CopyFrom(self.metrics)
+        if self.agg is not None:
+            import json
+
+            # sort_keys: the series dict's insertion order depends on
+            # which group drained first — canonical JSON keeps the
+            # byte-identity assertions across dispatch routes honest
+            resp.metrics.agg_json = json.dumps(self.agg, sort_keys=True)
         return resp

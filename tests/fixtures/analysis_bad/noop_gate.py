@@ -8,6 +8,7 @@ from . import fakes as obs
 FAULTS = object()        # stand-in singleton; never executed
 TELEMETRY = object()
 HEDGE = object()
+ANALYTICS = object()
 
 
 class Telemetry:
@@ -78,3 +79,15 @@ def hedge_unguarded(seconds):
 def hedge_guarded(seconds):
     if HEDGE.armed:
         HEDGE.observe(seconds)
+
+
+def analytics_unguarded(batch):
+    # VIOLATION: analytics staging with no dominating gate check — the
+    # disabled deployment would build the composite-key column on every
+    # search
+    ANALYTICS.stage_for_batch(batch)
+
+
+def analytics_guarded(batch):
+    if ANALYTICS.enabled:
+        ANALYTICS.stage_for_batch(batch)

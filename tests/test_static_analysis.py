@@ -153,7 +153,7 @@ _FIXTURE_GATES = (
 def test_contract_flags_pre_gate_work_and_unguarded_calls(bad_pkg):
     findings = NoopContractChecker(gated=_FIXTURE_GATES).check(bad_pkg)
     keys = sorted(f.key.split(":")[0] for f in findings)
-    assert keys == ["pre-gate", "pre-gate"] + ["unguarded"] * 6, \
+    assert keys == ["pre-gate", "pre-gate"] + ["unguarded"] * 7, \
         [f.message for f in findings]
     msgs = " | ".join(f.message for f in findings)
     assert "metric write" in msgs and "clock read" in msgs
@@ -162,6 +162,11 @@ def test_contract_flags_pre_gate_work_and_unguarded_calls(bad_pkg):
     # is flagged; the guarded twin stays silent
     assert "hedge_unguarded" in msgs and "HEDGE.observe()" in msgs
     assert "hedge_guarded" not in msgs
+    # the analytics rule: staging without the enabled gate is flagged;
+    # the guarded twin stays silent
+    assert "analytics_unguarded" in msgs
+    assert "ANALYTICS.stage_for_batch()" in msgs
+    assert "analytics_guarded" not in msgs
     # polarity: `if FAULTS.active: return` exits on the ARMED path —
     # it must NOT count as a guard for what follows; and the else
     # branch of a gate test is the gate-OFF path
