@@ -1307,6 +1307,138 @@ def phase_query_stats_overhead():
     return result
 
 
+def phase_selftrace_overhead():
+    """Dogfood pipeline contract (`selftrace_ingest_enabled`,
+    docs/observability.md "Self-hosted tracing"): the gate off is a
+    TRUE noop — byte-identical search responses — and the gate ON must
+    cost < 2% of an end-to-end request. The request-path additions are
+    (a) per-dispatch stage-span lowering, (b) the request span's
+    query.* annotation, (c) the breaker/recorder gate reads; export +
+    self-ingest ride the flush thread, off the request path. Same shape
+    as profile_overhead: the ASSERTED bound is the deterministic
+    protocol cost as a fraction of a measured request; the wall-clock
+    A/B delta rides along, informational."""
+    import json as _json
+    import tempfile
+
+    from tempo_tpu.api.http import HTTPApi
+    from tempo_tpu.db.tempodb import TempoDBConfig
+    from tempo_tpu.modules import App, AppConfig
+    from tempo_tpu.observability import selftrace
+    from tempo_tpu.observability.selftrace import SELFTRACE
+    from tempo_tpu.utils.ids import random_trace_id
+    from tempo_tpu.utils.test_data import make_trace
+
+    iters = int(os.environ.get("BENCH_SELFTRACE_ITERS", 40))
+    reps = int(os.environ.get("BENCH_SELFTRACE_REPS", 3))
+    with tempfile.TemporaryDirectory(prefix="bench-selftrace-") as tmp:
+        app = App(AppConfig(
+            wal_dir=os.path.join(tmp, "wal"),
+            db=TempoDBConfig(auto_mesh=False),
+            self_tracing={"enabled": True, "exporter": "self",
+                          "selftrace_ingest_enabled": True,
+                          "sample_ratio": 1.0,
+                          # keep the batch thread quiet mid-timing;
+                          # force_flush drains between reps
+                          "flush_interval_s": 3600.0}))
+        try:
+            api = HTTPApi(app)
+            for seed in range(1, 5):
+                app.push("t1", list(make_trace(random_trace_id(),
+                                               seed=seed).batches))
+            app.flush_tick(force=True)
+            app.poll_tick()
+            params = {"tags": "service.name=frontend", "limit": "20"}
+            hdr = {"X-Scope-OrgID": "t1"}
+
+            def run_loop(n):
+                body = None
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    code, body = api.handle("GET", "/api/search",
+                                            params, hdr)
+                    assert code == 200
+                return time.perf_counter() - t0, body
+
+            run_loop(max(4, iters // 4))  # warm: jit cache + heat
+            t_on, t_off = [], []
+            b_on = b_off = None
+            try:
+                for _ in range(reps):
+                    selftrace.configure(ingest_enabled=False)
+                    dt, b_off = run_loop(iters)
+                    t_off.append(dt)
+                    selftrace.configure(ingest_enabled=True)
+                    dt, b_on = run_loop(iters)
+                    t_on.append(dt)
+                    app.tracer.processor.force_flush()
+            finally:
+                selftrace.configure(ingest_enabled=True)
+            request_us = min(t_on) / iters * 1e6
+            ab_overhead_pct = (min(t_on) - min(t_off)) / min(t_off) * 100
+            identical = (_json.dumps(b_on, sort_keys=True)
+                         == _json.dumps(b_off, sort_keys=True))
+            assert identical, "selftrace gate on/off responses diverged"
+
+            # deterministic protocol cost: exactly what the gate adds
+            # to one request — lower a representative 5-stage dispatch
+            # record + annotate the request span with the QueryStats
+            # headline dict — measured enabled vs disabled (the span
+            # itself exists either way under plain self-tracing)
+            class _Rec:
+                mode = "batched"
+                jit = "hit"
+                h2d_bytes = 4096
+                d2h_bytes = 256
+                stages = {"build": 1e-4, "h2d": 2e-4, "compile": 0.0,
+                          "execute": 4e-4, "d2h": 1e-4}
+
+            rec = _Rec()
+            qd = {"wall_ms": 2.0, "device_seconds": 4e-4,
+                  "blocks_inspected": 4,
+                  "bytes_inspected": {"host": 1 << 16, "device": 1 << 18},
+                  "dispatches": 2, "fused_dispatches": 1}
+            tracer = app.tracer
+
+            def protocol_loop(n):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    with tracer.start_span("bench.request") as span:
+                        SELFTRACE.lower_dispatch(rec, parent=span)
+                        SELFTRACE.annotate_query(qd)
+                return time.perf_counter() - t0
+
+            N_PROTO = 5_000
+            protocol_loop(500)  # warm
+            on_us = min(protocol_loop(N_PROTO) for _ in range(3)) \
+                / N_PROTO * 1e6
+            selftrace.configure(ingest_enabled=False)
+            try:
+                off_us = min(protocol_loop(N_PROTO) for _ in range(3)) \
+                    / N_PROTO * 1e6
+            finally:
+                selftrace.configure(ingest_enabled=True)
+            overhead_pct = (on_us - off_us) / request_us * 100
+            result = {
+                "iters_per_rep": iters,
+                "reps": reps,
+                "request_us": round(request_us, 1),
+                "gate_cost_us": round(on_us - off_us, 2),
+                "noop_cost_us": round(off_us, 3),
+                "overhead_pct": round(overhead_pct, 3),
+                "ab_overhead_pct": round(ab_overhead_pct, 3),
+                "within_2pct": overhead_pct < 2.0,
+                "byte_identical": identical,
+            }
+            assert overhead_pct < 2.0, (
+                f"selftrace gate cost {on_us - off_us:.1f}us is "
+                f"{overhead_pct:.2f}% of the {request_us:.0f}us request "
+                "— exceeds the 2% budget")
+        finally:
+            app.shutdown()
+    return result
+
+
 def phase_freshness():
     """Search-freshness SLO (ROADMAP item 4's acceptance instrument):
     drive a soak-style concurrent write load through the full
@@ -3184,6 +3316,7 @@ PHASES = {
     "high_cardinality_full": phase_high_cardinality_full,
     "profile_overhead": phase_profile_overhead,
     "query_stats_overhead": phase_query_stats_overhead,
+    "selftrace_overhead": phase_selftrace_overhead,
     "freshness": phase_freshness,
     "chaos": phase_chaos,
     "ownership": phase_ownership,
@@ -3207,6 +3340,7 @@ PHASE_TIMEOUTS = {
     "high_cardinality_full": 420.0,
     "profile_overhead": 300.0,
     "query_stats_overhead": 300.0,
+    "selftrace_overhead": 300.0,
     "freshness": 560.0,  # baseline leg + hot-tier gate-on leg + tail
     "chaos": 420.0,
     "ownership": 540.0,
@@ -3485,6 +3619,12 @@ def _assemble(results: dict) -> dict:
     if isinstance(qso, dict):
         doc["detail"]["query_stats"] = (
             qso if not _failed(qso) else {"error": qso.get("error")})
+    # dogfood self-trace gate: noop byte-identity + <2% request
+    # overhead, tracked like the profiler/query-stats contracts
+    sto = results.get("selftrace_overhead")
+    if isinstance(sto, dict):
+        doc["detail"]["selftrace"] = (
+            sto if not _failed(sto) else {"error": sto.get("error")})
     # search-freshness SLO: push->searchable p50/p99 under soak write
     # load + the write-path telemetry contracts (gauge-vs-canary
     # agreement, noop byte-identity, <2% ack overhead) — ROADMAP item

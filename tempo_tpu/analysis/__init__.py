@@ -21,8 +21,12 @@ kernels, these properties are enforced here as ANALYSIS over the code:
     branching inside kernel functions reaching ``jax.jit`` /
     ``shard_map_compat``; jit-cache-key hygiene (:mod:`jit_purity`);
   - ``drift`` — declarative code-vs-docs catalogs (config knobs,
-    metrics, faultpoints); the three hand-rolled drift tests are thin
-    wrappers over these declarations now (:mod:`drift`).
+    metrics, faultpoints, /debug routes); the three hand-rolled drift
+    tests are thin wrappers over these declarations now (:mod:`drift`);
+  - ``metrics-catalog`` — every registered Counter/Gauge/Histogram has
+    an observability.md catalog row AND write sites pass only the
+    labels that row declares — an undocumented label mints surprise
+    series cardinality (:mod:`metrics_catalog`).
 
 ``scripts/check.py`` is the CLI; ``tests/test_static_analysis.py`` runs
 the suite in tier-1 and fails on any finding not justified in
@@ -52,10 +56,12 @@ def default_checkers() -> list:
     from .drift import DriftChecker
     from .jit_purity import JitPurityChecker
     from .locks import LockOrderChecker
+    from .metrics_catalog import MetricsCatalogChecker
 
     return [
         LockOrderChecker(),
         NoopContractChecker(),
         JitPurityChecker(),
         DriftChecker(),
+        MetricsCatalogChecker(),
     ]

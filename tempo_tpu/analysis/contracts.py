@@ -191,6 +191,21 @@ GATED_FUNCTIONS = (
     GatedFunction("tempo_tpu.search.analytics",
                   "AnalyticsEngine.consume_blob", ("enabled",),
                   "search_analytics_enabled"),
+    # dogfood self-ingest: span lowering and query-stat annotation only
+    # run when self-traces actually flow into the `_selftrace` tenant —
+    # the default-off deployment pays one attribute read before any
+    # tracer lookup, clock read, or span synthesis
+    GatedFunction("tempo_tpu.observability.selftrace",
+                  "SelfTraceGate.lower_dispatch", ("ingest_enabled",),
+                  "selftrace_ingest_enabled"),
+    GatedFunction("tempo_tpu.observability.selftrace",
+                  "SelfTraceGate.annotate_query", ("ingest_enabled",),
+                  "selftrace_ingest_enabled"),
+    # anomaly flight recorder: a disabled recorder must not snapshot
+    # subsystems, read clocks, or take its lock when a trigger fires
+    GatedFunction("tempo_tpu.observability.flightrecorder",
+                  "FlightRecorder.record", ("enabled",),
+                  "selftrace_ingest_enabled"),
 )
 
 GUARDED_CALLS = (
@@ -249,6 +264,17 @@ GUARDED_CALLS = (
     # opted in — mentioning it in a test guards like the gate itself)
     GuardedCall("ANALYTICS", ("consume_blob", "stage_for_batch"), (),
                 "enabled", "want_agg", "search_analytics_enabled"),
+    # dogfood hooks on hot paths (dispatch finish, query-stat publish):
+    # call sites gate on the one-attribute read so the default-off
+    # deployment never enters the lowering/annotation protocol
+    GuardedCall("SELFTRACE", ("lower_dispatch", "annotate_query"), (),
+                "ingest_enabled", "SELFTRACE",
+                "selftrace_ingest_enabled"),
+    # flight-recorder triggers (breaker trip, watchdog, slow query)
+    # live on failure paths of otherwise-hot code: each site reads
+    # RECORDER.enabled before snapshotting state into a bundle
+    GuardedCall("RECORDER", ("record",), (), "enabled", "RECORDER",
+                "selftrace_ingest_enabled"),
 )
 
 

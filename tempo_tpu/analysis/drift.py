@@ -126,6 +126,31 @@ def metric_names(pkg: Package) -> dict:
     return out
 
 
+def debug_routes(pkg: Package) -> dict:
+    """Keys of the DEBUG_ROUTES dict in api/http.py — every registered
+    /debug route must be documented in the observability doc's route
+    index."""
+    mod = pkg.by_dotted.get("tempo_tpu.api.http")
+    out: dict = {}
+    if mod is None:
+        return out
+    for node in mod.tree.body:
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "DEBUG_ROUTES" \
+                    and isinstance(value, ast.Dict):
+                for k in value.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        out[k.value] = (mod.rel, k.lineno)
+    return out
+
+
 def faultpoints(pkg: Package) -> dict:
     """Keys of the CATALOG dict in robustness/faults.py."""
     mod = pkg.by_dotted.get("tempo_tpu.robustness.faults")
@@ -180,6 +205,15 @@ CATALOGS = (
         min_names=8,
         backtick=True,
         hint="add the faultpoint to the docs/robustness.md catalog",
+    ),
+    Catalog(
+        name="debug-routes",
+        docs=("docs/observability.md",),
+        extract=debug_routes,
+        min_names=8,
+        backtick=True,
+        hint="document the route in docs/observability.md's /debug "
+             "route index",
     ),
     Catalog(
         name="robustness-knobs",

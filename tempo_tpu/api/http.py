@@ -405,10 +405,18 @@ class HTTPApi:
         milliseconds while backend block groups are still scanning. The
         final `done` event carries the complete merged response
         (byte-equivalent to what /api/search would have returned)."""
+        import contextvars
         import queue as _queue
+
+        from tempo_tpu.observability import metrics as obs
+        from tempo_tpu.observability import tracing
 
         req = self._parse_search(query, headers)
         q: _queue.Queue = _queue.Queue()
+
+        # copied context: the worker's frontend/search spans parent
+        # under the HTTP request span instead of starting orphan traces
+        ctx = contextvars.copy_context()
 
         def run():
             # worker thread: contextvars are thread-local, so the
@@ -423,20 +431,49 @@ class HTTPApi:
             except Exception as e:  # noqa: BLE001 — ship to the stream
                 q.put(("error", e))
 
-        threading.Thread(target=run, daemon=True,
+        threading.Thread(target=ctx.run, args=(run,), daemon=True,
                          name="search-stream").start()
 
+        # the generator drains AFTER handle()'s request span closed (the
+        # server writes frames as they arrive), so the streaming leg
+        # gets its own span parented under the request — ended manually,
+        # never made current: the consuming thread/context is not ours
+        parent = tracing.current_span().context
+
         def events():
-            while True:
-                kind, payload = q.get()
-                if kind == "error":
-                    yield _sse_event("error", {
-                        "error": f"{type(payload).__name__}: {payload}"})
-                    return
-                doc = json_format.MessageToDict(payload)
-                yield _sse_event(kind, doc)
-                if kind == "done":
-                    return
+            obs.sse_active_streams.add(1, endpoint="search_stream",
+                                       tenant=tenant)
+            span = tracing.start_span("sse.search_stream", parent=parent,
+                                      tenant=tenant)
+            n = 0
+            try:
+                while True:
+                    kind, payload = q.get()
+                    if kind == "error":
+                        obs.sse_events_streamed.inc(
+                            endpoint="search_stream", tenant=tenant,
+                            event="error")
+                        if span.recording:
+                            span.set_status(
+                                tracing.STATUS_ERROR, str(payload))
+                        yield _sse_event("error", {
+                            "error":
+                                f"{type(payload).__name__}: {payload}"})
+                        return
+                    doc = json_format.MessageToDict(payload)
+                    obs.sse_events_streamed.inc(
+                        endpoint="search_stream", tenant=tenant,
+                        event=kind)
+                    n += 1
+                    yield _sse_event(kind, doc)
+                    if kind == "done":
+                        return
+            finally:
+                if span.recording:
+                    span.set_attribute("events", n)
+                span.end()
+                obs.sse_active_streams.add(-1, endpoint="search_stream",
+                                           tenant=tenant)
 
         return 200, SSEBody(events())
 
@@ -445,6 +482,9 @@ class HTTPApi:
         trace that matches streams a `trace` event within the push's
         micro-batch — no poll loop against /api/search needed."""
         import time as _time
+
+        from tempo_tpu.observability import metrics as obs
+        from tempo_tpu.observability import tracing
 
         req = self._parse_search(query, headers={})
         sub = self.app.tail_subscribe(tenant, req)
@@ -461,28 +501,52 @@ class HTTPApi:
         # subscription slot forever (the cap is per tenant)
         seconds = min(_int_param(query, "seconds", 30), 3600)
         deadline = _time.monotonic() + seconds
+        # streaming-leg span: same stance as _search_stream — ended
+        # manually, never made current (the generator drains on the
+        # server writer thread after the request span closed)
+        parent = tracing.current_span().context
 
         def events():
+            obs.sse_active_streams.add(1, endpoint="tail", tenant=tenant)
+            span = tracing.start_span("sse.tail", parent=parent,
+                                      tenant=tenant, seconds=seconds)
+            booked = obs.sse_events_streamed
+            n = 0
             try:
+                booked.inc(endpoint="tail", tenant=tenant,
+                           event="subscribed")
                 yield _sse_event("subscribed", {"seconds": seconds})
                 while True:
                     remaining = deadline - _time.monotonic()
                     if remaining <= 0:
+                        booked.inc(endpoint="tail", tenant=tenant,
+                                   event="done")
                         yield _sse_event("done", {"reason": "duration"})
                         return
                     metas = sub.poll(min(remaining, 1.0))
                     if not metas:
                         # SSE comment = keepalive; proxies and clients
                         # see bytes flowing on an idle tail
+                        booked.inc(endpoint="tail", tenant=tenant,
+                                   event="keepalive")
                         yield ": keepalive\n\n"
                         continue
                     for m in metas:
+                        booked.inc(endpoint="tail", tenant=tenant,
+                                   event="trace")
+                        n += 1
                         yield _sse_event(
                             "trace", json_format.MessageToDict(m))
             finally:
                 # runs on generator close() too — client hangup mid-
                 # stream must release the tenant's subscription slot
                 self.app.tail_unsubscribe(sub)
+                if span.recording:
+                    span.set_attribute("events", n)
+                    span.set_attribute("dropped", sub.dropped)
+                span.end()
+                obs.sse_active_streams.add(-1, endpoint="tail",
+                                           tenant=tenant)
 
         return 200, SSEBody(events())
 
@@ -554,6 +618,17 @@ class HTTPApi:
             snap["residency"] = db.batcher.ownership_residency()
         return 200, snap
 
+    def _debug_flightrecorder_route(self, query):
+        # anomaly flight recorder: bounded diagnostic bundles captured
+        # at breaker trips / watchdog fires / slow queries, each with
+        # the offending self-trace id — resolvable in _selftrace while
+        # the dogfood pipeline (selftrace_ingest_enabled) is on
+        # (observability/flightrecorder.py)
+        from tempo_tpu.observability.flightrecorder import RECORDER
+
+        return 200, RECORDER.snapshot(
+            recent=_int_param(query, "recent", 32))
+
     def _debug_ingest_route(self, query):
         # write-path telemetry: per-tenant live/unflushed/backlog state,
         # last flush/poll ages, WAL replay, slow-flush ring, canary
@@ -584,10 +659,14 @@ class HTTPApi:
             # reference /status/config?mode=diff|defaults (app.go:332-378)
             return self._status_config((query or {}).get("mode", ""))
         from tempo_tpu.observability.ingest_telemetry import TELEMETRY
-        from tempo_tpu.observability.profile import device_status
+        from tempo_tpu.observability.profile import build_info, device_status
 
         out = {
             "ready": app.ready(),
+            # build/runtime identity (the tempo_build_info gauge's
+            # labels, re-evaluated live — backend/native may have
+            # initialized since the gauge was set at App init)
+            "build": build_info(),
             "ring": {
                 "instances": app.ring.instance_ids(),
                 "healthy": app.ring.healthy_count(),
@@ -682,6 +761,7 @@ DEBUG_ROUTES = {
     "/debug/ingest": HTTPApi._debug_ingest_route,
     "/debug/faults": HTTPApi._debug_faults_route,
     "/debug/ownership": HTTPApi._debug_ownership_route,
+    "/debug/flightrecorder": HTTPApi._debug_flightrecorder_route,
 }
 
 

@@ -63,6 +63,16 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
     frontend_doc = doc.get("frontend") or {}
     querier_doc = doc.get("querier") or {}
 
+    # self_tracing passes through to init_tracing as a dict, but the
+    # dogfood knobs are read (and type-normalized) HERE explicitly so
+    # the yaml-knob drift catalog pins them to documented rows
+    # (docs/configuration.md; tests/test_config_docs.py)
+    self_tracing = dict(doc.get("self_tracing") or {})
+    self_tracing["selftrace_ingest_enabled"] = bool(
+        self_tracing.get("selftrace_ingest_enabled", False))
+    self_tracing["selftrace_flight_recorder_max"] = int(
+        self_tracing.get("selftrace_flight_recorder_max", 32))
+
     db = TempoDBConfig(
         block_encoding=storage.get("block_encoding", "zstd"),
         wal_encoding=storage.get("wal_encoding", "auto"),
@@ -257,7 +267,7 @@ def load_config(path: str | None = None, text: str | None = None) -> tuple[AppCo
             if k in Limits.__dataclass_fields__
         }),
         per_tenant_overrides=overrides.get("per_tenant", {}),
-        self_tracing=doc.get("self_tracing", {}),
+        self_tracing=self_tracing,
         metrics_generator=doc.get("metrics_generator", {}),
         receivers=doc.get("distributor", {}).get("receivers", {}),
     )

@@ -146,6 +146,11 @@ class App:
         from tempo_tpu.observability import tracing
         self.tracer = tracing.init_tracing(self.cfg.self_tracing,
                                            push=self.push)
+        # build identity: the constant-1 gauge whose labels say WHAT is
+        # running (set once here; /status re-evaluates live)
+        from tempo_tpu.observability import metrics as obs
+        from tempo_tpu.observability import profile
+        obs.build_info.set(1, **profile.build_info())
         # write-path telemetry + freshness canary (process-wide sink,
         # the profiler idiom: the most recent App's config wins)
         from tempo_tpu.observability import ingest_telemetry

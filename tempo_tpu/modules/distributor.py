@@ -101,13 +101,23 @@ class Distributor:
         observation wraps the whole method — it is the latency a client
         experiences before its spans are durable on RF ingesters' WALs
         (telemetry-off pays one attribute read, no clock)."""
+        from tempo_tpu.observability import tracing
         from tempo_tpu.observability.ingest_telemetry import TELEMETRY
 
-        if not TELEMETRY.enabled:
-            return self._push_batches(tenant, batches)
-        t0 = time.perf_counter()
-        self._push_batches(tenant, batches)
-        TELEMETRY.record_push_ack(time.perf_counter() - t0)
+        # a push becomes a trace of its own (or a child of the HTTP
+        # receive span) — with the dogfood pipeline on, the write path
+        # is queryable in _selftrace like the read path. Self-ingest
+        # pushes arrive with tracing suppressed, so the loop never
+        # traces its own exporter (start_span returns the noop span).
+        with tracing.start_span("distributor.PushBatches",
+                                tenant=tenant) as span:
+            if span.recording:
+                span.set_attribute("batches", len(batches))
+            if not TELEMETRY.enabled:
+                return self._push_batches(tenant, batches)
+            t0 = time.perf_counter()
+            self._push_batches(tenant, batches)
+            TELEMETRY.record_push_ack(time.perf_counter() - t0)
 
     def _push_batches(self, tenant: str, batches: list) -> None:
         if not tenant:

@@ -9,6 +9,8 @@ aggregate ingester + block dictionaries under byte limits.
 
 from __future__ import annotations
 
+import contextvars
+
 from tempo_tpu import tempopb
 from tempo_tpu.db import TempoDB
 from tempo_tpu.model.codec import codec_for, CURRENT_ENCODING
@@ -21,6 +23,16 @@ from tempo_tpu.utils.hashing import token_for
 from tempo_tpu.utils.ids import pad_trace_id
 from .overrides import Overrides
 from .ring import Ring
+
+def _ctx_submit(pool, fn, *args):
+    """Submit to the replica pool UNDER the submitter's contextvars:
+    the request's current span and deadline follow the read onto the
+    worker, so spans opened there parent into the request's trace and
+    a breaker fault booked mid-fanout carries the offending trace id
+    into its flight-recorder bundle instead of an anonymous None."""
+    ctx = contextvars.copy_context()
+    return pool.submit(ctx.run, fn, *args)
+
 
 QUERY_MODE_INGESTERS = "ingesters"
 QUERY_MODE_BLOCKS = "blocks"
@@ -121,8 +133,8 @@ class Querier:
                     failed += 1
                     obs.partial_results.inc(reason="replica")
                     continue
-                futs.append(self._fanout_pool().submit(
-                    ing.find_trace_by_id, tenant, tid))
+                futs.append(_ctx_submit(self._fanout_pool(),
+                                        ing.find_trace_by_id, tenant, tid))
             try:
                 # bounded by the request deadline, like search_recent:
                 # a replica wedged behind a dead backend must not hold
@@ -181,7 +193,7 @@ class Querier:
             return local.response()
 
         pool = self._fanout_pool()
-        futs = [pool.submit(one, ing) for ing in ings]
+        futs = [_ctx_submit(pool, one, ing) for ing in ings]
         try:
             # bounded by the request deadline: a replica stuck behind a
             # dead device must not hold the whole answer hostage —

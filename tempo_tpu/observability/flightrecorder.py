@@ -1,0 +1,150 @@
+"""Anomaly flight recorder: a bounded black box for the bad moments.
+
+When something notable goes wrong — a circuit-breaker trip, a watchdog
+timeout abandoning a dispatch, a query past the slow-query threshold —
+the metrics rings still hold the evidence, but only until they roll
+over, and correlating them after the fact means scraping four /debug
+endpoints and hoping the windows overlap. The flight recorder snapshots
+them TOGETHER, at the moment of the anomaly, into one bounded bundle:
+
+  { seq, unix_ts, trigger, trace_id, detail,
+    profile:   recent profiler ring + aggregates,
+    breaker:   breaker state machine snapshot,
+    planner:   offload-planner calibration snapshot,
+    ownership: HBM ownership-map snapshot }
+
+``trace_id`` is the offending request's own self-trace id (the current
+span's, or passed explicitly by the trigger site) — with the dogfood
+pipeline on (observability/selftrace, the shared gate) that trace is
+ingested into the reserved ``_selftrace`` tenant, so the bundle's id
+resolves via ordinary trace-by-ID and the operator pivots from "what
+tripped" straight to "what that request was doing".
+
+Bundles land in a deque bounded by ``selftrace_flight_recorder_max``
+(oldest evicted) and render at ``/debug/flightrecorder``.
+
+Lock discipline: every subsystem snapshot is taken BEFORE the
+recorder's own lock is acquired, and trigger sites call ``record``
+outside their own locks (breaker.record_fault fires after releasing
+the breaker lock), so ``FlightRecorder._lock`` is a leaf in the
+process lock graph — the LockOrderChecker's clean-package test pins
+this. Noop contract: disabled ``record`` is one attribute read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from . import tracing
+
+TRIGGER_BREAKER = "breaker_trip"
+TRIGGER_WATCHDOG = "watchdog_timeout"
+TRIGGER_SLOW_QUERY = "slow_query"
+
+_PROFILE_RECENT = 8  # profiler-ring entries captured per bundle
+
+
+def _safe(fn):
+    """Snapshot helpers must never fail a trigger site: a process with
+    a subsystem half-configured (tests, standalone roles) records what
+    it can and omits the rest."""
+    try:
+        return fn()
+    except Exception:  # noqa: BLE001 — diagnostics never raise upward
+        return None
+
+
+def _snapshots() -> dict:
+    from tempo_tpu.observability import profile
+
+    out = {
+        "profile": _safe(lambda: profile.PROFILER.snapshot(
+            recent=_PROFILE_RECENT)),
+    }
+
+    def _breaker():
+        from tempo_tpu.robustness import BREAKER
+
+        return BREAKER.snapshot()
+
+    def _planner():
+        from tempo_tpu.search.planner import PLANNER
+
+        return PLANNER.snapshot(recent=_PROFILE_RECENT)
+
+    def _ownership():
+        from tempo_tpu.search.ownership import OWNERSHIP
+
+        return OWNERSHIP.snapshot()
+
+    out["breaker"] = _safe(_breaker)
+    out["planner"] = _safe(_planner)
+    out["ownership"] = _safe(_ownership)
+    return out
+
+
+class FlightRecorder:
+    """Process-wide recorder (module singleton ``RECORDER``, the
+    PROFILER idiom); ``enabled`` tracks selftrace.configure's
+    ``ingest_enabled`` — one dogfood gate for the whole subsystem."""
+
+    def __init__(self, max_bundles: int = 32) -> None:
+        self.enabled = False
+        self._bundles: deque = deque(maxlen=max_bundles)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._by_trigger: dict[str, int] = {}
+
+    def record(self, trigger: str, trace_id: str | None = None,
+               detail: dict | None = None) -> dict | None:
+        """Snapshot one diagnostic bundle. `trace_id`: the offending
+        self-trace id (hex); defaults to the current span's — trigger
+        sites running on the request thread get it for free. Returns
+        the bundle (tests), None when disabled."""
+        if not self.enabled:
+            return None
+        if trace_id is None:
+            span = tracing.current_span()
+            trace_id = (span.context.trace_id.hex()
+                        if span.recording else None)
+        bundle = {
+            "trigger": trigger,
+            "unix_ts": round(time.time(), 3),
+            "trace_id": trace_id,
+            "detail": dict(detail or {}),
+        }
+        bundle.update(_snapshots())
+        with self._lock:
+            self._seq += 1
+            bundle["seq"] = self._seq
+            self._by_trigger[trigger] = self._by_trigger.get(trigger, 0) + 1
+            self._bundles.append(bundle)
+        return bundle
+
+    def resize(self, max_bundles: int) -> None:
+        with self._lock:
+            self._bundles = deque(self._bundles, maxlen=max(1, max_bundles))
+
+    def snapshot(self, recent: int = 32) -> dict:
+        """The /debug/flightrecorder payload."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "max_bundles": self._bundles.maxlen,
+                "recorded": self._seq,
+                "by_trigger": dict(self._by_trigger),
+                "bundles": list(self._bundles)[-recent:]
+                if recent > 0 else [],
+            }
+
+    def reset(self) -> None:
+        """Test hook: drop bundles, keep configuration."""
+        with self._lock:
+            self._bundles.clear()
+            self._by_trigger.clear()
+            self._seq = 0
+
+
+RECORDER = FlightRecorder()

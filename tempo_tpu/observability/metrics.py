@@ -128,6 +128,14 @@ class Gauge(_Metric):
         with self._lock:
             self._series[self._key(labels)] = v
 
+    def add(self, n: float, **labels: LabelValue) -> None:
+        """Delta update (negative to decrement) — for gauges tracking
+        in-flight counts with no single owner to re-derive them from
+        (e.g. SSE response bodies draining on server writer threads)."""
+        k = self._key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0) + n
+
     def remove(self, **labels: LabelValue) -> None:
         """Drop one labeled series. A per-tenant gauge whose tenant
         vanished must stop exporting its last value — a frozen
@@ -437,9 +445,22 @@ live_tail_notifications = Counter(
     "tail notifications delivered to standing-query subscribers")
 live_tail_dropped = Counter(
     "tempo_search_live_tail_dropped_total",
-    "tail notifications/registrations dropped (reason=queue: a slow "
-    "consumer's bounded queue overflowed, oldest dropped; cap: "
+    "tail notifications/registrations dropped per tenant (reason=queue: "
+    "a slow consumer's bounded queue overflowed, oldest dropped; cap: "
     "subscribe rejected at search_live_tail_max_subscriptions)")
+
+# ---- SSE streaming surfaces (api/http.py /api/search/stream, /api/tail)
+sse_active_streams = Gauge(
+    "tempo_sse_active_streams",
+    "SSE responses currently being written per tenant "
+    "(endpoint=search_stream|tail) — live-tail SUBSCRIPTIONS are "
+    "tempo_search_live_tail_subscriptions; this counts the HTTP legs, "
+    "including ones draining after their subscription lapsed")
+sse_events_streamed = Counter(
+    "tempo_sse_events_total",
+    "SSE events written to clients per tenant "
+    "(endpoint=search_stream|tail, event = the SSE event name: "
+    "result|trace|summary|subscribed|end|error|keepalive)")
 
 # ---- device-side aggregate analytics (search/analytics.py) ----
 search_analytics_dispatches = Counter(
@@ -643,8 +664,21 @@ faults_injected = Counter(
 # ---- self-tracing health (observability/tracing.py) ----
 selftrace_dropped_spans = Counter(
     "tempo_selftrace_dropped_spans_total",
-    "self-trace spans dropped because the batch processor queue was full")
+    "self-trace spans dropped because the batch processor queue was "
+    "full, labeled by exporter class like selftrace_export_failures — "
+    "and the SINGLE source of truth: BatchProcessor.dropped derives "
+    "from this series")
 selftrace_export_failures = Counter(
     "tempo_selftrace_export_failures_total",
     "self-trace export batches that raised (swallowed to protect the "
     "flush loop; this counter is the only visible signal)")
+
+# ---- build identity ----
+build_info = Gauge(
+    "tempo_build_info",
+    "constant 1; the process's build/runtime identity rides the labels "
+    "(version = tempo_tpu package version, jax = jax version or "
+    "'absent', backend = initialized jax backend or "
+    "uninitialized/unknown at set time, native = native libtempotpu.so "
+    "state: loaded|present|absent|unknown) — the standard *_build_info "
+    "idiom, set once at App init and mirrored live in /status")

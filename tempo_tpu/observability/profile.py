@@ -58,6 +58,7 @@ import time
 from collections import deque
 
 from . import metrics as obs
+from . import selftrace
 from . import tracing
 
 STAGES = ("build", "h2d", "compile", "execute", "d2h", "lock_wait")
@@ -408,6 +409,12 @@ class DispatchProfiler:
                 jit_cache=rec.jit or "",
                 **{f"{k}_ms": round(v * 1e3, 3)
                    for k, v in rec.stages.items()})
+            # dogfood pipeline: the record additionally lowers into
+            # per-stage child spans of the active span, so structural
+            # queries over span.stage see real dispatch telemetry
+            # (observability/selftrace; gate off = one attribute read)
+            if selftrace.SELFTRACE.ingest_enabled:
+                selftrace.SELFTRACE.lower_dispatch(rec, parent=span)
 
     # ---- operator surface ----
 
@@ -504,6 +511,52 @@ def dispatch(mode: str):
 def observe_stage(stage: str, mode: str, seconds: float,
                   nbytes: int = 0) -> None:
     PROFILER.observe_stage(stage, mode, seconds, nbytes=nbytes)
+
+
+def build_info() -> dict:
+    """Build/runtime identity: package version, jax version, backend,
+    native-.so state. Feeds the `tempo_build_info` gauge labels (set
+    once at App init) and the /status "build" block (re-evaluated per
+    probe). Shares device_status's stance: NEVER initializes a jax
+    backend, never triggers a native build — reporting identity must
+    not claim a chip or fork a compiler."""
+    import os
+
+    import tempo_tpu
+
+    info: dict = {"version": tempo_tpu.__version__}
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+    except Exception:  # noqa: BLE001 — identity, never fatal
+        info["jax"] = "absent"
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if getattr(_xb, "_backends", None):
+            import jax
+
+            info["backend"] = jax.default_backend()
+        else:
+            info["backend"] = "uninitialized"
+    except Exception:  # noqa: BLE001 — internal API moves across versions
+        info["backend"] = "unknown"
+    try:
+        from tempo_tpu.ops import native as _native
+
+        if _native._TRIED:
+            info["native"] = ("loaded" if _native._LIB is not None
+                              else "absent")
+        else:
+            # not probed yet: report file presence without loading —
+            # _load() may BUILD the .so, and /metrics must not
+            info["native"] = ("present" if any(
+                os.path.exists(os.path.abspath(p))
+                for p in _native._SO_PATHS) else "absent")
+    except Exception:  # noqa: BLE001
+        info["native"] = "unknown"
+    return info
 
 
 def device_status() -> dict:

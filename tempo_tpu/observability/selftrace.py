@@ -1,0 +1,137 @@
+"""Dogfood pipeline gate: self-traces become first-class ingested data.
+
+`selftrace_ingest_enabled` (a `self_tracing:` key, default off) closes
+the "tempo traces tempo" loop: tracing.InProcessExporter pushes every
+finished self-trace span through the normal distributor/TenantInstance
+ingest path into the reserved ``_selftrace`` tenant, and THIS module
+enriches those traces at two points the plain exporter cannot see:
+
+  - ``lower_dispatch``: a finished profiler dispatch record
+    (observability/profile.Dispatch) is lowered into per-stage CHILD
+    spans — build/h2d/compile/execute/d2h/lock_wait — under the span
+    that was active when the dispatch closed, with transfer bytes and
+    the jit-cache verdict as attributes. Stage times are reconstructed
+    (laid back-to-back ending at the lowering instant), not observed
+    live, so structural queries like
+    ``{ span.stage = "h2d" && duration > 50ms }`` work over real
+    dispatch telemetry.
+  - ``annotate_query``: a finished request-scope QueryStats breakdown
+    attaches as ``query.*`` attributes on the request span, so the
+    trace of a slow search carries its own cost accounting.
+
+Noop contract (the PR 9 stance, statically checked by the
+NoopContractChecker): with the gate off every call site pays ONE
+attribute read — no allocation, no clock, no lock — and outputs are
+byte-identical. Feedback safety: the ingest-of-self-spans path runs
+under tracing._suppressed, so the spans describing the self-ingest are
+never themselves traced; additionally both hooks bail when the current
+span is not recording, which covers suppressed and sampled-out paths.
+
+The anomaly flight recorder (observability/flightrecorder.RECORDER)
+shares this gate: breaker trips, watchdog fires and slow queries
+snapshot bounded diagnostic bundles whose trace ids resolve in
+``_selftrace``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from . import tracing
+
+# lowering order — stages are laid back-to-back in the order the
+# dispatch path actually runs them (profile.STAGES minus the reorder:
+# lock_wait precedes the guarded body on mesh paths)
+_STAGE_ORDER = ("lock_wait", "build", "h2d", "compile", "execute", "d2h")
+
+
+class SelfTraceGate:
+    """Process-wide gate (module singleton ``SELFTRACE``, the PROFILER
+    idiom): tracing.init_tracing flips ``ingest_enabled`` from the
+    ``self_tracing:`` config block; hot call sites read the one
+    attribute and branch out when the dogfood loop is off."""
+
+    def __init__(self) -> None:
+        self.ingest_enabled = False
+
+    def lower_dispatch(self, rec, parent=None) -> None:
+        """Lower a finished profiler ``Dispatch`` record into per-stage
+        child spans of `parent` (default: the current span). The record
+        holds durations, not timestamps, so the children are synthesized
+        back-to-back ending now — inside the real dispatch window to
+        clock resolution, and honest about per-stage duration, which is
+        what structural duration predicates query."""
+        if not self.ingest_enabled:
+            return
+        tracer = tracing.get_tracer()
+        if tracer is None:
+            return
+        if parent is None:
+            parent = tracing.current_span()
+        if not parent.recording or not rec.stages:
+            return
+        end_ns = time.time_ns()
+        cursor = end_ns - int(sum(rec.stages.values()) * 1e9)
+        for stage in _STAGE_ORDER:
+            sec = rec.stages.get(stage)
+            if sec is None:
+                continue
+            dur_ns = int(sec * 1e9)
+            span = tracer.start_span(f"dispatch.{stage}",
+                                     parent=parent.context,
+                                     stage=stage, mode=rec.mode)
+            if span.recording:
+                if stage == "h2d" and rec.h2d_bytes:
+                    span.set_attribute("bytes", rec.h2d_bytes)
+                elif stage == "d2h" and rec.d2h_bytes:
+                    span.set_attribute("bytes", rec.d2h_bytes)
+                if stage in ("compile", "execute") and rec.jit is not None:
+                    span.set_attribute("jit_cache", rec.jit)
+                span.start_ns = cursor
+                span.end(end_ns=cursor + dur_ns)
+            cursor += dur_ns
+
+    def annotate_query(self, d: dict) -> None:
+        """Attach a finished request-scope QueryStats dict (to_dict
+        form) as flat ``query.*`` attributes on the current span — the
+        request-scope span when called from the registry's publish on
+        the request thread. Scalars only: nested breakdowns stay in the
+        explain payload; the span carries the headline costs a trace
+        reader triages by."""
+        if not self.ingest_enabled:
+            return
+        span = tracing.current_span()
+        if not span.recording:
+            return
+        span.set_attribute("query.wall_ms", d.get("wall_ms", 0.0))
+        span.set_attribute("query.device_seconds",
+                           d.get("device_seconds", 0.0))
+        span.set_attribute("query.blocks_inspected",
+                           d.get("blocks_inspected", 0))
+        b = d.get("bytes_inspected") or {}
+        span.set_attribute("query.bytes_host", b.get("host", 0))
+        span.set_attribute("query.bytes_device", b.get("device", 0))
+        span.set_attribute("query.dispatches", d.get("dispatches", 0))
+        if d.get("fused_dispatches"):
+            span.set_attribute("query.fused_dispatches",
+                               d["fused_dispatches"])
+        if d.get("subqueries"):
+            span.set_attribute("query.subqueries", d["subqueries"])
+
+
+SELFTRACE = SelfTraceGate()
+
+
+def configure(ingest_enabled: bool | None = None,
+              flight_recorder_max: int | None = None) -> SelfTraceGate:
+    """Apply the self_tracing config block to the process gate AND the
+    flight recorder (one gate, two surfaces — the recorder's triggers
+    are only meaningful while the triggering trace is queryable)."""
+    from . import flightrecorder
+
+    if ingest_enabled is not None:
+        SELFTRACE.ingest_enabled = bool(ingest_enabled)
+        flightrecorder.RECORDER.enabled = bool(ingest_enabled)
+    if flight_recorder_max is not None:
+        flightrecorder.RECORDER.resize(int(flight_recorder_max))
+    return SELFTRACE

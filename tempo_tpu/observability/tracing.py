@@ -106,10 +106,13 @@ class Span:
                           "exception.message": str(exc)})
         return self.set_status(STATUS_ERROR, str(exc))
 
-    def end(self) -> None:
+    def end(self, end_ns: int | None = None) -> None:
+        """end_ns: explicit end timestamp for synthesized spans (the
+        dogfood pipeline lowers profiler stage records into child spans
+        whose times are reconstructed, not observed live)."""
         if self.end_ns:
             return
-        self.end_ns = time.time_ns()
+        self.end_ns = end_ns or time.time_ns()
         if self.context.sampled:
             self._tracer._on_end(self)
 
@@ -258,22 +261,41 @@ class BatchProcessor:
         self.max_batch = max_batch
         self.interval_s = interval_s
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
-        self.dropped = 0
+        # dropped-span accounting lives in ONE place — the labeled
+        # counter; the instance view derives from it (before, the bare
+        # `self.dropped += 1` int and the unlabeled counter could drift,
+        # and the counter could not distinguish exporters)
+        self._exporter_label = type(exporter).__name__
+        from . import metrics as obs
+
+        self._dropped_base = obs.selftrace_dropped_spans.value(
+            exporter=self._exporter_label)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="tempo-tpu-trace-export")
         self._thread.start()
 
+    @property
+    def dropped(self) -> int:
+        """Spans THIS processor dropped: derived from the labeled
+        counter (single source of truth) minus the baseline captured at
+        construction, so concurrent processors over the same exporter
+        class cannot make a fresh one report history it never had."""
+        from . import metrics as obs
+
+        return int(obs.selftrace_dropped_spans.value(
+            exporter=self._exporter_label) - self._dropped_base)
+
     def on_end(self, span: Span) -> None:
         try:
             self._q.put_nowait(span)
         except queue.Full:
-            self.dropped += 1
             # visible, not just instance state: a saturated exporter was
-            # previously indistinguishable from a healthy quiet one
+            # previously indistinguishable from a healthy quiet one —
+            # and labeled by exporter, like selftrace_export_failures
             from . import metrics as obs
 
-            obs.selftrace_dropped_spans.inc()
+            obs.selftrace_dropped_spans.inc(exporter=self._exporter_label)
 
     def _drain(self) -> list:
         out = []
@@ -417,6 +439,31 @@ class SelfExporter:
         self.push(self.tenant, [rs])
 
 
+# the dogfood pipeline's reserved tenant: self-trace spans ingested
+# through the normal distributor path land here, away from user data.
+# A leading underscore passes tenant validation (utils/pathsafe allows
+# it) while making the reservation visually obvious in blocklists.
+SELFTRACE_TENANT = "_selftrace"
+
+
+class InProcessExporter(SelfExporter):
+    """The dogfood ingest exporter (`selftrace_ingest_enabled`):
+    finished self-trace spans become the existing push wire format and
+    ride the normal distributor/TenantInstance ingest path into the
+    reserved ``_selftrace`` tenant — every search request, device
+    dispatch, flush, poll and compaction becomes a real trace queryable
+    via trace-by-ID, tag search, structural ``?q=``, ``?agg=`` and live
+    tail. The surrounding BatchProcessor/SyncProcessor suppression
+    covers the whole ingest-of-self-spans path, so the loop cannot feed
+    back (test_self_export_suppression_no_recursion)."""
+
+    def __init__(self, push, service_name: str = "tempo-tpu",
+                 instance_id: str = "self"):
+        super().__init__(push, tenant=SELFTRACE_TENANT,
+                         service_name=service_name,
+                         instance_id=instance_id)
+
+
 class OTLPHTTPExporter:
     """OTLP/HTTP protobuf export to any collector (or another tempo-tpu's
     /v1/traces receiver)."""
@@ -503,8 +550,26 @@ def init_tracing(cfg: dict, push=None) -> Tracer | None:
           tenant: self
           sample_ratio: 1.0
           service_name: tempo-tpu
+          selftrace_ingest_enabled: false   # dogfood pipeline: ingest
+                                            # into _selftrace, stage
+                                            # child spans, querystats
+                                            # span attrs, flight recorder
+          selftrace_flight_recorder_max: 32
     """
-    if not cfg or not cfg.get("enabled"):
+    cfg = cfg or {}
+    # the dogfood gate + flight recorder configure HERE — the one entry
+    # point every App/test uses — so gate state always tracks the most
+    # recently installed tracer config (the REGISTRY idiom). Tracing
+    # disabled forces the gate off: there are no spans to dogfood.
+    ingest_on = bool(cfg.get("enabled")) and bool(
+        cfg.get("selftrace_ingest_enabled", False))
+    from . import selftrace as _selftrace
+
+    _selftrace.configure(
+        ingest_enabled=ingest_on,
+        flight_recorder_max=int(
+            cfg.get("selftrace_flight_recorder_max", 32)))
+    if not cfg.get("enabled"):
         return None
     service = cfg.get("service_name", "tempo-tpu")
     tenant = cfg.get("tenant", "self")
@@ -512,7 +577,13 @@ def init_tracing(cfg: dict, push=None) -> Tracer | None:
     if exporter_kind == "self":
         if push is None:
             raise ValueError("self exporter needs an in-process push target")
-        exporter = SelfExporter(push, tenant=tenant, service_name=service)
+        if ingest_on:
+            # dogfood pipeline: the reserved tenant wins over any
+            # configured one — user tenants must not receive self-spans
+            exporter = InProcessExporter(push, service_name=service)
+        else:
+            exporter = SelfExporter(push, tenant=tenant,
+                                    service_name=service)
     elif exporter_kind == "otlp":
         endpoint = cfg.get("endpoint")
         if not endpoint:

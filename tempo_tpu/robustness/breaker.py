@@ -36,6 +36,8 @@ from collections import deque
 
 from tempo_tpu.observability import metrics as obs
 from tempo_tpu.observability import tracing
+from tempo_tpu.observability.flightrecorder import (RECORDER,
+                                                    TRIGGER_BREAKER)
 from tempo_tpu.observability.log import get_logger
 
 log = get_logger("tempo_tpu.breaker")
@@ -129,6 +131,7 @@ class CircuitBreaker:
         if not self.enabled:
             return
         now = time.monotonic()
+        tripped = False
         with self._lock:
             self._last_fault = {"kind": kind, "mode": mode}
             self._last_fault_t = now
@@ -138,13 +141,23 @@ class CircuitBreaker:
                 self._transition(OPEN)
                 self._opened_t = now
                 self._probe_tokens = 0
-                return
-            self._faults.append(now)
-            while self._faults and now - self._faults[0] > self.window_s:
-                self._faults.popleft()
-            if self._state == CLOSED and len(self._faults) >= self.threshold:
-                self._transition(OPEN)
-                self._opened_t = now
+                tripped = True
+            else:
+                self._faults.append(now)
+                while (self._faults
+                       and now - self._faults[0] > self.window_s):
+                    self._faults.popleft()
+                if (self._state == CLOSED
+                        and len(self._faults) >= self.threshold):
+                    self._transition(OPEN)
+                    self._opened_t = now
+                    tripped = True
+        # the flight-recorder snapshot happens OUTSIDE the breaker lock
+        # (it re-reads BREAKER.snapshot among others — the recorder's
+        # lock must stay a leaf in the process lock graph)
+        if tripped and RECORDER.enabled:
+            RECORDER.record(TRIGGER_BREAKER,
+                            detail={"kind": kind, "mode": mode})
 
     def record_success(self, mode: str = "") -> None:
         """Book one successful device dispatch. Closed state returns on

@@ -36,6 +36,9 @@ import contextvars
 import threading
 from typing import Callable, TypeVar
 
+from tempo_tpu.observability.flightrecorder import (RECORDER,
+                                                    TRIGGER_WATCHDOG)
+
 from . import deadline as _deadline
 from .breaker import BREAKER
 from .faults import FAULTS, InjectedFault
@@ -173,6 +176,13 @@ class DispatchGuard:
         except concurrent.futures.TimeoutError:
             fut.cancel()  # no-op if running; the worker is abandoned
             BREAKER.record_fault("timeout", mode=mode)
+            # flight recorder: a watchdog fire means a dispatch is
+            # wedged RIGHT NOW — snapshot before the abandonment
+            # propagates (no lock held here)
+            if RECORDER.enabled:
+                RECORDER.record(TRIGGER_WATCHDOG,
+                                detail={"mode": mode,
+                                        "timeout_s": round(timeout, 3)})
             raise DeviceDispatchTimeout(
                 f"device dispatch ({mode}) exceeded its "
                 f"{timeout:.3f}s watchdog deadline") from None

@@ -219,7 +219,8 @@ class TailSubscription:
                 self.dropped += 1
                 from tempo_tpu.observability import metrics as obs
 
-                obs.live_tail_dropped.inc(reason="queue")
+                obs.live_tail_dropped.inc(reason="queue",
+                                          tenant=self.tenant)
             self._q.append(meta)
             self._cond.notify_all()
 
@@ -400,7 +401,7 @@ class LiveTier:
             if len(t.subs) >= self.max_subscriptions:
                 from tempo_tpu.observability import metrics as obs
 
-                obs.live_tail_dropped.inc(reason="cap")
+                obs.live_tail_dropped.inc(reason="cap", tenant=tenant)
                 return None
             sub = TailSubscription(tenant, req, max_queue=max_queue)
             t.subs.append(sub)

@@ -65,7 +65,10 @@ import time
 from collections import deque
 
 from tempo_tpu.observability import metrics as obs
+from tempo_tpu.observability.flightrecorder import (RECORDER,
+                                                    TRIGGER_SLOW_QUERY)
 from tempo_tpu.observability.log import TenantTokenBucket, get_logger
+from tempo_tpu.observability.selftrace import SELFTRACE
 
 log = get_logger("tempo_tpu.querystats")
 slow_log = get_logger("tempo_tpu.slowquery")
@@ -427,6 +430,12 @@ class QueryStatsRegistry:
         # landing after this snapshot is dropped by design — the
         # abandoned dispatch's share has no response to ride anyway.
         d = qs.to_dict()
+        if qs.scope == "request" and SELFTRACE.ingest_enabled:
+            # dogfood pipeline: publish runs on the request thread, so
+            # the current span IS the request-scope span — the finished
+            # breakdown attaches as query.* attributes and travels into
+            # _selftrace with the trace (gate off = one attribute read)
+            SELFTRACE.annotate_query(d)
         dev_s = d["device_seconds"]
         b = d["bytes_inspected"]
         bytes_host, bytes_device = b["host"], b["device"]
@@ -481,6 +490,17 @@ class QueryStatsRegistry:
                         {"msg": "slow query",
                          "threshold_s": self.slow_s, **d},
                         separators=(",", ":"), sort_keys=True))
+                # flight recorder: the slow query snapshots its bundle
+                # with its own self-trace id, so /debug/flightrecorder
+                # pivots straight to the offending trace in _selftrace.
+                # NOT rate-limited like the log line — the recorder's
+                # deque is the bound
+                if RECORDER.enabled:
+                    RECORDER.record(
+                        TRIGGER_SLOW_QUERY, trace_id=qs.trace_id,
+                        detail={"tenant": qs.tenant, "scope": qs.scope,
+                                "wall_s": round(qs.wall_s, 3),
+                                "threshold_s": self.slow_s})
         return d
 
     def snapshot(self, recent: int = 32) -> dict:

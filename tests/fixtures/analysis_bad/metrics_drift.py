@@ -1,0 +1,41 @@
+"""Metric-catalog fixture: a metric registered without a docs row, a
+catalogued metric written with an undeclared label, and the clean twins.
+
+The self-tests construct :class:`MetricsCatalogChecker` with an
+injected catalog ({"tempo_fixture_good_total": {"tenant"}}) so no doc
+file is involved. No locks, no jit, no guarded receivers — this file
+must stay invisible to the other checkers (the lock-order CLI test pins
+its fixture finding count).
+"""
+
+
+class Counter:  # stand-in ctor shape; the checker matches statically
+    def __init__(self, name, help_=""):
+        self.name = name
+
+    def inc(self, n=1, **labels):
+        pass
+
+
+# BAD: tempo-prefixed metric with no catalog row
+uncatalogued_metric = Counter("tempo_fixture_missing_total",
+                              "registered but never documented")
+
+# catalogued (by the injected catalog) — the write sites below exercise
+# the label check
+good_metric = Counter("tempo_fixture_good_total", "has a catalog row")
+
+
+def bad_label_write():
+    # BAD: `shard` is not in the catalog row's labels cell
+    good_metric.inc(tenant="t1", shard="s0")
+
+
+def clean_label_write():
+    # GOOD twin: only catalogued labels
+    good_metric.inc(tenant="t1")
+
+
+def dynamic_labels_skipped(labels):
+    # GOOD: **expansion is not statically checkable — must stay silent
+    good_metric.inc(**labels)

@@ -37,7 +37,16 @@ def test_registry_covers_the_known_routes():
     # additions are welcome; REMOVALS of a documented route are not
     assert {"/debug/threads", "/debug/scan", "/debug/profile",
             "/debug/planner", "/debug/querystats",
-            "/debug/ingest"} <= set(DEBUG_ROUTES)
+            "/debug/ingest", "/debug/flightrecorder"} <= set(DEBUG_ROUTES)
+
+
+def test_every_route_documented_in_observability_md():
+    """The debug-routes drift catalog: every DEBUG_ROUTES entry must
+    appear (backticked) in docs/observability.md's route index."""
+    from tempo_tpu.analysis.drift import catalog_findings
+
+    findings = catalog_findings("debug-routes")
+    assert findings == [], "\n".join(f.render() for f in findings)
 
 
 @pytest.mark.parametrize("path", sorted(DEBUG_ROUTES))

@@ -233,13 +233,17 @@ def test_selftrace_dropped_spans_counter(sync_tracer):
     bp = tracing.BatchProcessor(_NeverExporter(), max_queue=2,
                                 interval_s=3600)
     try:
-        before = obs.selftrace_dropped_spans.value()
+        # the metric is labelled by exporter class and is the single
+        # source of truth — bp.dropped reads it back, no shadow count
+        before = obs.selftrace_dropped_spans.value(
+            exporter="_NeverExporter")
         for _ in range(5):
             with tracer.start_span("s") as sp:
                 pass
             bp.on_end(sp)
         assert bp.dropped >= 3
-        assert obs.selftrace_dropped_spans.value() - before == bp.dropped
+        assert (obs.selftrace_dropped_spans.value(exporter="_NeverExporter")
+                - before == bp.dropped)
     finally:
         bp.shutdown()
 

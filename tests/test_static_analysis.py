@@ -325,8 +325,90 @@ def test_contract_new_structural_gates_registered():
             "search_structural_remainder_pages") in guarded
 
 
+def test_contract_selftrace_gates_registered():
+    """The dogfood gate is pinned by BOTH registries: the lowering /
+    annotation / recorder entry points test their gate attribute first
+    (GatedFunction) and the hot-path call sites are dominated by the
+    one-attribute gate read (GuardedCall) — the checker run over the
+    real package enforces them; this pins that the entries exist so a
+    refactor cannot silently drop the noop contract."""
+    from tempo_tpu.analysis.contracts import (GATED_FUNCTIONS,
+                                              GUARDED_CALLS)
+
+    gated = {(g.qualname, g.knob) for g in GATED_FUNCTIONS}
+    assert ("SelfTraceGate.lower_dispatch",
+            "selftrace_ingest_enabled") in gated
+    assert ("SelfTraceGate.annotate_query",
+            "selftrace_ingest_enabled") in gated
+    assert ("FlightRecorder.record",
+            "selftrace_ingest_enabled") in gated
+    guarded = {(m, g.knob) for g in GUARDED_CALLS for m in g.methods}
+    assert ("lower_dispatch", "selftrace_ingest_enabled") in guarded
+    assert ("annotate_query", "selftrace_ingest_enabled") in guarded
+    assert ("record", "selftrace_ingest_enabled") in guarded
+
+
 def test_jit_purity_clean_on_real_kernels(real_pkg):
     assert JitPurityChecker().check(real_pkg) == []
+
+
+# ------------------------------------------------- metrics-catalog
+
+
+_FIXTURE_METRIC_CATALOG = {
+    "tempo_fixture_good_total": frozenset({"tenant"}),
+}
+
+
+def test_metrics_catalog_flags_uncatalogued_metric(bad_pkg):
+    from tempo_tpu.analysis.metrics_catalog import MetricsCatalogChecker
+
+    findings = MetricsCatalogChecker(
+        catalog=_FIXTURE_METRIC_CATALOG).check(bad_pkg)
+    missing = [f for f in findings if f.key.startswith("uncatalogued:")]
+    assert len(missing) == 1, [f.message for f in findings]
+    assert "tempo_fixture_missing_total" in missing[0].message
+
+
+def test_metrics_catalog_flags_unknown_label_and_spares_twins(bad_pkg):
+    from tempo_tpu.analysis.metrics_catalog import MetricsCatalogChecker
+
+    findings = MetricsCatalogChecker(
+        catalog=_FIXTURE_METRIC_CATALOG).check(bad_pkg)
+    labels = [f for f in findings if f.key.startswith("unknown-label:")]
+    assert len(labels) == 1, [f.message for f in findings]
+    assert "'shard'" in labels[0].message
+    # the clean twin (catalogued label only) and the dynamic
+    # **expansion (not statically checkable) stay silent
+    lines = {f.line for f in labels}
+    src = bad_pkg.by_rel["analysis_bad/metrics_drift.py"].source
+    for needle in ("good_metric.inc(tenant=\"t1\")",
+                   "good_metric.inc(**labels)"):
+        ok_line = src[:src.index(needle)].count("\n") + 1
+        assert ok_line not in lines
+
+
+def test_metrics_catalog_parses_doc_tables():
+    from tempo_tpu.analysis.metrics_catalog import parse_doc_catalog
+
+    doc = (
+        "| name | type | labels | meaning |\n"
+        "|---|---|---|---|\n"
+        "| `tempo_a_total` | counter | `tenant`, `reason` | things |\n"
+        "| `tempo_b` | gauge | — | a gauge |\n"
+        "| `stage` | other | `x` | not a metric row (bad type) |\n"
+        "| unticked | counter | `x` | not a metric row (no ticks) |\n")
+    cat = parse_doc_catalog(doc)
+    assert cat == {"tempo_a_total": frozenset({"tenant", "reason"}),
+                   "tempo_b": frozenset()}
+
+
+def test_metrics_catalog_clean_on_real_package(real_pkg):
+    """Every registered metric has a docs/observability.md row and every
+    literal write-site label is catalogued — the satellite contract."""
+    from tempo_tpu.analysis.metrics_catalog import MetricsCatalogChecker
+
+    assert MetricsCatalogChecker().check(real_pkg) == []
 
 
 # ------------------------------------------------- allowlist semantics
